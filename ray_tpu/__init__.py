@@ -1,0 +1,183 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+Public API parity with the reference's L7 surface (``python/ray/_private/
+worker.py:1225,2576,2691,2756``; ``python/ray/remote_function.py:266``;
+``python/ray/actor.py:566``): ``init/shutdown``, ``@remote``, ``get/put/wait``,
+actors, named actors, placement groups, and the library stack (``data``,
+``train``, ``tune``, ``serve``, ``rl``) as pure clients of this core.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.worker import (
+    ObjectRef,
+    ObjectRefGenerator,
+    get_runtime,
+    is_initialized,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "ObjectRef",
+    "ObjectRefGenerator",
+    "ActorClass",
+    "ActorHandle",
+    "exceptions",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "timeline",
+    "__version__",
+]
+
+
+def init(**kwargs):
+    """Start (or connect to) the runtime. Parity: ``ray.init``."""
+    return _worker.init(**kwargs)
+
+
+def shutdown():
+    _worker.shutdown()
+
+
+def remote(*args, **options):
+    """Decorator turning a function into a remote task / class into an actor."""
+
+    def decorate(obj):
+        import inspect
+
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and (callable(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return decorate
+
+
+def method(num_returns: int = 1):
+    """Decorator recording per-method defaults (parity: ``ray.method``)."""
+
+    def decorate(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+
+    return decorate
+
+
+def put(value: Any) -> ObjectRef:
+    rt = get_runtime()
+    return ObjectRef(rt.put(value), _owned=True)
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+) -> Any:
+    rt = get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get_objects([refs.id()], timeout=timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        if not refs:
+            return []
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("get() accepts an ObjectRef or a list of ObjectRefs")
+        return rt.get_objects([r.id() for r in refs], timeout=timeout)
+    raise TypeError(f"get() got {type(refs)}")
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> tuple:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    rt = get_runtime()
+    id_to_ref = {r.id(): r for r in refs}
+    ready_ids, not_ready_ids = rt.wait(
+        [r.id() for r in refs], num_returns=num_returns, timeout=timeout
+    )
+    return [id_to_ref[i] for i in ready_ids], [id_to_ref[i] for i in not_ready_ids]
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    rt = get_runtime()
+    task_id = ref.id().task_id()
+    if hasattr(rt, "scheduler"):
+        rt.scheduler.post(("cancel", task_id, force))
+    else:
+        rt._send(("cmd", ("cancel", task_id, force)))
+
+
+def nodes() -> List[dict]:
+    """Parity: ``ray.nodes()``."""
+    rt = get_runtime()
+    if hasattr(rt, "scheduler"):
+        return rt.scheduler_rpc("list_nodes", ())
+    return rt.rpc("list_nodes")
+
+
+def cluster_resources() -> dict:
+    total: dict = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["total"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict:
+    total: dict = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["available"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace task events. Parity: ``ray.timeline()``
+    (``python/ray/_private/state.py:944``)."""
+    rt = get_runtime()
+    if not hasattr(rt, "scheduler"):
+        raise RuntimeError("timeline() is driver-only")
+    events = rt.scheduler.task_events()
+    out = []
+    for e in events:
+        out.append(
+            {
+                "cat": e["type"],
+                "name": e["name"],
+                "pid": 1,
+                "tid": (hash(e["task_id"]) % 1000),
+                "ph": "i",
+                "ts": e["time"] * 1e6,
+                "args": {"state": e["state"], "task_id": e["task_id"]},
+            }
+        )
+    return out

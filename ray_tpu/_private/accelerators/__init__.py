@@ -1,0 +1,5 @@
+"""Accelerator managers. Parity: ``python/ray/_private/accelerators/``."""
+
+from ray_tpu._private.accelerators import tpu
+
+__all__ = ["tpu"]

@@ -1,0 +1,84 @@
+"""TPU accelerator manager: chip detection, visibility, pod topology.
+
+Design parity: ``TPUAcceleratorManager`` (``python/ray/_private/accelerators/
+tpu.py:71``): chip count via /dev/accel* or vfio, ``TPU_VISIBLE_CHIPS``
+visibility control, pod type from GCE metadata (``tpu.py:48``), worker id, and
+the ``TPU-{pod}-head`` gang-scheduling resource (``tpu.py:334``). Detection
+here never imports jax (the core runtime must not initialize the device).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+GCE_TPU_ACCELERATOR_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5litepod-64"
+GCE_TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+GCE_TPU_TOPOLOGY_ENV = "TPU_TOPOLOGY"
+
+# chips per host for known generations (v4/v5p: 4 chips/host; v5e/v6e: up to 8)
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4, "v5litepod": 8, "v6e": 8}
+
+
+def _visible_chips() -> Optional[List[str]]:
+    raw = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+    if raw is None or raw == "":
+        return None
+    return [c for c in raw.split(",") if c != ""]
+
+
+def detect_chip_count() -> int:
+    """Number of TPU chips attached to this host (0 if none)."""
+    vis = _visible_chips()
+    if vis is not None:
+        return len(vis)
+    paths = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+    if paths:
+        return len([p for p in paths if os.path.basename(p) != "vfio"])
+    if os.environ.get("RAY_TPU_FAKE_CHIPS"):
+        return int(os.environ["RAY_TPU_FAKE_CHIPS"])
+    return 0
+
+
+def detect_pod_type() -> Optional[str]:
+    """Accelerator type string like ``v5litepod-64`` (None off-TPU-VM).
+
+    The reference queries the GCE metadata server (``tpu.py:48``); we read the
+    env vars the TPU VM runtime populates to stay dependency-free, falling
+    back to metadata only if explicitly enabled.
+    """
+    return os.environ.get(GCE_TPU_ACCELERATOR_ENV) or None
+
+
+def detect_worker_id() -> int:
+    return int(os.environ.get(GCE_TPU_WORKER_ID_ENV, "0"))
+
+
+def detect_topology() -> Optional[str]:
+    return os.environ.get(GCE_TPU_TOPOLOGY_ENV) or None
+
+
+def pod_chip_count(pod_type: str) -> int:
+    """Total chips in a pod slice, e.g. v5litepod-64 -> 64."""
+    try:
+        return int(pod_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def pod_host_count(pod_type: str) -> int:
+    gen = pod_type.rsplit("-", 1)[0]
+    chips = pod_chip_count(pod_type)
+    per_host = _CHIPS_PER_HOST.get(gen, 4)
+    return max(1, chips // per_host)
+
+
+def set_visible_chips(chips: List[str]) -> None:
+    os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(chips)
+
+
+def get_current_pod_name() -> Optional[str]:
+    pod = detect_pod_type()
+    return f"TPU-{pod}-head" if pod else None

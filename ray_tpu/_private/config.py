@@ -1,0 +1,91 @@
+"""Typed, env-overridable flag registry.
+
+Design parity: the reference's ``RAY_CONFIG(type, name, default)`` macro system
+(``src/ray/common/ray_config_def.h:18``, 217 flags) — every flag can be
+overridden by an environment variable ``RAY_TPU_<NAME>``, and the head node's
+resolved config is propagated to every node at bootstrap (here: pickled into the
+session's ``config.json`` and re-read by workers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _coerce(raw: str, typ: type) -> Any:
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    """All runtime flags. Defaults match single-host dev usage."""
+
+    # --- object store ---
+    object_store_memory: int = 2 * 1024**3  # bytes of shm for the store arena
+    max_direct_call_object_size: int = 100 * 1024  # inline small returns (ref: ray_config_def.h)
+    object_spilling_threshold: float = 0.8  # fraction of store full before spilling
+    spill_directory: str = ""  # default: <session>/spill
+    # --- scheduler ---
+    worker_lease_timeout_s: float = 30.0
+    scheduler_top_k_fraction: float = 0.2  # hybrid policy top-k (ref: hybrid_scheduling_policy.cc:99)
+    worker_startup_timeout_s: float = 60.0
+    max_pending_lease_requests_per_scheduling_category: int = 10
+    # --- workers ---
+    num_workers_soft_limit: int = 0  # 0 = num_cpus
+    worker_idle_timeout_s: float = 300.0
+    prestart_workers: bool = True
+    # --- health / fault tolerance ---
+    health_check_period_ms: int = 1000  # ref: gcs_health_check_manager.h:55
+    health_check_failure_threshold: int = 5
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    # --- events / metrics ---
+    event_stats_print_interval_ms: int = 0  # 0 = disabled
+    metrics_report_interval_ms: int = 5000
+    task_event_buffer_max: int = 100_000
+    # --- misc ---
+    session_dir_root: str = "/tmp/ray_tpu_sessions"
+    log_to_driver: bool = True
+
+    @classmethod
+    def from_env(cls, **overrides) -> "Config":
+        cfg = cls()
+        types = {"int": int, "float": float, "bool": bool, "str": str}
+        for f in fields(cls):
+            env_name = _ENV_PREFIX + f.name.upper()
+            if env_name in os.environ:
+                typ = types.get(f.type if isinstance(f.type, str) else f.type.__name__, str)
+                setattr(cfg, f.name, _coerce(os.environ[env_name], typ))
+        for k, v in overrides.items():
+            if v is not None:
+                if not hasattr(cfg, k):
+                    raise ValueError(f"unknown config flag: {k}")
+                setattr(cfg, k, v)
+        return cfg
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump({f.name: getattr(self, f.name) for f in fields(self)}, fh, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as fh:
+            data = json.load(fh)
+        cfg = cls()
+        for k, v in data.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+

@@ -1,0 +1,177 @@
+"""Node: session directories, object store layout, worker process spawning.
+
+Design parity: ``python/ray/_private/node.py:37`` (session dir creation, port
+and process management) + the raylet WorkerPool's process-spawning half
+(``src/ray/raylet/worker_pool.h:83``). Workers are spawned from a forkserver so
+each spawn is a cheap fork of a pre-imported template process (the reference
+prestarts idle python workers for the same reason).
+"""
+
+from __future__ import annotations
+
+import atexit
+import multiprocessing as mp
+import os
+import pickle
+import shutil
+import time
+from typing import Dict, Optional
+
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import ObjectStoreClient, destroy_store
+from ray_tpu._private.scheduler import NodeState, Scheduler, WorkerState
+
+_mp_ctx = None
+
+
+def _get_ctx():
+    global _mp_ctx
+    if _mp_ctx is None:
+        method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
+        _mp_ctx = mp.get_context(method)
+        if method == "forkserver":
+            _mp_ctx.set_forkserver_preload(
+                ["ray_tpu._private.worker_process", "ray_tpu._private.serialization"]
+            )
+    return _mp_ctx
+
+
+class Node:
+    """Head node of a (possibly virtual multi-node) cluster."""
+
+    def __init__(
+        self,
+        config: Config,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.config = config
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        self.session_name = f"session_{ts}_{os.getpid()}"
+        self.session_dir = os.path.join(config.session_dir_root, self.session_name)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        shm_root = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
+        self.shm_dir = os.path.join(shm_root, "ray_tpu_" + self.session_name)
+        self.fallback_dir = config.spill_directory or os.path.join(self.session_dir, "spill")
+        config.dump(os.path.join(self.session_dir, "config.json"))
+
+        self.store_client = ObjectStoreClient(
+            self.shm_dir, self.fallback_dir, config.object_store_memory
+        )
+
+        if num_cpus is None:
+            num_cpus = os.cpu_count() or 1
+        if num_tpus is None:
+            from ray_tpu._private.accelerators import tpu as tpu_accel
+
+            num_tpus = tpu_accel.detect_chip_count()
+        total: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+            pod_type = None
+            try:
+                from ray_tpu._private.accelerators import tpu as tpu_accel
+
+                pod_type = tpu_accel.detect_pod_type()
+            except Exception:
+                pod_type = None
+            if pod_type:
+                # parity: reference plants `TPU-{pod}-head` on worker 0
+                # (python/ray/_private/accelerators/tpu.py:334)
+                total[f"TPU-{pod_type}-head"] = 1.0
+        total["memory"] = float(_detect_memory_bytes())
+        total["object_store_memory"] = float(config.object_store_memory)
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        self.head_node_id = NodeID.from_random()
+        head = NodeState(
+            node_id=self.head_node_id,
+            total=dict(total),
+            available=dict(total),
+            labels=dict(labels or {}),
+        )
+
+        self.scheduler = Scheduler(self, config)
+        self.scheduler.nodes[self.head_node_id] = head
+        self.scheduler.start()
+
+        self._config_blob = pickle.dumps(config)
+        self._ctx = _get_ctx()
+        atexit.register(self._atexit)
+        self._closed = False
+
+        if config.prestart_workers:
+            for _ in range(min(2, int(num_cpus))):
+                self.spawn_worker(self.head_node_id)
+
+    # -- virtual nodes (parity: cluster_utils.Cluster.add_node) -----------
+
+    def add_virtual_node(
+        self,
+        num_cpus: float = 1.0,
+        num_tpus: float = 0.0,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> NodeID:
+        total: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        nid = NodeID.from_random()
+        ns = NodeState(node_id=nid, total=dict(total), available=dict(total), labels=dict(labels or {}))
+        self.scheduler.post(("add_node", ns))
+        return nid
+
+    def remove_virtual_node(self, node_id: NodeID) -> None:
+        self.scheduler.post(("remove_node", node_id))
+
+    # -- workers -----------------------------------------------------------
+
+    def spawn_worker(self, node_id: NodeID) -> WorkerID:
+        from ray_tpu._private import worker_process
+
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        wid = WorkerID.from_random()
+        proc = self._ctx.Process(
+            target=worker_process.worker_main,
+            args=(child_conn, wid.binary(), self.shm_dir, self.fallback_dir, self._config_blob),
+            name=f"ray_tpu-worker-{wid.hex()[:8]}",
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        ws = WorkerState(worker_id=wid, conn=parent_conn, proc=proc, node_id=node_id)
+        self.scheduler.post(("worker_spawned", ws))
+        return wid
+
+    # -- shutdown ----------------------------------------------------------
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.scheduler.shutdown()
+        self.store_client.close()
+        destroy_store(self.shm_dir)
+        shutil.rmtree(self.fallback_dir, ignore_errors=True)
+
+    def _atexit(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+def _detect_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 * 1024**3

@@ -1,0 +1,219 @@
+"""Shared-memory object store (plasma equivalent).
+
+Design parity: the reference's plasma store (``src/ray/object_manager/plasma/``,
+``store.h:55``) is an mmap-arena + dlmalloc shared-memory store with sealed-object
+semantics, LRU eviction and fallback allocation to disk. Here every object is a
+file in ``/dev/shm/<session>/`` mapped with mmap:
+
+* ``create`` opens ``<hex>.building`` and maps it writable;
+* ``seal`` atomically renames to ``<hex>.obj`` — the rename is the cross-process
+  "sealed" visibility barrier (plasma uses a client notification protocol);
+* ``get`` maps ``<hex>.obj`` read-only, zero-copy;
+* fallback allocation: when /dev/shm is full, objects land in the session spill
+  dir on disk (same mmap interface) — mirroring plasma's fallback allocator.
+
+A per-process client tracks its open maps so deserialized numpy views stay
+valid until ``release``. Eviction (LRU over sealed, unpinned objects) is driven
+by the owner's reference counter, as in the reference (primary-copy pinning in
+``local_object_manager.h:41``).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+_HEADER = 16  # [u64 data_size][u64 flags]
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class ObjectStoreClient:
+    """Client handle to the shm store; safe to use from one process."""
+
+    def __init__(self, shm_dir: str, fallback_dir: str, capacity: int):
+        self._shm_dir = shm_dir
+        self._fallback_dir = fallback_dir
+        self._capacity = capacity
+        os.makedirs(shm_dir, exist_ok=True)
+        os.makedirs(fallback_dir, exist_ok=True)
+        # open maps: id -> (mmap, memoryview, writable)
+        self._maps: Dict[ObjectID, Tuple[mmap.mmap, memoryview, bool]] = {}
+        self._lock = threading.Lock()
+
+    # -- paths ------------------------------------------------------------
+
+    def _path(self, oid: ObjectID, sealed: bool, fallback: bool = False) -> str:
+        base = self._fallback_dir if fallback else self._shm_dir
+        return os.path.join(base, oid.hex() + (".obj" if sealed else ".building"))
+
+    def _find_sealed(self, oid: ObjectID) -> Optional[str]:
+        p = self._path(oid, True)
+        if os.path.exists(p):
+            return p
+        p = self._path(oid, True, fallback=True)
+        if os.path.exists(p):
+            return p
+        return None
+
+    # -- API --------------------------------------------------------------
+
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate a writable buffer of ``size`` bytes; returns the data view."""
+        total = _HEADER + size
+        fallback = False
+        path = self._path(oid, False)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, total)
+            except OSError:
+                os.close(fd)
+                os.unlink(path)
+                raise StoreFullError(f"shm full allocating {total} bytes")
+        except StoreFullError:
+            fallback = True
+            path = self._path(oid, False, fallback=True)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            os.ftruncate(fd, total)
+        except FileExistsError:
+            raise ValueError(f"object {oid.hex()} already being created")
+        m = mmap.mmap(fd, total)
+        os.close(fd)
+        mv = memoryview(m)
+        mv[:8] = size.to_bytes(8, "little")
+        mv[8:16] = (1 if fallback else 0).to_bytes(8, "little")
+        with self._lock:
+            self._maps[oid] = (m, mv, True)
+        return mv[_HEADER : _HEADER + size]
+
+    def seal(self, oid: ObjectID) -> None:
+        with self._lock:
+            entry = self._maps.get(oid)
+        if entry is None or not entry[2]:
+            raise ValueError(f"object {oid.hex()} not under creation by this client")
+        m, mv, _ = entry
+        fallback = int.from_bytes(mv[8:16], "little") == 1
+        src = self._path(oid, False, fallback)
+        dst = self._path(oid, True, fallback)
+        os.rename(src, dst)
+        with self._lock:
+            self._maps[oid] = (m, mv, False)
+
+    def put_bytes(self, oid: ObjectID, data: bytes) -> None:
+        buf = self.create(oid, len(data))
+        buf[:] = data
+        self.seal(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._find_sealed(oid) is not None
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
+        """Zero-copy read view of a sealed object; None on timeout."""
+        with self._lock:
+            entry = self._maps.get(oid)
+            if entry is not None and not entry[2]:
+                m, mv, _ = entry
+                size = int.from_bytes(mv[:8], "little")
+                return mv[_HEADER : _HEADER + size]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0001
+        while True:
+            path = self._find_sealed(oid)
+            if path is not None:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            return None  # evicted between stat and open
+        try:
+            total = os.fstat(fd).st_size
+            m = mmap.mmap(fd, total, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        mv = memoryview(m)
+        size = int.from_bytes(mv[:8], "little")
+        with self._lock:
+            self._maps[oid] = (m, mv, False)
+        return mv[_HEADER : _HEADER + size]
+
+    def release(self, oid: ObjectID) -> None:
+        """Drop this client's mapping (invalidates views)."""
+        with self._lock:
+            entry = self._maps.pop(oid, None)
+        if entry is not None:
+            m, mv, _ = entry
+            try:
+                mv.release()
+                m.close()
+            except BufferError:
+                # live numpy views still reference it; re-register so it is not lost
+                with self._lock:
+                    self._maps[oid] = entry
+
+    def delete(self, oid: ObjectID) -> None:
+        self.release(oid)
+        for sealed in (True, False):
+            for fallback in (False, True):
+                try:
+                    os.unlink(self._path(oid, sealed, fallback))
+                except FileNotFoundError:
+                    pass
+
+    def usage_bytes(self) -> int:
+        total = 0
+        for d in (self._shm_dir, self._fallback_dir):
+            try:
+                with os.scandir(d) as it:
+                    for e in it:
+                        try:
+                            total += e.stat().st_size
+                        except FileNotFoundError:
+                            pass
+            except FileNotFoundError:
+                pass
+        return total
+
+    def list_objects(self):
+        out = []
+        for d in (self._shm_dir, self._fallback_dir):
+            try:
+                with os.scandir(d) as it:
+                    for e in it:
+                        if e.name.endswith(".obj"):
+                            try:
+                                out.append(
+                                    (ObjectID.from_hex(e.name[:-4]), e.stat().st_size - _HEADER)
+                                )
+                            except (ValueError, FileNotFoundError):
+                                pass
+            except FileNotFoundError:
+                pass
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            maps, self._maps = self._maps, {}
+        for m, mv, _ in maps.values():
+            try:
+                mv.release()
+                m.close()
+            except BufferError:
+                pass
+
+
+def destroy_store(shm_dir: str) -> None:
+    import shutil
+
+    shutil.rmtree(shm_dir, ignore_errors=True)

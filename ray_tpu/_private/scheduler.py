@@ -1,0 +1,1115 @@
+"""Cluster scheduler + control plane (single-host runtime).
+
+Design parity: this module fuses the roles of the reference's GCS server
+(``src/ray/gcs/gcs_server/gcs_server.h:78`` — actor/node/job/PG/KV tables),
+raylet ClusterTaskManager/LocalTaskManager (``src/ray/raylet/scheduling/
+cluster_task_manager.cc:44``, ``local_task_manager.cc:74``), WorkerPool
+(``src/ray/raylet/worker_pool.h:83``) and the CoreWorker task manager's retry
+logic (``src/ray/core_worker/task_manager.h:208``) into one event loop thread
+in the driver process. Virtual nodes (à la ``python/ray/cluster_utils.py:135``)
+let multi-node scheduling policies be exercised on one machine; the multi-host
+control plane rides the same structures over sockets in a later layer.
+
+Scheduling policy is the reference's hybrid policy
+(``hybrid_scheduling_policy.cc:99``): prefer the local/driver node while it is
+feasible and below a load threshold, else spill to the best-scoring feasible
+node (top-k random to avoid herding).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import os
+import pickle
+import queue
+import random
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from multiprocessing import connection as mpc
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (
+    ActorID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# memory store (driver-side inline objects + readiness futures)
+# --------------------------------------------------------------------------
+
+
+class MemoryStore:
+    """In-process store for inline results and readiness signaling.
+
+    Parity: ``CoreWorkerMemoryStore`` (``src/ray/core_worker/store_provider/
+    memory_store/memory_store.h:43``) — holds small/direct returns, wakes
+    get/wait futures.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # oid -> ("inline", bytes) | ("stored",) | ("error", bytes)
+        self._table: Dict[ObjectID, Tuple] = {}
+
+    def put(self, oid: ObjectID, entry: Tuple) -> None:
+        with self._cv:
+            self._table[oid] = entry
+            self._cv.notify_all()
+
+    def get_entry(self, oid: ObjectID) -> Optional[Tuple]:
+        with self._lock:
+            return self._table.get(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._table
+
+    def wait_for(self, oids, timeout: Optional[float]) -> Set[ObjectID]:
+        """Block until all oids present or timeout; returns the ready set."""
+        oids = set(oids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = {o for o in oids if o in self._table}
+                if len(ready) == len(oids):
+                    return ready
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                self._cv.wait(remaining if remaining is not None else 1.0)
+
+    def wait_num(self, oids, num_returns: int, timeout: Optional[float]) -> List[ObjectID]:
+        """Block until >= num_returns of oids are present or timeout."""
+        oids = list(dict.fromkeys(oids))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in oids if o in self._table]
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                self._cv.wait(remaining if remaining is not None else 1.0)
+
+    def evict(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._table.pop(oid, None)
+
+
+# --------------------------------------------------------------------------
+# cluster state
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeState:
+    """Virtual node: resource ledger. Parity: ``NodeResources`` in
+    ``src/ray/common/scheduling/cluster_resource_data.h``."""
+
+    node_id: NodeID
+    total: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) >= v for k, v in demand.items())
+
+    def can_run(self, demand: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) >= v - 1e-9 for k, v in demand.items())
+
+    def acquire(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.available[k] = min(self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+
+    def utilization(self) -> float:
+        if not self.total:
+            return 0.0
+        fracs = [
+            1.0 - self.available.get(k, 0.0) / t for k, t in self.total.items() if t > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+
+@dataclass
+class WorkerState:
+    worker_id: WorkerID
+    conn: Any  # mp Connection
+    proc: Any  # mp Process
+    node_id: NodeID
+    state: str = "starting"  # starting|idle|busy|blocked|dead
+    current_task: Optional[TaskID] = None
+    acquired: Dict[str, float] = field(default_factory=dict)
+    acquired_node: Optional[NodeID] = None
+    actor_id: Optional[ActorID] = None
+    pg_reservation: Optional[Tuple[PlacementGroupID, int]] = None
+
+
+@dataclass
+class ActorState:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    worker_id: Optional[WorkerID] = None
+    state: str = "PENDING"  # PENDING|ALIVE|RESTARTING|DEAD
+    restarts_left: int = 0
+    name: Optional[str] = None
+    namespace: str = "default"
+    # method calls queued while (re)starting:
+    pending_calls: Deque[TaskSpec] = field(default_factory=collections.deque)
+    death_cause: Optional[str] = None
+    num_handles: int = 1
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING|WAITING_DEPS|SCHEDULED|RUNNING|FINISHED|FAILED
+    worker_id: Optional[WorkerID] = None
+    retries_left: int = 0
+    unresolved_deps: Set[ObjectID] = field(default_factory=set)
+    submit_time: float = field(default_factory=time.monotonic)
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+
+
+@dataclass
+class PlacementGroupState:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    # per-bundle: node placed on + remaining reservation
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    bundle_available: List[Dict[str, float]] = field(default_factory=list)
+    state: str = "PENDING"  # PENDING|CREATED|REMOVED
+    name: str = ""
+    ready_event: threading.Event = field(default_factory=threading.Event)
+
+
+# --------------------------------------------------------------------------
+# GCS tables (KV, named actors, jobs) — thread-safe, shared with driver
+# --------------------------------------------------------------------------
+
+
+class GcsTables:
+    """Parity: GcsKvManager / GcsActorManager name registry / GcsJobManager
+    (``src/ray/gcs/gcs_server/gcs_kv_manager.h``, ``gcs_actor_manager.h:278``,
+    ``gcs_job_manager.h:41``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.kv: Dict[Tuple[str, bytes], bytes] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+
+    def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and (ns, key) in self.kv:
+                return False
+            self.kv[(ns, key)] = value
+            return True
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get((ns, key))
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        with self._lock:
+            return self.kv.pop((ns, key), None) is not None
+
+    def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
+        with self._lock:
+            return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    def claim_actor_name(self, ns: str, name: str, actor_id: ActorID) -> bool:
+        """Atomically claim a name; False if already taken."""
+        with self._lock:
+            if (ns, name) in self.named_actors:
+                return False
+            self.named_actors[(ns, name)] = actor_id
+            return True
+
+
+# --------------------------------------------------------------------------
+# the scheduler event loop
+# --------------------------------------------------------------------------
+
+
+class Scheduler:
+    """Event-loop thread owning all cluster state; see module docstring."""
+
+    def __init__(self, node, config: Config):
+        self._node = node  # ray_tpu._private.node.Node
+        self.config = config
+        self.memory_store = MemoryStore()
+        self.gcs = GcsTables()
+
+        self._cmd_queue: queue.SimpleQueue = queue.SimpleQueue()
+        self._wakeup_r, self._wakeup_w = os.pipe()
+
+        self.nodes: Dict[NodeID, NodeState] = {}
+        self.workers: Dict[WorkerID, WorkerState] = {}
+        self.actors: Dict[ActorID, ActorState] = {}
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupState] = {}
+        self._pending: Deque[TaskID] = collections.deque()
+        self._dep_waiters: Dict[ObjectID, Set[TaskID]] = collections.defaultdict(set)
+        # worker pulls waiting on pending objects: oid -> [(worker_id, req_id)]
+        self._pull_waiters: Dict[ObjectID, List[Tuple[WorkerID, int]]] = collections.defaultdict(list)
+        self._conn_to_worker: Dict[Any, WorkerID] = {}
+        self._idle_by_node: Dict[NodeID, Deque[WorkerID]] = collections.defaultdict(collections.deque)
+        self._starting_count: Dict[NodeID, int] = collections.defaultdict(int)
+        # object ref counts (owner-side): oid -> count; deletion when 0
+        self._ref_counts: Dict[ObjectID, int] = collections.defaultdict(int)
+        self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
+        self._started = threading.Event()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+        self._started.wait(5)
+
+    def shutdown(self):
+        self.post(("shutdown",))
+        self._thread.join(timeout=10)
+
+    def post(self, cmd: Tuple) -> None:
+        """Thread-safe command injection into the loop."""
+        self._cmd_queue.put(cmd)
+        try:
+            os.write(self._wakeup_w, b"x")
+        except OSError:
+            pass
+
+    # ---- main loop -------------------------------------------------------
+
+    def _run(self):
+        self._started.set()
+        wake = self._wakeup_r
+        while not self._stop.is_set():
+            conns = list(self._conn_to_worker.keys())
+            try:
+                ready = mpc.wait(conns + [wake], timeout=0.2)
+            except OSError:
+                ready = []
+            for r in ready:
+                if r is wake:
+                    try:
+                        os.read(wake, 4096)
+                    except OSError:
+                        pass
+                else:
+                    self._drain_worker(r)
+            while True:
+                try:
+                    cmd = self._cmd_queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    self._handle_cmd(cmd)
+                except Exception:
+                    logger.exception("scheduler command failed: %r", cmd[0])
+            self._schedule()
+        self._shutdown_workers()
+
+    def _drain_worker(self, conn):
+        wid = self._conn_to_worker.get(conn)
+        if wid is None:
+            return
+        try:
+            while conn.poll(0):
+                msg = conn.recv()
+                self._handle_worker_msg(wid, msg)
+        except (EOFError, OSError, pickle.UnpicklingError):
+            self._on_worker_death(wid)
+
+    # ---- worker messages -------------------------------------------------
+
+    def _handle_worker_msg(self, wid: WorkerID, msg: Tuple):
+        kind = msg[0]
+        w = self.workers.get(wid)
+        if w is None:
+            return
+        if kind == "ready":
+            w.state = "idle"
+            self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
+            if w.actor_id is None:
+                self._idle_by_node[w.node_id].append(wid)
+        elif kind == "task_done":
+            _, task_id, results = msg
+            self._on_task_done(wid, task_id, results)
+        elif kind == "submit":
+            spec: TaskSpec = msg[1]
+            self.submit(spec)
+        elif kind == "pull":
+            _, req_id, oids = msg
+            self._handle_pull(wid, req_id, oids)
+        elif kind == "block_begin":
+            if w.state == "busy" and w.actor_id is None:
+                w.state = "blocked"
+                if w.acquired and w.acquired_node is not None:
+                    self._release_resources(w)
+        elif kind == "block_end":
+            if w.state == "blocked":
+                w.state = "busy"
+                # note: resources are NOT re-acquired (may oversubscribe while
+                # unblocking; matches the reference's blocked-worker behavior)
+        elif kind == "actor_exit":
+            # graceful actor termination (ray.kill / __ray_terminate__)
+            self._on_worker_death(wid, graceful=True)
+        elif kind == "submit_put":
+            self._commit_result(msg[1], ("stored",))
+        elif kind == "cmd":
+            self._handle_cmd(msg[1])
+        elif kind == "rpc":
+            _, req_id, op, args = msg
+            try:
+                result = self._serve_rpc(op, args)
+            except Exception as e:  # noqa: BLE001
+                result = e
+            try:
+                w.conn.send(("rpc_reply", req_id, result))
+            except (OSError, EOFError):
+                self._on_worker_death(wid)
+        elif kind == "generator_item":
+            _, task_id, index, entry = msg
+            # streaming generator item: task_id's return stream index -> object
+            oid = ObjectID.for_return(TaskID(task_id.binary()), index)
+            self._commit_result(oid, entry)
+        else:
+            logger.warning("unknown worker message: %r", kind)
+
+    def _handle_pull(self, wid: WorkerID, req_id: int, oids: List[ObjectID]):
+        w = self.workers[wid]
+        reply: Dict[ObjectID, Tuple] = {}
+        for oid in oids:
+            entry = self.memory_store.get_entry(oid)
+            if entry is not None:
+                reply[oid] = entry
+            else:
+                self._pull_waiters[oid].append((wid, req_id))
+                reply[oid] = ("pending",)
+        try:
+            w.conn.send(("pull_reply", req_id, reply))
+        except (OSError, EOFError):
+            self._on_worker_death(wid)
+
+    # ---- command handling ------------------------------------------------
+
+    def _handle_cmd(self, cmd: Tuple):
+        kind = cmd[0]
+        if kind == "submit":
+            self._on_submit(cmd[1])
+        elif kind == "put_done":
+            self._commit_result(cmd[1], cmd[2])
+        elif kind == "add_node":
+            node: NodeState = cmd[1]
+            self.nodes[node.node_id] = node
+            self._retry_pending_pgs()
+        elif kind == "remove_node":
+            self._on_remove_node(cmd[1])
+        elif kind == "worker_spawned":
+            _, wstate = cmd
+            self.workers[wstate.worker_id] = wstate
+            self._conn_to_worker[wstate.conn] = wstate.worker_id
+        elif kind == "kill_actor":
+            _, actor_id, no_restart = cmd
+            self._kill_actor(actor_id, no_restart)
+        elif kind == "handle_count":
+            _, actor_id, delta = cmd
+            st = self.actors.get(actor_id)
+            if st is not None:
+                st.num_handles += delta
+                # out-of-scope actors terminate like the reference's
+                # GcsActorManager handle tracking
+                if st.num_handles <= 0 and st.name is None and st.state != "DEAD":
+                    self._kill_actor(actor_id, no_restart=True)
+        elif kind == "create_pg":
+            self._create_pg(cmd[1])
+        elif kind == "remove_pg":
+            self._remove_pg(cmd[1])
+        elif kind == "add_ref":
+            for oid in cmd[1]:
+                self._ref_counts[oid] += 1
+        elif kind == "remove_ref":
+            self._unpin(cmd[1])
+        elif kind == "cancel":
+            self._cancel_task(cmd[1], force=cmd[2])
+        elif kind == "local_rpc":
+            _, op, args, event, box = cmd
+            try:
+                box["result"] = self._serve_rpc(op, args)
+            except Exception as e:  # noqa: BLE001
+                box["result"] = e
+            event.set()
+        elif kind == "shutdown":
+            self._stop.set()
+        else:
+            logger.warning("unknown scheduler command %r", kind)
+
+    # ---- submission & scheduling ----------------------------------------
+
+    def submit(self, spec: TaskSpec) -> None:
+        self.post(("submit", spec))
+
+    def _on_submit(self, spec: TaskSpec):
+        rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
+        self.tasks[spec.task_id] = rec
+        self._record_event(spec, "PENDING")
+        if spec.task_type == TaskType.ACTOR_CREATION:
+            st = ActorState(
+                actor_id=spec.actor_id,
+                creation_spec=spec,
+                restarts_left=spec.max_restarts,
+                name=spec.actor_name,
+                namespace=spec.namespace or "default",
+            )
+            self.actors[spec.actor_id] = st
+            if spec.actor_name:
+                self.gcs.claim_actor_name(st.namespace, spec.actor_name, spec.actor_id)
+        if spec.task_type == TaskType.ACTOR_TASK:
+            actor = self.actors.get(spec.actor_id)
+            if actor is None or actor.state == "DEAD":
+                reason = actor.death_cause if actor else "actor not found"
+                self._fail_task(
+                    rec, exc.ActorDiedError(spec.actor_id, reason or "actor died")
+                )
+                return
+        # dependency check
+        deps = self._unresolved_deps(spec)
+        if deps:
+            rec.state = "WAITING_DEPS"
+            rec.unresolved_deps = deps
+            for d in deps:
+                self._dep_waiters[d].add(spec.task_id)
+        else:
+            self._make_schedulable(rec)
+
+    def _unresolved_deps(self, spec: TaskSpec) -> Set[ObjectID]:
+        deps = set()
+        for a in itertools.chain(spec.args, spec.kwargs.values()):
+            if a.is_ref and a.object_id is not None:
+                if not self.memory_store.contains(a.object_id):
+                    deps.add(a.object_id)
+        return deps
+
+    def _make_schedulable(self, rec: TaskRecord):
+        rec.state = "PENDING"
+        if rec.spec.task_type == TaskType.ACTOR_TASK:
+            self._dispatch_actor_task(rec)
+        else:
+            self._pending.append(rec.spec.task_id)
+
+    def _schedule(self):
+        """Dispatch pending tasks to idle workers; spawn workers as needed.
+
+        Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
+        (``cluster_task_manager.cc:136``)."""
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                self._create_pg(pg)
+        if not self._pending:
+            return
+        deferred = []
+        while self._pending:
+            task_id = self._pending.popleft()
+            rec = self.tasks.get(task_id)
+            if rec is None or rec.state not in ("PENDING",):
+                continue
+            placed = self._try_dispatch(rec)
+            if not placed:
+                deferred.append(task_id)
+        self._pending.extend(deferred)
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
+        """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
+        demand = spec.resources
+        strat = spec.scheduling_strategy
+        alive = [n for n in self.nodes.values() if n.alive]
+        if strat.kind == "NODE_AFFINITY":
+            for n in alive:
+                if n.node_id.hex() == strat.node_id:
+                    if n.can_run(demand):
+                        return n
+                    return None if not strat.soft else self._pick_node_default(demand, alive)
+            return None if not strat.soft else self._pick_node_default(demand, alive)
+        if strat.kind == "SPREAD":
+            runnable = [n for n in alive if n.can_run(demand)]
+            if not runnable:
+                return None
+            return min(runnable, key=lambda n: n.utilization())
+        return self._pick_node_default(demand, alive)
+
+    def _pick_node_default(self, demand, alive) -> Optional[NodeState]:
+        local = self._node.head_node_id
+        runnable = [n for n in alive if n.can_run(demand)]
+        if not runnable:
+            return None
+        for n in runnable:
+            if n.node_id == local and n.utilization() < 0.9:
+                return n
+        k = max(1, int(len(runnable) * self.config.scheduler_top_k_fraction))
+        top = sorted(runnable, key=lambda n: n.utilization())[:k]
+        return random.choice(top)
+
+    def _try_dispatch(self, rec: TaskRecord) -> bool:
+        spec = rec.spec
+        strat = spec.scheduling_strategy
+        # placement-group capacity comes from the bundle reservation, not the node
+        if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
+            return self._try_dispatch_pg(rec)
+        node = self._pick_node(spec)
+        if node is None:
+            return False
+        wid = self._acquire_worker(node, spec)
+        if wid is None:
+            return False
+        node.acquire(spec.resources)
+        w = self.workers[wid]
+        w.acquired = dict(spec.resources)
+        w.acquired_node = node.node_id
+        self._send_exec(wid, rec)
+        return True
+
+    def _try_dispatch_pg(self, rec: TaskRecord) -> bool:
+        spec = rec.spec
+        pg = self.placement_groups.get(spec.scheduling_strategy.placement_group_id)
+        if pg is None or pg.state != "CREATED":
+            return False
+        idx = spec.scheduling_strategy.bundle_index
+        candidates = range(len(pg.bundles)) if idx == -1 else [idx]
+        for i in candidates:
+            avail = pg.bundle_available[i]
+            if all(avail.get(k, 0.0) >= v - 1e-9 for k, v in spec.resources.items()):
+                node = self.nodes[pg.bundle_nodes[i]]
+                wid = self._acquire_worker(node, spec)
+                if wid is None:
+                    return False
+                for k, v in spec.resources.items():
+                    avail[k] = avail.get(k, 0.0) - v
+                w = self.workers[wid]
+                w.acquired = dict(spec.resources)
+                w.acquired_node = None
+                w.pg_reservation = (pg.pg_id, i)
+                self._send_exec(wid, rec)
+                return True
+        return False
+
+    def _acquire_worker(self, node: NodeState, spec: TaskSpec) -> Optional[WorkerID]:
+        idle = self._idle_by_node[node.node_id]
+        while idle:
+            wid = idle.popleft()
+            w = self.workers.get(wid)
+            if w is not None and w.state == "idle":
+                w.state = "busy"
+                return wid
+        # spawn a new worker for this node (throttled, parity: WorkerPool
+        # starting-worker throttling)
+        if self._starting_count[node.node_id] < 4:
+            self._starting_count[node.node_id] += 1
+            self._node.spawn_worker(node.node_id)
+        return None
+
+    def _send_exec(self, wid: WorkerID, rec: TaskRecord):
+        w = self.workers[wid]
+        rec.state = "RUNNING"
+        rec.worker_id = wid
+        rec.start_time = time.monotonic()
+        w.current_task = rec.spec.task_id
+        if rec.spec.task_type == TaskType.ACTOR_CREATION:
+            actor = self.actors[rec.spec.actor_id]
+            actor.worker_id = wid
+            w.actor_id = rec.spec.actor_id
+        self._record_event(rec.spec, "RUNNING")
+        try:
+            w.conn.send(("exec", rec.spec))
+        except (OSError, EOFError):
+            self._on_worker_death(wid)
+
+    def _dispatch_actor_task(self, rec: TaskRecord):
+        actor = self.actors[rec.spec.actor_id]
+        if actor.state == "ALIVE" and actor.worker_id is not None:
+            w = self.workers.get(actor.worker_id)
+            if w is not None and w.state != "dead":
+                rec.state = "RUNNING"
+                rec.worker_id = actor.worker_id
+                rec.start_time = time.monotonic()
+                self._record_event(rec.spec, "RUNNING")
+                try:
+                    w.conn.send(("exec", rec.spec))
+                except (OSError, EOFError):
+                    self._on_worker_death(actor.worker_id)
+                return
+        if actor.state == "DEAD":
+            self._fail_task(rec, exc.ActorDiedError(actor.actor_id, actor.death_cause or "actor died"))
+        else:
+            actor.pending_calls.append(rec.spec)
+
+    # ---- completion ------------------------------------------------------
+
+    def _on_task_done(self, wid: WorkerID, task_id: TaskID, results: List[Tuple]):
+        w = self.workers[wid]
+        rec = self.tasks.get(task_id)
+        spec = rec.spec if rec else None
+        if rec is not None:
+            rec.state = "FINISHED"
+            rec.end_time = time.monotonic()
+            self._record_event(rec.spec, "FINISHED")
+        # commit each return
+        if spec is not None:
+            for i, entry in enumerate(results):
+                oid = ObjectID.for_return(spec.task_id, i)
+                self._commit_result(oid, entry)
+            # drop the submitted-task arg pins (actor-creation args stay pinned:
+            # a restart re-resolves them)
+            if spec.task_type != TaskType.ACTOR_CREATION:
+                self._unpin(spec.arg_ref_ids())
+        # actor lifecycle transitions
+        creation_failed = False
+        if spec is not None and spec.task_type == TaskType.ACTOR_CREATION:
+            actor = self.actors[spec.actor_id]
+            if results and results[0][0] == "error":
+                creation_failed = True
+                actor.state = "DEAD"
+                actor.death_cause = "actor __init__ failed"
+                self._drain_actor_queue(actor)
+            else:
+                actor.state = "ALIVE"
+                while actor.pending_calls:
+                    pending_spec = actor.pending_calls.popleft()
+                    prec = self.tasks[pending_spec.task_id]
+                    self._dispatch_actor_task(prec)
+        if creation_failed:
+            # reclaim the dedicated worker: release creation resources and
+            # terminate the process (it holds a broken actor instance)
+            w.current_task = None
+            self._release_resources(w)
+            try:
+                w.conn.send(("exit",))
+            except (OSError, EOFError):
+                pass
+            self._on_worker_death(wid, graceful=True)
+            return
+        # return worker to pool (actor workers stay dedicated)
+        if w.state in ("busy", "blocked") and (spec is None or spec.task_type != TaskType.ACTOR_TASK):
+            if spec is not None and spec.task_type == TaskType.ACTOR_CREATION:
+                # swap creation-demand resources for lifetime resources
+                self._downgrade_to_lifetime(w, spec)
+            else:
+                self._release_resources(w)
+                w.current_task = None
+                w.state = "idle"
+                self._idle_by_node[w.node_id].append(wid)
+        elif spec is not None and spec.task_type == TaskType.ACTOR_TASK:
+            w.current_task = None
+
+    def _unpin(self, oids):
+        for oid in oids:
+            self._ref_counts[oid] -= 1
+            if self._ref_counts[oid] <= 0:
+                self._ref_counts.pop(oid, None)
+                self._maybe_free(oid)
+
+    def _downgrade_to_lifetime(self, w: WorkerState, spec: TaskSpec):
+        lifetime = spec.lifetime_resources or {}
+        if w.pg_reservation is not None:
+            pg_id, i = w.pg_reservation
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg.state == "CREATED":
+                avail = pg.bundle_available[i]
+                for k, v in w.acquired.items():
+                    avail[k] = min(avail.get(k, 0.0) + v, pg.bundles[i].get(k, 0.0))
+                for k, v in lifetime.items():
+                    avail[k] = avail.get(k, 0.0) - v
+        elif w.acquired_node is not None:
+            node = self.nodes.get(w.acquired_node)
+            if node is not None:
+                node.release(w.acquired)
+                node.acquire(lifetime)
+        w.acquired = dict(lifetime)
+        w.current_task = None
+
+    def _release_resources(self, w: WorkerState):
+        if w.pg_reservation is not None:
+            pg_id, i = w.pg_reservation
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg.state == "CREATED":
+                avail = pg.bundle_available[i]
+                for k, v in w.acquired.items():
+                    avail[k] = min(avail.get(k, 0.0) + v, pg.bundles[i].get(k, 0.0))
+            w.pg_reservation = None
+        elif w.acquired and w.acquired_node is not None:
+            node = self.nodes.get(w.acquired_node)
+            if node is not None:
+                node.release(w.acquired)
+        w.acquired = {}
+        w.acquired_node = None
+
+    def _commit_result(self, oid: ObjectID, entry: Tuple):
+        self.memory_store.put(oid, entry)
+        # wake dependent tasks
+        for tid in self._dep_waiters.pop(oid, ()):  # type: ignore[arg-type]
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            rec.unresolved_deps.discard(oid)
+            if not rec.unresolved_deps and rec.state == "WAITING_DEPS":
+                self._make_schedulable(rec)
+        # wake worker pulls
+        for wid, req_id in self._pull_waiters.pop(oid, ()):  # type: ignore[arg-type]
+            w = self.workers.get(wid)
+            if w is not None and w.state != "dead":
+                try:
+                    w.conn.send(("pull_reply", req_id, {oid: entry}))
+                except (OSError, EOFError):
+                    self._on_worker_death(wid)
+
+    def _fail_task(self, rec: TaskRecord, error: Exception):
+        rec.state = "FAILED"
+        rec.end_time = time.monotonic()
+        self._record_event(rec.spec, "FAILED")
+        blob = pickle.dumps(error)
+        for oid in rec.spec.return_ids():
+            self._commit_result(oid, ("error", blob))
+        if rec.spec.task_type != TaskType.ACTOR_CREATION:
+            self._unpin(rec.spec.arg_ref_ids())
+
+    # ---- failure handling ------------------------------------------------
+
+    def _on_worker_death(self, wid: WorkerID, graceful: bool = False):
+        w = self.workers.get(wid)
+        if w is None or w.state == "dead":
+            return
+        w.state = "dead"
+        self._conn_to_worker.pop(w.conn, None)
+        try:
+            w.conn.close()
+        except OSError:
+            pass
+        self._release_resources(w)
+        try:
+            self._idle_by_node[w.node_id].remove(wid)
+        except ValueError:
+            pass
+        # fail/retry the running task
+        if w.current_task is not None:
+            rec = self.tasks.get(w.current_task)
+            if rec is not None and rec.state == "RUNNING":
+                if not graceful and rec.retries_left > 0 and rec.spec.task_type == TaskType.NORMAL_TASK:
+                    rec.retries_left -= 1
+                    rec.state = "PENDING"
+                    rec.worker_id = None
+                    self._pending.append(rec.spec.task_id)
+                elif not graceful:
+                    self._fail_task(
+                        rec,
+                        exc.WorkerCrashedError(
+                            f"worker died executing {rec.spec.name or rec.spec.task_id.hex()}"
+                        ),
+                    )
+        # actor death & restart (parity: GcsActorManager max_restarts,
+        # gcs_actor_manager.h:278)
+        if w.actor_id is not None:
+            actor = self.actors.get(w.actor_id)
+            if actor is not None and actor.state != "DEAD":
+                # fail all in-flight calls on this actor
+                for rec in list(self.tasks.values()):
+                    if (
+                        rec.spec.task_type == TaskType.ACTOR_TASK
+                        and rec.spec.actor_id == w.actor_id
+                        and rec.state == "RUNNING"
+                    ):
+                        self._fail_task(
+                            rec, exc.ActorDiedError(w.actor_id, "actor worker died")
+                        )
+                if graceful:
+                    actor.state = "DEAD"
+                    actor.death_cause = "actor exited"
+                    self._drain_actor_queue(actor)
+                elif actor.restarts_left != 0:
+                    if actor.restarts_left > 0:
+                        actor.restarts_left -= 1
+                    actor.state = "RESTARTING"
+                    actor.worker_id = None
+                    respec = actor.creation_spec
+                    rec = TaskRecord(spec=respec, retries_left=0)
+                    self.tasks[respec.task_id] = rec
+                    self._pending.append(respec.task_id)
+                else:
+                    actor.state = "DEAD"
+                    actor.death_cause = "actor worker died"
+                    self._drain_actor_queue(actor)
+        try:
+            if w.proc is not None:
+                w.proc.join(timeout=0)
+        except Exception:
+            pass
+
+    def _drain_actor_queue(self, actor: ActorState):
+        while actor.pending_calls:
+            spec = actor.pending_calls.popleft()
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                self._fail_task(
+                    rec, exc.ActorDiedError(actor.actor_id, actor.death_cause or "actor died")
+                )
+
+    def _kill_actor(self, actor_id: ActorID, no_restart: bool):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return
+        if no_restart:
+            actor.restarts_left = 0
+        if actor.name:
+            self.gcs.named_actors.pop((actor.namespace, actor.name), None)
+        if actor.worker_id is not None:
+            w = self.workers.get(actor.worker_id)
+            if w is not None and w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+                self._on_worker_death(actor.worker_id, graceful=no_restart)
+        if no_restart:
+            actor.state = "DEAD"
+            actor.death_cause = "killed via ray_tpu.kill"
+            self._drain_actor_queue(actor)
+
+    def _cancel_task(self, task_id: TaskID, force: bool):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return
+        if rec.state in ("PENDING", "WAITING_DEPS"):
+            self._fail_task(rec, exc.RayTpuError("task cancelled"))
+            try:
+                self._pending.remove(task_id)
+            except ValueError:
+                pass
+        elif rec.state == "RUNNING" and force and rec.worker_id is not None:
+            w = self.workers.get(rec.worker_id)
+            if w is not None and w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+
+    def _on_remove_node(self, node_id: NodeID):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.alive = False
+        for wid, w in list(self.workers.items()):
+            if w.node_id == node_id and w.state != "dead":
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                self._on_worker_death(wid)
+
+    # ---- placement groups (parity: GcsPlacementGroupManager 2PC,
+    # gcs_placement_group_manager.h:230) --------------------------------
+
+    def _create_pg(self, pg: PlacementGroupState):
+        self.placement_groups[pg.pg_id] = pg
+        nodes = [n for n in self.nodes.values() if n.alive]
+        placement = self._place_bundles(pg.bundles, pg.strategy, nodes)
+        if placement is None:
+            pg.state = "PENDING"  # infeasible now; retried when nodes change
+            return
+        # commit: reserve resources on chosen nodes
+        for i, node in enumerate(placement):
+            node.acquire(pg.bundles[i])
+        pg.bundle_nodes = [n.node_id for n in placement]
+        pg.bundle_available = [dict(b) for b in pg.bundles]
+        pg.state = "CREATED"
+        pg.ready_event.set()
+
+    def _place_bundles(
+        self, bundles, strategy, nodes: List[NodeState]
+    ) -> Optional[List[NodeState]]:
+        """Bundle placement policies: PACK/SPREAD/STRICT_* (parity:
+        ``bundle_scheduling_policy.cc``)."""
+        if strategy == "STRICT_PACK":
+            for n in nodes:
+                tot: Dict[str, float] = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        tot[k] = tot.get(k, 0.0) + v
+                if n.can_run(tot):
+                    return [n] * len(bundles)
+            return None
+        shadow = {n.node_id: dict(n.available) for n in nodes}
+
+        def fits(n, b):
+            av = shadow[n.node_id]
+            return all(av.get(k, 0.0) >= v - 1e-9 for k, v in b.items())
+
+        def take(n, b):
+            av = shadow[n.node_id]
+            for k, v in b.items():
+                av[k] = av.get(k, 0.0) - v
+
+        out: List[NodeState] = []
+        if strategy == "STRICT_SPREAD":
+            used: Set[NodeID] = set()
+            for b in bundles:
+                cand = [n for n in nodes if n.node_id not in used and fits(n, b)]
+                if not cand:
+                    return None
+                chosen = cand[0]
+                used.add(chosen.node_id)
+                take(chosen, b)
+                out.append(chosen)
+            return out
+        if strategy == "SPREAD":
+            order = sorted(nodes, key=lambda n: n.utilization())
+            i = 0
+            for b in bundles:
+                placedn = None
+                for j in range(len(order)):
+                    n = order[(i + j) % len(order)]
+                    if fits(n, b):
+                        placedn = n
+                        i += j + 1
+                        break
+                if placedn is None:
+                    return None
+                take(placedn, b)
+                out.append(placedn)
+            return out
+        # PACK (default): fewest nodes, first-fit-decreasing onto local first
+        order = sorted(
+            nodes, key=lambda n: (n.node_id != self._node.head_node_id, n.utilization())
+        )
+        for b in bundles:
+            placedn = None
+            for n in order:
+                if fits(n, b):
+                    placedn = n
+                    break
+            if placedn is None:
+                return None
+            take(placedn, b)
+            out.append(placedn)
+        return out
+
+    def _retry_pending_pgs(self):
+        """Re-attempt placement of PGs that were infeasible at creation
+        (parity: GcsPlacementGroupManager pending queue retry)."""
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                self._create_pg(pg)
+
+    def _remove_pg(self, pg_id: PlacementGroupID):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg.state == "REMOVED":
+            return
+        if pg.state == "CREATED":
+            for i, nid in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(nid)
+                if node is not None:
+                    # release what is not currently loaned to running tasks
+                    node.release(pg.bundle_available[i])
+        pg.state = "REMOVED"
+
+    # ---- rpc served to workers ------------------------------------------
+
+    def _serve_rpc(self, op: str, args):
+        if op == "kv_put":
+            return self.gcs.kv_put(*args)
+        if op == "kv_get":
+            return self.gcs.kv_get(*args)
+        if op == "kv_del":
+            return self.gcs.kv_del(*args)
+        if op == "kv_keys":
+            return self.gcs.kv_keys(*args)
+        if op == "get_actor_by_name":
+            ns, name = args
+            return self.gcs.named_actors.get((ns, name))
+        if op == "claim_actor_name":
+            return self.gcs.claim_actor_name(*args)
+        if op == "actor_state":
+            st = self.actors.get(args[0])
+            return None if st is None else st.state
+        if op == "object_ready":
+            return self.memory_store.contains(args[0])
+        if op == "pg_state":
+            pg = self.placement_groups.get(args[0])
+            return None if pg is None else pg.state
+        if op == "list_nodes":
+            return [
+                {
+                    "node_id": n.node_id.hex(),
+                    "alive": n.alive,
+                    "total": dict(n.total),
+                    "available": dict(n.available),
+                    "labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            ]
+        raise ValueError(f"unknown rpc {op}")
+
+    # ---- misc ------------------------------------------------------------
+
+    def _maybe_free(self, oid: ObjectID):
+        self.memory_store.evict(oid)
+        store = self._node.store_client
+        if store is not None and store.contains(oid):
+            store.delete(oid)
+
+    def _record_event(self, spec: TaskSpec, state: str):
+        self._task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "type": spec.task_type.name,
+                "state": state,
+                "time": time.time(),
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            }
+        )
+
+    def task_events(self) -> List[dict]:
+        return list(self._task_events)
+
+    def _shutdown_workers(self):
+        for w in self.workers.values():
+            if w.state != "dead":
+                try:
+                    w.conn.send(("exit",))
+                except (OSError, EOFError):
+                    pass
+        deadline = time.monotonic() + 2
+        for w in self.workers.values():
+            if w.proc is not None:
+                w.proc.join(timeout=max(0, deadline - time.monotonic()))
+                if w.proc.is_alive():
+                    w.proc.terminate()

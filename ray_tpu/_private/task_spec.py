@@ -1,0 +1,83 @@
+"""Wire-level task description and control messages.
+
+Design parity: ``TaskSpecification`` (``src/ray/common/task/``) — function
+descriptor, args (inline values or object refs), resource demand, scheduling
+strategy, retry policy; actor creation/call specs share the struct. Messages
+between driver/scheduler/workers are tagged tuples serialized with pickle over
+OS pipes (the reference uses gRPC protos; single-host transport here is a pipe,
+the multi-host transport rides the same structs).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class Arg:
+    """One task argument: exactly one of value/object_id set."""
+
+    value: Any = None
+    object_id: Optional[ObjectID] = None
+    is_ref: bool = False
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT | SPREAD | node-affinity | placement group bundle."""
+
+    kind: str = "DEFAULT"
+    node_id: Optional[str] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    function: Any  # pickled callable descriptor (bytes) or (module, name)
+    args: List[Arg]
+    kwargs: Dict[str, Arg]
+    num_returns: int
+    resources: Dict[str, float]
+    name: str = ""
+    actor_id: Optional[ActorID] = None
+    # actor creation only:
+    # resources held for the actor's lifetime (creation demand is `resources`;
+    # parity: Ray actors take 1 CPU to schedule, 0 while running unless
+    # explicitly requested)
+    lifetime_resources: Optional[Dict[str, float]] = None
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    # retries
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # scheduling
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[dict] = None
+    # streaming generator
+    is_streaming: bool = False
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def arg_ref_ids(self) -> List[ObjectID]:
+        return [
+            a.object_id
+            for a in list(self.args) + list(self.kwargs.values())
+            if a.is_ref and a.object_id is not None
+        ]

@@ -1,0 +1,343 @@
+"""Driver runtime and the global worker dispatch.
+
+Design parity: ``python/ray/_private/worker.py`` — the module-level
+``global_worker`` that ``ray.get/put/wait/remote`` route through, in driver
+mode (owns the cluster) or worker mode (connected via the task loop in
+``worker_process.py``). ObjectRef mirrors ``python/ray/includes/object_ref``:
+the future handle with owner-side reference counting
+(``src/ray/core_worker/reference_count.h:61`` — here: counts driver handles
+and in-flight task args; objects are freed when the count drops to zero).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, _Counter
+from ray_tpu._private.node import Node
+from ray_tpu._private.task_spec import Arg, TaskSpec, TaskType
+
+_global_lock = threading.RLock()
+_driver: Optional["DriverRuntime"] = None
+_worker_runtime = None  # set in worker processes
+
+
+def _set_worker_runtime(rt) -> None:
+    global _worker_runtime
+    _worker_runtime = rt
+
+
+def get_runtime():
+    """The active runtime: WorkerRuntime inside workers, DriverRuntime else."""
+    if _worker_runtime is not None:
+        return _worker_runtime
+    if _driver is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _driver
+
+
+def is_initialized() -> bool:
+    return _worker_runtime is not None or _driver is not None
+
+
+class ObjectRef:
+    """Handle to a (possibly pending) object. Parity: ``ray.ObjectRef``."""
+
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, oid: ObjectID, _owned: bool = False):
+        self._id = oid
+        self._owned = _owned
+        if _owned and _driver is not None:
+            _driver.add_refs([oid])
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # refs deserialize un-owned (borrower side does not count)
+        return (ObjectRef, (self._id,))
+
+    def __del__(self):
+        if self._owned and _driver is not None and not _driver.closed:
+            try:
+                _driver.remove_refs([self._id])
+            except Exception:
+                pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(get_runtime().get_objects([self._id])[0])
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, lambda: get_runtime().get_objects([self._id])[0])
+        return fut.__await__()
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming generator task's returns.
+
+    Parity: ``ObjectRefGenerator`` (``python/ray/_raylet.pyx:277``).
+    """
+
+    def __init__(self, task_id: TaskID, count_ref: ObjectRef):
+        self._task_id = task_id
+        self._count_ref = count_ref
+        self._index = 0
+        self._total: Optional[int] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        rt = get_runtime()
+        next_oid = ObjectID.for_return(self._task_id, self._index + 1)
+        while True:
+            if rt.object_ready(next_oid):
+                self._index += 1
+                return ObjectRef(next_oid)
+            if self._total is None and rt.object_ready(self._count_ref.id()):
+                self._total = rt.get_objects([self._count_ref.id()])[0]
+            if self._total is not None and self._index >= self._total:
+                raise StopIteration
+            time.sleep(0.001)
+
+
+class DriverRuntime:
+    """The driver-side CoreWorker equivalent."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.scheduler = node.scheduler
+        self.store = node.store_client
+        self.config = node.config
+        self.serde = serialization.get_context()
+        self.job_id = JobID.from_int(1)
+        self.task_id = TaskID.for_driver(self.job_id)
+        self._put_counter = _Counter()
+        self.closed = False
+
+    # -- refs --------------------------------------------------------------
+
+    def add_refs(self, oids):
+        self.scheduler.post(("add_ref", list(oids)))
+
+    def remove_refs(self, oids):
+        self.scheduler.post(("remove_ref", list(oids)))
+
+    # -- object plane ------------------------------------------------------
+
+    def put(self, value) -> ObjectID:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        oid = ObjectID.for_put(self.task_id, self._put_counter.next())
+        blob = self.serde.serialize_to_bytes(value)
+        self.store.put_bytes(oid, blob)
+        self.scheduler.memory_store.put(oid, ("stored",))
+        self.scheduler.post(("put_done", oid, ("stored",)))
+        return oid
+
+    def object_ready(self, oid: ObjectID) -> bool:
+        return self.scheduler.memory_store.contains(oid) or self.store.contains(oid)
+
+    def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        ms = self.scheduler.memory_store
+        missing = list(dict.fromkeys(o for o in oids if not ms.contains(o)))
+        if missing:
+            ready = ms.wait_for(missing, timeout)
+            if len(ready) < len(missing):
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {len(missing) - len(ready)} objects"
+                )
+        out = []
+        for oid in oids:
+            entry = ms.get_entry(oid)
+            val, is_err = self._entry_value(oid, entry)
+            if is_err:
+                raise val
+            out.append(val)
+        return out
+
+    def _entry_value(self, oid: ObjectID, entry: Tuple) -> Tuple[Any, bool]:
+        """Returns (value, is_error). Error-ness comes from the entry kind so
+        exception *values* stored by users round-trip as plain objects."""
+        kind = entry[0]
+        if kind == "inline":
+            return self.serde.deserialize_from(memoryview(entry[1])), False
+        if kind == "stored":
+            mv = self.store.get(oid, timeout=10.0)
+            if mv is None:
+                return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
+            return self.serde.deserialize_from(mv), False
+        if kind == "error":
+            err = pickle.loads(entry[1])
+            if isinstance(err, exc.TaskError):
+                return err.as_instanceof_cause(), True
+            return err, True
+        return exc.RayTpuError(f"bad entry {kind}"), True
+
+    def wait(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):
+        ms = self.scheduler.memory_store
+        ready = ms.wait_num(oids, num_returns, timeout)
+        ready_set = set(ready[:num_returns])
+        return (
+            [o for o in oids if o in ready_set],
+            [o for o in oids if o not in ready_set],
+        )
+
+    # -- task plane --------------------------------------------------------
+
+    def submit(self, spec: TaskSpec) -> None:
+        # pin ref args for the duration of the task (submitted-task references,
+        # parity: reference_count.h). add_ref is posted to the same command
+        # queue *before* submit, so a subsequent ObjectRef.__del__ remove_ref
+        # can never drop the count to zero while the task is in flight.
+        arg_refs = spec.arg_ref_ids()
+        if arg_refs:
+            self.scheduler.post(("add_ref", arg_refs))
+        self.scheduler.submit(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool):
+        self.scheduler.post(("kill_actor", actor_id, no_restart))
+
+    def actor_handle_count(self, actor_id: ActorID, delta: int):
+        self.scheduler.post(("handle_count", actor_id, delta))
+
+    def rpc(self, op: str, *args):
+        """Control-plane queries (same-process fast path)."""
+        return self.scheduler_rpc(op, args)
+
+    # ops backed by internally-locked tables, safe to call from this thread
+    _DIRECT_RPC = {
+        "kv_put",
+        "kv_get",
+        "kv_del",
+        "kv_keys",
+        "claim_actor_name",
+        "get_actor_by_name",
+        "object_ready",
+    }
+
+    def scheduler_rpc(self, op: str, args):
+        if op in self._DIRECT_RPC:
+            return self.scheduler._serve_rpc(op, args)
+        # everything else reads loop-owned state: serialize through the loop
+        event = threading.Event()
+        box: dict = {}
+        self.scheduler.post(("local_rpc", op, args, event, box))
+        if not event.wait(timeout=30):
+            raise exc.RayTpuError(f"scheduler rpc {op} timed out")
+        result = box["result"]
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def current_task_id(self) -> TaskID:
+        return self.task_id
+
+    def new_task_id(self) -> TaskID:
+        return TaskID.for_task(self.task_id.actor_id())
+
+    def shutdown(self):
+        self.closed = True
+        self.node.shutdown()
+
+
+# --------------------------------------------------------------------------
+# arg packing shared by remote_function / actor
+# --------------------------------------------------------------------------
+
+
+def pack_args(rt, args, kwargs) -> Tuple[List[Arg], Dict[str, Arg]]:
+    serde = serialization.get_context()
+    inline_limit = rt.config.max_direct_call_object_size
+
+    def pack(v) -> Arg:
+        if isinstance(v, ObjectRef):
+            return Arg(object_id=v.id(), is_ref=True)
+        blob = serde.serialize_to_bytes(v)
+        if len(blob) <= inline_limit:
+            return Arg(value=b"\x01" + blob)
+        oid = rt.put(v)
+        return Arg(object_id=oid, is_ref=True)
+
+    return [pack(a) for a in args], {k: pack(v) for k, v in (kwargs or {}).items()}
+
+
+# --------------------------------------------------------------------------
+# init / shutdown
+# --------------------------------------------------------------------------
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    labels: Optional[Dict[str, str]] = None,
+    ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
+    namespace: Optional[str] = None,
+    _system_config: Optional[dict] = None,
+) -> "DriverRuntime":
+    global _driver
+    with _global_lock:
+        if _driver is not None:
+            if ignore_reinit_error:
+                return _driver
+            raise RuntimeError("ray_tpu.init() called twice (pass ignore_reinit_error=True)")
+        cfg = Config.from_env(
+            object_store_memory=object_store_memory, **(_system_config or {})
+        )
+        node = Node(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels)
+        _driver = DriverRuntime(node)
+        return _driver
+
+
+def shutdown() -> None:
+    global _driver
+    with _global_lock:
+        if _driver is not None:
+            _driver.shutdown()
+            _driver = None
+
+
+def get_driver() -> Optional[DriverRuntime]:
+    return _driver

@@ -1,0 +1,361 @@
+"""Worker process main loop.
+
+Design parity: the reference worker = CoreWorker task execution path
+(``CoreWorker::ExecuteTask`` ``core_worker.cc:2906`` → Cython
+``task_execution_handler`` ``python/ray/_raylet.pyx:2218``): receive task,
+resolve args (inline / shm / pull from owner), execute user code, write returns
+(small inline in the reply, large to the shm store), loop. Actor workers keep
+instance state between tasks and execute calls in submission order (parity:
+``ActorSchedulingQueue``).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID, TaskID, WorkerID, _Counter
+from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError
+from ray_tpu._private.task_spec import Arg, TaskSpec, TaskType
+
+
+class WorkerRuntime:
+    """Per-worker runtime; installed as the global runtime inside workers so
+    ``ray_tpu.get/put/remote`` work from task code (nested tasks)."""
+
+    def __init__(self, conn, worker_id: WorkerID, store: ObjectStoreClient, config):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.store = store
+        self.config = config
+        self.serde = serialization.get_context()
+        self._inbox: collections.deque = collections.deque()
+        self._req_counter = _Counter()
+        self._actor_instance: Any = None
+        self._actor_id = None
+        self.current_task_id: Optional[TaskID] = None
+        self._put_counter = _Counter()
+        self._send_lock = threading.Lock()
+
+    # -- transport ---------------------------------------------------------
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _recv(self, want_kind: str, req_id: Optional[int] = None, timeout=None):
+        """Receive the next message of ``want_kind`` (matching req_id),
+        buffering anything else (e.g. queued actor calls) in the inbox."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else max(0, deadline - time.monotonic())
+            if not self.conn.poll(remaining if remaining is not None else 1.0):
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                continue
+            msg = self.conn.recv()
+            if msg[0] == want_kind and (req_id is None or msg[1] == req_id):
+                return msg
+            self._inbox.append(msg)
+
+    # -- object plane ------------------------------------------------------
+
+    def put(self, value) -> ObjectID:
+        tid = self.current_task_id or TaskID.nil()
+        oid = ObjectID.for_put(tid, self._put_counter.next())
+        blob = self.serde.serialize_to_bytes(value)
+        self.store.put_bytes(oid, blob)
+        self._send(("submit_put", oid))
+        return oid
+
+    def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        out: Dict[ObjectID, Any] = {}
+        errs: Dict[ObjectID, bool] = {}
+        missing = []
+        for oid in oids:
+            mv = self.store.get(oid, timeout=0)
+            if mv is not None:
+                out[oid] = self.serde.deserialize_from(mv)
+                errs[oid] = False
+            else:
+                missing.append(oid)
+        if missing:
+            self._send(("block_begin",))
+            try:
+                deadline = None if timeout is None else time.monotonic() + timeout
+                pending = set(missing)
+                while pending:
+                    req_id = self._req_counter.next()
+                    self._send(("pull", req_id, list(pending)))
+                    reply = self._recv("pull_reply", req_id)
+                    got_any = False
+                    for oid, entry in reply[2].items():
+                        if entry[0] == "pending":
+                            continue
+                        out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
+                        pending.discard(oid)
+                        got_any = True
+                    # a later pull_reply for a registered waiter may arrive
+                    while pending:
+                        mv = self.store.get(next(iter(pending)), timeout=0)
+                        if mv is None:
+                            break
+                        oid = next(iter(pending))
+                        out[oid] = self.serde.deserialize_from(mv)
+                        errs[oid] = False
+                        pending.discard(oid)
+                    if not pending:
+                        break
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise exc.GetTimeoutError(f"get timed out on {len(pending)} objects")
+                    if not got_any:
+                        msg = self._recv("pull_reply", None, timeout=0.2)
+                        if msg is not None:
+                            for oid, entry in msg[2].items():
+                                if oid in pending and entry[0] != "pending":
+                                    out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
+                                    pending.discard(oid)
+            finally:
+                self._send(("block_end",))
+        results = []
+        for oid in oids:
+            if errs.get(oid):
+                raise out[oid]
+            results.append(out[oid])
+        return results
+
+    def _entry_value(self, oid: ObjectID, entry: Tuple, timeout) -> Tuple[Any, bool]:
+        """Returns (value, is_error); error-ness from the entry kind only."""
+        kind = entry[0]
+        if kind == "inline":
+            return self.serde.deserialize_from(memoryview(entry[1])), False
+        if kind == "error":
+            err = pickle.loads(entry[1])
+            if isinstance(err, exc.TaskError):
+                return err.as_instanceof_cause(), True
+            return err, True
+        if kind == "stored":
+            mv = self.store.get(oid, timeout=timeout if timeout is not None else 60.0)
+            if mv is None:
+                return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
+            return self.serde.deserialize_from(mv), False
+        return exc.RayTpuError(f"bad entry {kind}"), True
+
+    def wait(self, oids, num_returns, timeout):
+        ready, not_ready = [], list(oids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            still = []
+            for oid in not_ready:
+                if self.store.contains(oid):
+                    ready.append(oid)
+                    continue
+                req_id = self._req_counter.next()
+                self._send(("pull", req_id, [oid]))
+                reply = self._recv("pull_reply", req_id)
+                if reply and reply[2][oid][0] != "pending":
+                    ready.append(oid)
+                else:
+                    still.append(oid)
+            not_ready = still
+            if len(ready) >= num_returns or not not_ready:
+                return ready[:num_returns], [o for o in oids if o not in ready[:num_returns]]
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, not_ready
+            time.sleep(0.005)
+
+    def submit(self, spec: TaskSpec):
+        arg_refs = spec.arg_ref_ids()
+        if arg_refs:
+            self._send(("cmd", ("add_ref", arg_refs)))
+        self._send(("submit", spec))
+
+    def rpc(self, op: str, *args):
+        req_id = self._req_counter.next()
+        self._send(("rpc", req_id, op, args))
+        reply = self._recv("rpc_reply", req_id)
+        result = reply[2]
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def object_ready(self, oid: ObjectID) -> bool:
+        return self.store.contains(oid) or bool(self.rpc("object_ready", oid))
+
+    def kill_actor(self, actor_id, no_restart: bool):
+        self._send(("cmd", ("kill_actor", actor_id, no_restart)))
+
+    def actor_handle_count(self, actor_id, delta: int):
+        self._send(("cmd", ("handle_count", actor_id, delta)))
+
+    def new_task_id(self) -> TaskID:
+        base = self.current_task_id or TaskID.nil()
+        return TaskID.for_task(base.actor_id())
+
+    def add_refs(self, oids):
+        self._send(("cmd", ("add_ref", list(oids))))
+
+    def remove_refs(self, oids):
+        self._send(("cmd", ("remove_ref", list(oids))))
+
+    # -- execution ---------------------------------------------------------
+
+    def _resolve_args(self, spec: TaskSpec):
+        ref_ids = [
+            a.object_id
+            for a in list(spec.args) + list(spec.kwargs.values())
+            if a.is_ref and a.object_id is not None
+        ]
+        values: Dict[ObjectID, Any] = {}
+        if ref_ids:
+            resolved = self.get_objects(ref_ids)
+            values = dict(zip(ref_ids, resolved))
+
+        def mat(a: Arg):
+            if a.is_ref:
+                return values[a.object_id]
+            if isinstance(a.value, bytes) and a.value[:1] == b"\x01":
+                return self.serde.deserialize_from(memoryview(a.value)[1:])
+            return a.value
+
+        args = [mat(a) for a in spec.args]
+        kwargs = {k: mat(a) for k, a in spec.kwargs.items()}
+        return args, kwargs
+
+    def _store_results(self, spec: TaskSpec, value: Any) -> List[Tuple]:
+        if spec.num_returns == 1:
+            values = [value]
+        elif spec.num_returns == 0:
+            values = []
+        else:
+            values = list(value)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(values)} values"
+                )
+        out = []
+        for i, v in enumerate(values):
+            blob = self.serde.serialize_to_bytes(v)
+            if len(blob) <= self.config.max_direct_call_object_size:
+                out.append(("inline", blob))
+            else:
+                oid = ObjectID.for_return(spec.task_id, i)
+                try:
+                    self.store.put_bytes(oid, blob)
+                    out.append(("stored",))
+                except StoreFullError:
+                    out.append(
+                        ("error", pickle.dumps(exc.ObjectStoreFullError(f"{len(blob)} bytes")))
+                    )
+        return out
+
+    def execute(self, spec: TaskSpec) -> List[Tuple]:
+        self.current_task_id = spec.task_id
+        try:
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                cls = cloudpickle.loads(spec.function)
+                args, kwargs = self._resolve_args(spec)
+                self._actor_instance = cls(*args, **kwargs)
+                self._actor_id = spec.actor_id
+                return [("inline", self.serde.serialize_to_bytes(None))]
+            if spec.task_type == TaskType.ACTOR_TASK:
+                method_name = cloudpickle.loads(spec.function)
+                args, kwargs = self._resolve_args(spec)
+                if method_name == "__ray_terminate__":
+                    self._send(("actor_exit",))
+                    sys.exit(0)
+                method = getattr(self._actor_instance, method_name)
+                result = method(*args, **kwargs)
+            else:
+                fn = cloudpickle.loads(spec.function)
+                args, kwargs = self._resolve_args(spec)
+                result = fn(*args, **kwargs)
+            if spec.is_streaming:
+                # streaming generator: report items as they are produced
+                # (parity: HandleReportGeneratorItemReturns, task_manager.h:355)
+                count = 0
+                for item in result:
+                    blob = self.serde.serialize_to_bytes(item)
+                    entry = (
+                        ("inline", blob)
+                        if len(blob) <= self.config.max_direct_call_object_size
+                        else ("stored",)
+                    )
+                    if entry[0] == "stored":
+                        self.store.put_bytes(ObjectID.for_return(spec.task_id, count + 1), blob)
+                    self._send(("generator_item", spec.task_id, count + 1, entry))
+                    count += 1
+                return [("inline", self.serde.serialize_to_bytes(count))]
+            return self._store_results(spec, result)
+        except SystemExit:
+            raise
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            if isinstance(e, exc.TaskError):
+                err = e  # error from an upstream dependency: propagate as-is
+            else:
+                err = exc.TaskError(
+                    spec.name or "task", tb, e if isinstance(e, Exception) else None
+                )
+            try:
+                blob = pickle.dumps(err)
+            except Exception:
+                err = exc.TaskError(spec.name or "task", tb, None)
+                blob = pickle.dumps(err)
+            return [("error", blob)] * max(1, spec.num_returns)
+        finally:
+            self.current_task_id = None
+
+
+def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, config_blob: bytes):
+    """Entry point for spawned worker processes."""
+    import ray_tpu._private.worker as worker_mod
+
+    config = pickle.loads(config_blob)
+    worker_id = WorkerID(worker_id_bin)
+    store = ObjectStoreClient(shm_dir, fallback_dir, config.object_store_memory)
+    rt = WorkerRuntime(conn, worker_id, store, config)
+    worker_mod._set_worker_runtime(rt)
+    conn.send(("ready",))
+    try:
+        while True:
+            if rt._inbox:
+                msg = rt._inbox.popleft()
+            else:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+            kind = msg[0]
+            if kind == "exec":
+                spec: TaskSpec = msg[1]
+                results = rt.execute(spec)
+                try:
+                    conn.send(("task_done", spec.task_id, results))
+                except (EOFError, OSError):
+                    break
+            elif kind == "exit":
+                break
+            elif kind == "pull_reply":
+                pass  # stale reply from a timed-out get; drop
+            else:
+                pass
+    except SystemExit:
+        pass
+    finally:
+        store.close()
+        try:
+            conn.close()
+        except OSError:
+            pass

@@ -1,0 +1,104 @@
+"""User-facing exception types.
+
+Design parity: ``python/ray/exceptions.py`` — RayError hierarchy (RayTaskError
+wrapping the remote traceback, RayActorError, ObjectLostError, OOM, timeouts).
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception; carries the remote traceback.
+
+    Mirrors ``RayTaskError`` (python/ray/exceptions.py): re-raised at
+    ``get()`` with cause chained to the user's original exception.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+
+    def as_instanceof_cause(self):
+        """Return an exception that is both a TaskError and the cause's type."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if cause_cls in (TaskError, ActorDiedError):
+            return self
+        try:
+            class _Wrapped(TaskError, cause_cls):  # noqa: N801
+                def __init__(self, inner):
+                    self._inner = inner
+                    TaskError.__init__(
+                        self, inner.function_name, inner.traceback_str, inner.cause
+                    )
+
+                def __str__(self):
+                    return TaskError.__str__(self._inner)
+
+                def __reduce__(self):
+                    return (_rebuild_task_error, (self.function_name, self.traceback_str, self.cause))
+
+            _Wrapped.__name__ = cause_cls.__name__
+            _Wrapped.__qualname__ = cause_cls.__qualname__
+            return _Wrapped(self)
+        except TypeError:
+            return self
+
+
+def _rebuild_task_error(function_name, traceback_str, cause):
+    return TaskError(function_name, traceback_str, cause).as_instanceof_cause()
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead; pending and future calls fail with this."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get()`` exceeded its timeout."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Task/actor was killed by the memory monitor."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The object store is full and nothing could be evicted/spilled."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Creating the runtime environment for a task/actor failed."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Back-pressure limit on an actor's pending call queue was reached."""
+
+
+class CrossSliceTransferError(RayTpuError):
+    """A device-to-device transfer across TPU slices failed (DCN path)."""

@@ -1,0 +1,59 @@
+"""Test fixtures.
+
+Parity: ``python/ray/tests/conftest.py`` (``ray_start_regular:419``,
+``ray_start_cluster:500``). TPU tests run on a virtual 8-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``), the JAX analogue of
+the reference's fake-GPU configs (SURVEY.md §4). The environment may pin
+``JAX_PLATFORMS`` to a real TPU plugin before we run, so we override both the
+env (for spawned worker processes) and the live jax config (this process).
+"""
+
+import os
+
+# Env first: worker processes and any not-yet-initialized jax in this process
+# inherit these. Force-set (not setdefault): the surrounding environment may
+# pin JAX_PLATFORMS to a hardware plugin.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The interpreter may have imported jax already (site customization); update
+# the live config too. Backends must not be initialized yet at conftest time.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

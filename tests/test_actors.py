@@ -1,0 +1,167 @@
+"""Actor tests: lifecycle, ordering, restart, named actors.
+
+Test strategy parity: ``python/ray/tests/test_actor*.py`` (SURVEY.md §4).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, k=1):
+        self.v += k
+        return self.v
+
+    def value(self):
+        return self.v
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(10)) == 11
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_call_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_init_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    b = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=30)
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Erratic:
+        def boom(self):
+            raise KeyError("nope")
+
+        def fine(self):
+            return "ok"
+
+    e = Erratic.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(e.boom.remote())
+    # actor survives a user exception
+    assert ray_tpu.get(e.fine.remote()) == "ok"
+
+
+def test_actor_death_and_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def __init__(self):
+            self.n = 0
+
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == 1
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(f.die.remote(), timeout=30)
+    # state reset after restart
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(f.ping.remote(), timeout=30) == 1
+            break
+        except exc.ActorDiedError:
+            time.sleep(0.1)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_no_restart_stays_dead(ray_start_regular):
+    @ray_tpu.remote
+    class Once:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    o = Once.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(o.die.remote(), timeout=30)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(o.ping.remote(), timeout=30)
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="global_counter").remote()
+    ray_tpu.get(c.inc.remote())
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.inc.remote()) == 2
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.inc.remote())
+
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 1
+    assert ray_tpu.get(c.value.remote()) == 1
+
+
+def test_actor_ref_arg(ray_start_regular):
+    c = Counter.remote()
+    ref = ray_tpu.put(5)
+    assert ray_tpu.get(c.inc.remote(ref)) == 5
+
+
+def test_many_actors(ray_start_regular):
+    # actors consume 0 CPU while idle -> more actors than cores
+    counters = [Counter.remote() for _ in range(8)]
+    out = ray_tpu.get([c.inc.remote() for c in counters], timeout=120)
+    assert out == [1] * 8

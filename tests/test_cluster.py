@@ -1,0 +1,136 @@
+"""Virtual multi-node cluster tests: scheduling policies, PGs, node failure.
+
+Test strategy parity: ``python/ray/tests/test_scheduling*.py``,
+``test_placement_group*.py``, chaos killers (SURVEY.md §4 item 3).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_custom_resource_routing(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"special": 2})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"special": 1})
+    def f():
+        return "routed"
+
+    assert ray_tpu.get(f.remote(), timeout=60) == "routed"
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    strat = NodeAffinitySchedulingStrategy(node_id=node.hex)
+    assert ray_tpu.get(f.options(scheduling_strategy=strat).remote(), timeout=60) == 1
+
+
+def test_infeasible_task_waits(ray_start_cluster):
+    @ray_tpu.remote(resources={"nonexistent": 1})
+    def f():
+        return 1
+
+    ref = f.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+    assert ready == []
+
+
+def test_pg_strict_spread_needs_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(0.5)  # only one node
+    cluster.add_node(num_cpus=2)
+    deadline = time.monotonic() + 10
+    # PENDING PGs retry when nodes change: re-create for now
+    pg2 = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg2.wait(10)
+
+
+def test_pg_pack_and_task(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return "ok"
+
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg, placement_group_bundle_index=0)
+    assert ray_tpu.get(f.options(scheduling_strategy=strat).remote(), timeout=60) == "ok"
+    table = placement_group_table()
+    assert any(v["state"] == "CREATED" for v in table.values())
+    remove_placement_group(pg)
+
+
+def test_pg_gang_actors(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    class W:
+        def ping(self):
+            return "pong"
+
+    actors = [
+        W.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(2)
+    ]
+    assert ray_tpu.get([a.ping.remote() for a in actors], timeout=60) == ["pong", "pong"]
+
+
+def test_node_failure_task_retry(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=1, resources={"doomed": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"doomed": 1}, max_retries=0)
+    def stuck():
+        time.sleep(60)
+        return 1
+
+    ref = stuck.remote()
+    # let it get scheduled onto the doomed node, then kill the node
+    time.sleep(1.0)
+    cluster.remove_node(node)
+    with pytest.raises((exc.WorkerCrashedError, exc.TaskError)):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_spread_strategy(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def f():
+        time.sleep(0.2)
+        return 1
+
+    assert sum(ray_tpu.get([f.remote() for _ in range(4)], timeout=120)) == 4

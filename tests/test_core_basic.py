@@ -1,0 +1,207 @@
+"""Core API tests: tasks, objects, errors.
+
+Test strategy parity: ``python/ray/tests/test_basic.py`` family (SURVEY.md §4).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(500_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_put_objectref_rejected(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1, 2)) == 3
+
+
+def test_task_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    a = double.remote(5)
+    b = double.remote(a)
+    c = double.remote(b)
+    assert ray_tpu.get(c) == 40
+
+
+def test_task_large_arg_roundtrip(ray_start_regular):
+    arr = np.ones((1000, 200), dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == 200_000.0
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    ref = boom.remote()
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(ref)
+    # also a TaskError
+    with pytest.raises(exc.TaskError):
+        ray_tpu.get(ref)
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(1), timeout=60) == 20
+
+
+def test_deeply_nested(ray_start_regular):
+    @ray_tpu.remote
+    def fib(n):
+        if n < 2:
+            return n
+        return sum(ray_tpu.get([fib.remote(n - 1), fib.remote(n - 2)]))
+
+    assert ray_tpu.get(fib.remote(6), timeout=120) == 8
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.01)
+    never = slow.remote(30)
+    ready, not_ready = ray_tpu.wait([fast, never], num_returns=1, timeout=10)
+    assert ready == [fast]
+    assert not_ready == [never]
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.2)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_options_name_and_retries(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom", max_retries=0).remote()) == 1
+
+
+def test_streaming_generator(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(r) for r in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_remote_lambda_closure(ray_start_regular):
+    factor = 7
+    f = ray_tpu.remote(lambda x: x * factor)
+    assert ray_tpu.get(f.remote(6)) == 42
+
+
+def test_cluster_and_available_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_timeline_events(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    events = ray_tpu.timeline()
+    assert any(e["args"]["state"] == "FINISHED" for e in events)
+
+
+def test_direct_call_rejected(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()

@@ -1,0 +1,93 @@
+"""Regression tests for review findings on the core runtime."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_get_duplicate_refs(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.2)
+        return 7
+
+    r = f.remote()
+    assert ray_tpu.get([r, r, r], timeout=60) == [7, 7, 7]
+
+
+def test_exception_value_roundtrip(ray_start_regular):
+    err = ValueError("stored, not raised")
+    ref = ray_tpu.put(err)
+    out = ray_tpu.get(ref)
+    assert isinstance(out, ValueError)
+    assert str(out) == "stored, not raised"
+
+
+def test_task_returning_exception_object(ray_start_regular):
+    @ray_tpu.remote
+    def collect():
+        return [KeyError("a"), 42]
+
+    errs = ray_tpu.get(collect.remote(), timeout=60)
+    assert isinstance(errs[0], KeyError)
+    assert errs[1] == 42
+
+
+def test_arg_pinned_after_driver_ref_dropped(ray_start_regular):
+    import numpy as np
+
+    @ray_tpu.remote
+    def total(x, delay):
+        time.sleep(delay)
+        return float(x.sum())
+
+    big = np.ones(300_000, dtype=np.float64)  # large enough to live in shm
+    ref = ray_tpu.put(big)
+    result = total.remote(ref, 0.5)
+    del ref  # must not free the object out from under the running task
+    assert ray_tpu.get(result, timeout=60) == 300_000.0
+
+
+def test_failed_actor_init_releases_resources(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("nope")
+
+        def ping(self):
+            return 1
+
+    handles = [Bad.remote() for _ in range(4)]  # would exhaust all 4 CPUs if leaked
+    for h in handles:
+        with pytest.raises(Exception):
+            ray_tpu.get(h.ping.remote(), timeout=60)
+
+    @ray_tpu.remote
+    def still_works():
+        return "yes"
+
+    assert ray_tpu.get(still_works.remote(), timeout=60) == "yes"
+
+
+def test_pending_pg_created_after_node_added(ray_start_cluster):
+    from ray_tpu.util.placement_group import placement_group
+
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(0.3)  # only one node: infeasible
+    cluster.add_node(num_cpus=2)
+    assert pg.wait(10)  # retried once the node joined
+
+
+def test_actor_method_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "a", "b"
+
+    s = Splitter.remote()
+    r1, r2 = s.pair.remote()
+    assert ray_tpu.get([r1, r2], timeout=60) == ["a", "b"]
