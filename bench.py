@@ -1,0 +1,131 @@
+"""Benchmark: flagship LM training-step MFU on the attached TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The baseline is BASELINE.json's north-star target of 35% MFU for GPT-J-style
+fine-tuning on v5e (the reference publishes no number for this workload —
+BASELINE.md "North-star targets"); vs_baseline = achieved_MFU / 0.35.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak dense bf16 FLOP/s (public spec sheets)
+    "v4": 275e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal, only so the script degrades gracefully
+}
+
+
+def _detect_peak(backend: str, device_kind: str) -> float:
+    kind = device_kind.lower()
+    if backend != "tpu":
+        return PEAK_BF16_FLOPS["cpu"]
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind.replace(" ", "").replace("lite", "litepod"):
+            return val
+    if "v5" in kind:
+        return PEAK_BF16_FLOPS["v5e"]
+    return PEAK_BF16_FLOPS["v5e"]
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.spmd import build_lm_train_step
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+
+    # ~1B-param GPT-J-architecture model: honest MFU on one chip while
+    # params + fp32 adam moments (~10 GB) still fit 16G HBM
+    if backend == "tpu":
+        cfg = TransformerConfig(
+            vocab_size=50432,
+            d_model=2048,
+            n_layers=16,
+            n_heads=16,
+            d_ff=8192,
+            max_seq_len=1024,
+            parallel_block=True,
+            use_swiglu=False,
+        )
+        batch, seq, steps = 16, 1024, 10
+    else:  # CPU fallback so the script always emits its line
+        cfg = TransformerConfig(
+            vocab_size=1024,
+            d_model=256,
+            n_layers=4,
+            n_heads=8,
+            d_ff=1024,
+            max_seq_len=256,
+            parallel_block=True,
+            use_swiglu=False,
+            remat=False,
+        )
+        batch, seq, steps = 4, 256, 3
+
+    mesh = create_mesh(MeshConfig(data=n_dev))
+    bundle = build_lm_train_step(cfg, mesh, learning_rate=1e-4)
+    state = bundle.init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size - 1, (batch, seq), dtype=np.int32)
+    targets = np.roll(tokens, -1, axis=1)
+    tok, tgt = bundle.shard_batch(tokens, targets)
+
+    # warmup (compile); sync via device_get — block_until_ready can return
+    # early on relayed/experimental PJRT backends
+    state, metrics = bundle.step_fn(state, tok, tgt)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = bundle.step_fn(state, tok, tgt)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    n_params = cfg.num_params()
+    tokens_per_step = batch * seq
+    # fwd+bwd ~= 6 * N FLOPs/token; remat re-runs fwd -> ~8 * N.
+    # MFU convention counts the useful 6N (hardware utilization incl. remat
+    # would be higher); report the conservative number.
+    model_flops_per_step = 6 * n_params * tokens_per_step
+    steps_per_sec = steps / dt
+    tokens_per_sec = tokens_per_step * steps_per_sec
+    achieved = model_flops_per_step * steps_per_sec
+    peak = _detect_peak(backend, device_kind) * n_dev
+    mfu = achieved / peak
+
+    result = {
+        "metric": "gptj_style_1b_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "detail": {
+            "backend": backend,
+            "device_kind": device_kind,
+            "n_devices": n_dev,
+            "n_params": n_params,
+            "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 1),
+            "step_time_ms": round(1000 * dt / steps, 2),
+            "loss": final_loss,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

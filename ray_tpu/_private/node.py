@@ -28,6 +28,18 @@ _mp_ctx = None
 def _get_ctx():
     global _mp_ctx
     if _mp_ctx is None:
+        # multiprocessing child prep re-imports the driver's __main__; when the
+        # driver is stdin/exec ("<stdin>", "<string>") that import crashes every
+        # worker at boot — drop the bogus path so prep skips it
+        import sys
+
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        if main_file and main_file.startswith("<"):
+            try:
+                del main_mod.__file__
+            except AttributeError:
+                pass
         method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
         _mp_ctx = mp.get_context(method)
         if method == "forkserver":

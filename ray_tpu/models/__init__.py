@@ -1,0 +1,22 @@
+"""Model zoo: decoder-only LMs (GPT-J/Llama families), MNIST nets, MoE.
+
+These play the role of the reference's example/benchmark workloads
+(``release/train_tests``, ``rllib/tuned_examples``) but are first-class here:
+every model declares logical sharding axes so it runs under any mesh.
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_logical_axes",
+]

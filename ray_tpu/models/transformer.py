@@ -1,0 +1,264 @@
+"""Decoder-only transformer LM, TPU-first.
+
+Design choices for the MXU/XLA (SURVEY.md §7, BASELINE.md north-star GPT-J):
+
+* params are a flat dict of stacked per-layer arrays scanned with
+  ``jax.lax.scan`` — one compiled block body regardless of depth;
+* every parameter has a logical-axes tuple (``param_logical_axes``) consumed
+  by ``ray_tpu.parallel.sharding`` so DP/FSDP/TP/CP are pure annotation
+  changes;
+* bfloat16 activations/weights with fp32 norm/softmax accumulation;
+* GPT-J-style *parallel* attention+MLP block (``parallel_block=True``) or
+  Llama-style sequential block; RoPE positions are explicit so context
+  parallelism can feed absolute positions per shard;
+* attention dispatches to the Pallas flash kernel on TPU, or ring attention
+  when a ``context`` axis is active (``context_axis`` argument).
+
+Config presets cover the benchmark models named in BASELINE.json: GPT-J-6B
+(fine-tune target) and Llama-2-7B (serve target), plus tiny variants for CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention, ring_attention
+from ray_tpu.ops.layers import apply_rope, gelu, rms_norm, rope_frequencies, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None  # None = MHA
+    d_ff: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    parallel_block: bool = False  # True = GPT-J style
+    use_swiglu: bool = True  # False = gelu MLP (GPT-J)
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = True  # jax.checkpoint each block (HBM <-> FLOPs trade)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    def num_params(self) -> int:
+        p = self.vocab_size * self.d_model  # embed
+        if not self.tie_embeddings:
+            p += self.vocab_size * self.d_model
+        per_layer = (
+            self.d_model * self.n_heads * self.head_dim  # wq
+            + 2 * self.d_model * self.kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * self.d_model  # wo
+            + (3 if self.use_swiglu else 2) * self.d_model * self.d_ff
+            + 2 * self.d_model  # norms
+        )
+        return p + self.n_layers * per_layer + self.d_model
+
+
+# -- presets (shapes match the public model cards; cited for parity with
+# BASELINE.json configs, not copied code) -----------------------------------
+
+GPTJ_6B = TransformerConfig(
+    vocab_size=50400,
+    d_model=4096,
+    n_layers=28,
+    n_heads=16,
+    d_ff=16384,
+    max_seq_len=2048,
+    parallel_block=True,
+    use_swiglu=False,
+    tie_embeddings=False,
+)
+
+LLAMA2_7B = TransformerConfig(
+    vocab_size=32000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    d_ff=11008,
+    max_seq_len=4096,
+)
+
+TINY = TransformerConfig(
+    vocab_size=256,
+    d_model=128,
+    n_layers=2,
+    n_heads=4,
+    d_ff=512,
+    max_seq_len=128,
+    remat=False,
+)
+
+
+def _init(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, jax.Array]:
+    """Stacked-layer parameter dict."""
+    keys = jax.random.split(key, 10)
+    L, D, H, KV, Hd, F = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+    )
+    dt = cfg.dtype
+    s_in = 1.0 / math.sqrt(D)
+    s_ff = 1.0 / math.sqrt(F)
+    params = {
+        "embed": _init(keys[0], (cfg.vocab_size, D), 0.02, dt),
+        "wq": _init(keys[1], (L, D, H, Hd), s_in, dt),
+        "wk": _init(keys[2], (L, D, KV, Hd), s_in, dt),
+        "wv": _init(keys[3], (L, D, KV, Hd), s_in, dt),
+        "wo": _init(keys[4], (L, H, Hd, D), s_in / math.sqrt(2 * L), dt),
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+        "w_up": _init(keys[5], (L, D, F), s_in, dt),
+        "w_down": _init(keys[6], (L, F, D), s_ff / math.sqrt(2 * L), dt),
+        "final_norm": jnp.ones((D,), jnp.float32),
+    }
+    if cfg.use_swiglu:
+        params["w_gate"] = _init(keys[7], (L, D, F), s_in, dt)
+    if not cfg.tie_embeddings:
+        params["unembed"] = _init(keys[8], (D, cfg.vocab_size), s_in, dt)
+    return params
+
+
+def param_logical_axes(cfg: TransformerConfig) -> Dict[str, Tuple]:
+    """Logical sharding axes per parameter (see parallel/sharding.py rules)."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "attn_norm": ("layers", "norm"),
+        "mlp_norm": ("layers", "norm"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "final_norm": ("norm",),
+    }
+    if cfg.use_swiglu:
+        axes["w_gate"] = ("layers", "embed", "mlp")
+    if not cfg.tie_embeddings:
+        axes["unembed"] = ("embed", "vocab")
+    return axes
+
+
+def _block(cfg: TransformerConfig, x, layer, cos, sin, positions, context_axis, mesh):
+    """One transformer block. x: (B, S, D)."""
+    h = rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    if context_axis is not None:
+        # partial-manual shard_map: only the context axis goes manual (ring
+        # ppermute over ICI); batch/model axes stay under GSPMD
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, context_axis, None, None)
+        att = jax.shard_map(
+            functools.partial(ring_attention, axis_name=context_axis, causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={context_axis},
+        )(q, k, v)
+    else:
+        att = attention(q, k, v, causal=True)
+    att_out = jnp.einsum("bshk,hkd->bsd", att, layer["wo"])
+
+    if cfg.parallel_block:
+        # GPT-J: MLP reads the same normed input; both branches add to residual
+        m = h
+    else:
+        x = x + att_out
+        m = rms_norm(x, layer["mlp_norm"])
+    if cfg.use_swiglu:
+        ff = swiglu(
+            jnp.einsum("bsd,df->bsf", m, layer["w_gate"]),
+            jnp.einsum("bsd,df->bsf", m, layer["w_up"]),
+        )
+    else:
+        ff = gelu(jnp.einsum("bsd,df->bsf", m, layer["w_up"]))
+    mlp_out = jnp.einsum("bsf,fd->bsd", ff, layer["w_down"])
+    if cfg.parallel_block:
+        return x + att_out + mlp_out
+    return x + mlp_out
+
+
+def forward(
+    params: Dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    context_axis: Optional[str] = None,
+    mesh=None,
+) -> jax.Array:
+    """tokens (B, S) -> logits (B, S, vocab). With ``context_axis`` (+``mesh``)
+    attention runs as a ring over that axis; ``positions`` must then be the
+    absolute token positions of this shard's slice of the sequence."""
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    stacked = {
+        k: v for k, v in params.items() if k not in ("embed", "unembed", "final_norm")
+    }
+
+    def body(x, layer):
+        out = _block(cfg, x, layer, cos, sin, positions, context_axis, mesh)
+        return out, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, stacked)
+    x = rms_norm(x, params["final_norm"])
+    unembed = params.get("unembed")
+    if unembed is None:
+        unembed = params["embed"].T
+    return jnp.einsum("bsd,dv->bsv", x, unembed)
+
+
+def loss_fn(
+    params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    positions=None,
+    context_axis=None,
+    mesh=None,
+    loss_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean next-token cross-entropy in fp32."""
+    logits = forward(
+        params, tokens, cfg, positions=positions, context_axis=context_axis, mesh=mesh
+    ).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if loss_mask is not None:
+        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.mean(nll)

@@ -1,0 +1,11 @@
+"""TPU compute ops: attention (flash/ring), norms, rotary embeddings.
+
+The reference has no equivalent layer (it delegates kernels to torch); these
+ops exist because long-context and model math are first-class here
+(SURVEY.md §2.3 sequence-parallel row, §7 step 6).
+"""
+
+from ray_tpu.ops.attention import attention, ring_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+
+__all__ = ["attention", "ring_attention", "rms_norm", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,42 @@
+"""Parallelism layer: meshes, sharding rules, ring collectives, pipelines.
+
+This is where the framework diverges hardest from the reference: instead of
+NCCL process groups (``python/ray/util/collective``, ``train/torch/config.py:65``)
+and actor-composed TP/PP (``python/ray/dag/compiled_dag_node.py:391``),
+parallelism is expressed as GSPMD mesh axes inside compiled XLA programs over
+ICI (SURVEY.md §2.3).
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_TENSOR,
+    MeshConfig,
+    create_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    batch_sharding,
+    infer_param_sharding,
+    logical_to_mesh_spec,
+    replicated,
+    with_sharding,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_TENSOR",
+    "AXIS_CONTEXT",
+    "AXIS_EXPERT",
+    "AXIS_PIPELINE",
+    "MeshConfig",
+    "create_mesh",
+    "batch_sharding",
+    "replicated",
+    "with_sharding",
+    "logical_to_mesh_spec",
+    "infer_param_sharding",
+]

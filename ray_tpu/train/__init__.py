@@ -1,0 +1,34 @@
+"""Distributed training library (Ray Train equivalent).
+
+Parity: ``python/ray/train`` — ``BaseTrainer.fit`` (``base_trainer.py:567``),
+``DataParallelTrainer`` (``data_parallel_trainer.py:25``), ``BackendExecutor``
+(``_internal/backend_executor.py:67``), in-worker session with
+``train.report`` (``_internal/session.py:667``). The framework backend is JAX:
+worker group = one actor per TPU host; collectives run inside jit over ICI
+(SURVEY.md §2.3 DP row), so there is no NCCL rendezvous step — the backend
+just aligns mesh construction across hosts.
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._result import Result
+from ray_tpu.train._session import get_checkpoint, get_context, report
+from ray_tpu.train.jax_trainer import JaxTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+    "JaxTrainer",
+    "report",
+    "get_context",
+    "get_checkpoint",
+]

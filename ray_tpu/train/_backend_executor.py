@@ -1,0 +1,148 @@
+"""Worker-group executor for training.
+
+Parity: ``BackendExecutor`` (``python/ray/train/_internal/backend_executor.py:67``,
+PG creation ``:213``) + ``WorkerGroup`` (``_internal/worker_group.py``): N
+worker actors gang-scheduled in a placement group, a per-framework backend
+hook, reports streamed back to the driver. The JAX backend's ``on_start``
+needs no NCCL rendezvous — single-host meshes come from ``jax.devices()`` and
+multi-host alignment is by construction (same program, same mesh).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train._config import RunConfig, ScalingConfig
+from ray_tpu.train._session import TrainContext, _Session, _set_session
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote(num_cpus=0)
+class _ReportCollector:
+    """Buffers (rank, iteration, metrics, checkpoint_path) reports."""
+
+    def __init__(self):
+        self.reports: List[Tuple[int, int, dict, Optional[str]]] = []
+
+    def report(self, rank, iteration, metrics, ckpt_path):
+        self.reports.append((rank, iteration, metrics, ckpt_path))
+        return True
+
+    def drain(self, start: int):
+        return self.reports[start:]
+
+
+@ray_tpu.remote
+class _TrainWorker:
+    """One member of the worker group; runs the user train loop."""
+
+    def __init__(self, rank: int, world_size: int, trial_dir: str):
+        self.context = TrainContext(
+            world_rank=rank,
+            world_size=world_size,
+            local_rank=rank,
+            trial_dir=trial_dir,
+        )
+
+    def run(self, fn_blob: bytes, config: Optional[dict], collector, latest_ckpt):
+        fn = cloudpickle.loads(fn_blob)
+        session = _Session(self.context, collector, latest_ckpt)
+        _set_session(session)
+        try:
+            if config is not None:
+                result = fn(config)
+            else:
+                result = fn()
+            return result
+        finally:
+            _set_session(None)
+
+
+class BackendExecutor:
+    def __init__(self, scaling: ScalingConfig, run_config: RunConfig, trial_dir: str):
+        self.scaling = scaling
+        self.run_config = run_config
+        self.trial_dir = trial_dir
+        self.pg = None
+        self.workers: List = []
+        self.collector = None
+
+    def start(self):
+        res = self.scaling.worker_resources()
+        bundles = [dict(res) for _ in range(self.scaling.num_workers)]
+        if self.scaling.topology:
+            # slice-aware gang scheduling: bundle 0 claims the slice-head
+            # resource the accelerator manager plants on the slice's worker 0
+            # (parity: TPU-{pod}-head, reference tpu.py:334) so the whole
+            # group lands on one ICI-connected slice
+            bundles[0][f"TPU-{self.scaling.topology}-head"] = 1.0
+        self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy)
+        if not self.pg.wait(60):
+            remove_placement_group(self.pg)
+            raise RuntimeError(
+                f"could not gang-schedule {self.scaling.num_workers} workers "
+                f"with {res} each (cluster too small?)"
+            )
+        self.collector = _ReportCollector.remote()
+        self.workers = []
+        for rank in range(self.scaling.num_workers):
+            w = _TrainWorker.options(
+                num_cpus=res.get("CPU", 1.0),
+                num_tpus=res.get("TPU", 0.0),
+                resources={
+                    k: v for k, v in res.items() if k not in ("CPU", "TPU")
+                },
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=rank
+                ),
+            ).remote(rank, self.scaling.num_workers, self.trial_dir)
+            self.workers.append(w)
+
+    def run(
+        self,
+        train_fn: Callable,
+        config: Optional[dict],
+        latest_ckpt=None,
+        report_callback: Optional[Callable] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        fn_blob = cloudpickle.dumps(train_fn)
+        refs = [
+            w.run.remote(fn_blob, config, self.collector, latest_ckpt)
+            for w in self.workers
+        ]
+        seen = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=0.5)
+            new = ray_tpu.get(self.collector.drain.remote(seen), timeout=60)
+            seen += len(new)
+            if report_callback:
+                for r in new:
+                    report_callback(*r)
+            for r in ready:
+                ray_tpu.get(r)  # surface worker errors immediately
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("training run timed out")
+        new = ray_tpu.get(self.collector.drain.remote(seen), timeout=60)
+        if report_callback:
+            for r in new:
+                report_callback(*r)
+        return ray_tpu.get(refs)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            remove_placement_group(self.pg)
+            self.pg = None

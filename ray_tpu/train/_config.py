@@ -1,0 +1,63 @@
+"""Train/Tune shared configs.
+
+Parity: ``python/ray/air/config.py`` (``ScalingConfig``, ``RunConfig``,
+``FailureConfig``, ``CheckpointConfig``). The TPU extension: ``ScalingConfig``
+can name a slice topology, which the placement layer turns into a
+slice-atomic placement group (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU slice topology, e.g. "v5litepod-16": one worker per slice host,
+    # gang-scheduled onto an ICI-connected slice
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = 1.0
+        return res
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for k, v in self.worker_resources().items():
+            out[k] = v * self.num_workers
+        return out
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = infinite
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,22 @@
+"""Result of a training/tuning run. Parity: ``python/ray/air/result.py``."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: str = ""
+    error: Optional[Exception] = None
+    metrics_dataframe: Optional[Any] = None
+    best_checkpoints: List = field(default_factory=list)
+
+    @property
+    def config(self):
+        return self.metrics.get("config")

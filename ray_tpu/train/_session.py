@@ -1,0 +1,106 @@
+"""In-worker training session.
+
+Parity: ``_TrainSession`` (``python/ray/train/_internal/session.py:111``) with
+``report`` (``:667``) and ``get_checkpoint`` (``:754``). Reports flow to the
+driver through a named collector actor instead of the reference's in-process
+queue+thread (workers here are separate processes).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+_session_local = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: str = ""
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+class _Session:
+    def __init__(self, context: TrainContext, collector, latest_checkpoint: Optional[Checkpoint]):
+        self.context = context
+        self.collector = collector  # ActorHandle of _ReportCollector (or None)
+        self.latest_checkpoint = latest_checkpoint
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        ckpt_path = None
+        # only rank 0's checkpoint is persisted and tracked (parity: Train's
+        # default; per-shard checkpointing composes via rank-0 gathering) —
+        # other ranks' copies would otherwise accumulate untracked on disk
+        if checkpoint is not None and self.context.world_rank != 0:
+            checkpoint = None
+        if checkpoint is not None:
+            # persist the checkpoint under the trial dir (parity: StorageContext
+            # upload, _internal/storage.py)
+            dest = os.path.join(
+                self.context.trial_dir,
+                f"checkpoint_{self.iteration:06d}_{uuid.uuid4().hex[:6]}",
+            )
+            if os.path.abspath(checkpoint.path) != dest:
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            ckpt_path = dest
+        if self.collector is not None:
+            import ray_tpu
+
+            ray_tpu.get(
+                self.collector.report.remote(
+                    self.context.world_rank, self.iteration, metrics, ckpt_path
+                )
+            )
+
+
+def _set_session(session: Optional[_Session]):
+    _session_local.session = session
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_session_local, "session", None)
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from the train loop.
+    Parity: ``ray.train.report``."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("train.report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        return TrainContext()
+    return s.context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    return s.latest_checkpoint if s else None

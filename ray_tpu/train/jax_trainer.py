@@ -1,0 +1,113 @@
+"""JaxTrainer: the DataParallelTrainer equivalent for TPU.
+
+Parity: ``DataParallelTrainer`` (``python/ray/train/data_parallel_trainer.py:25``)
++ ``TorchTrainer`` fit path (SURVEY.md §3.4). Differences by design:
+
+* no process-group rendezvous — the train loop builds a mesh and jits
+  (the reference's ``_setup_torch_process_group``, ``torch/config.py:65``,
+  has no TPU analogue: collectives are in-program over ICI);
+* ``ScalingConfig(topology=...)`` turns into a slice-aware placement group;
+* checkpoints are orbax pytrees behind the same dir-of-files ``Checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train._backend_executor import BackendExecutor
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import RunConfig, ScalingConfig
+from ray_tpu.train._result import Result
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"JaxTrainer_{time.strftime('%Y%m%d_%H%M%S')}"
+        trial_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        os.makedirs(trial_dir, exist_ok=True)
+
+        executor = BackendExecutor(self.scaling_config, self.run_config, trial_dir)
+        last: Dict[str, Any] = {}
+        checkpoints: list = []
+
+        def on_report(rank, iteration, metrics, ckpt_path):
+            if rank == 0:
+                last.clear()
+                last.update(metrics)
+                last["training_iteration"] = iteration
+                if ckpt_path:
+                    checkpoints.append(
+                        (
+                            {**metrics, "training_iteration": iteration},
+                            Checkpoint(ckpt_path),
+                        )
+                    )
+                    self._prune_checkpoints(checkpoints)
+
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        error: Optional[Exception] = None
+        train_fn = self.train_loop
+        config = self.train_loop_config
+        if self.datasets:
+            config = dict(config or {})
+            config["__datasets__"] = self.datasets
+        while True:
+            try:
+                executor.start()
+                latest = checkpoints[-1][1] if checkpoints else self.resume_from_checkpoint
+                executor.run(train_fn, config, latest_ckpt=latest, report_callback=on_report)
+                error = None
+                break
+            except Exception as e:  # noqa: BLE001
+                error = e
+                attempt += 1
+                executor.shutdown()
+                if max_failures != -1 and attempt > max_failures:
+                    break
+                time.sleep(1.0)
+            finally:
+                executor.shutdown()
+
+        best = checkpoints[-1][1] if checkpoints else None
+        return Result(metrics=dict(last), checkpoint=best, path=trial_dir, error=error)
+
+    def _prune_checkpoints(self, checkpoints: list) -> None:
+        cfg = self.run_config.checkpoint_config
+        if cfg.num_to_keep is None or len(checkpoints) <= cfg.num_to_keep:
+            return
+        if cfg.checkpoint_score_attribute:
+            reverse = cfg.checkpoint_score_order == "max"
+            checkpoints.sort(
+                key=lambda mc: mc[0].get(cfg.checkpoint_score_attribute, 0.0),
+                reverse=reverse,
+            )
+            doomed = checkpoints[cfg.num_to_keep :]
+            del checkpoints[cfg.num_to_keep :]
+            checkpoints.sort(key=lambda mc: mc[0].get("training_iteration", 0))
+        else:
+            doomed = checkpoints[: -cfg.num_to_keep]
+            del checkpoints[: -cfg.num_to_keep]
+        import shutil
+
+        for _, ckpt in doomed:
+            shutil.rmtree(ckpt.path, ignore_errors=True)

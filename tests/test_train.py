@@ -1,0 +1,139 @@
+"""JaxTrainer tests. Parity: ``python/ray/train/tests`` patterns (SURVEY.md §4):
+real worker-group actors, gloo-free CPU execution, checkpoint/restore."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_single_worker_fit(ray_start_regular, tmp_path):
+    def loop(config):
+        for i in range(3):
+            train.report({"loss": 10.0 - i, "lr": config["lr"]})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t1"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] == 8.0
+    assert result.metrics["training_iteration"] == 3
+    assert result.metrics["lr"] == 0.1
+
+
+def test_multi_worker_context(ray_start_regular, tmp_path):
+    def loop():
+        ctx = train.get_context()
+        train.report({"rank": ctx.get_world_rank(), "world": ctx.get_world_size()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t2"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rank"] == 0
+    assert result.metrics["world"] == 2
+
+
+def test_checkpoint_reported_and_kept(ray_start_regular, tmp_path):
+    def loop():
+        import tempfile
+
+        for i in range(4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "model.txt"), "w") as fh:
+                fh.write(f"iter-{i}")
+            train.report({"score": float(i)}, checkpoint=Checkpoint.from_directory(d))
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            name="t3",
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "model.txt")) as fh:
+        assert fh.read() == "iter-3"
+
+
+def test_failure_restart_from_checkpoint(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "fail_once")
+
+    def loop():
+        import tempfile
+
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "it.txt")) as fh:
+                start = int(fh.read()) + 1
+        for i in range(start, 3):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "it.txt"), "w") as fh:
+                fh.write(str(i))
+            train.report({"it": float(i)}, checkpoint=Checkpoint.from_directory(d))
+            if i == 1 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("injected failure")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            name="t4",
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["it"] == 2.0  # resumed from it=1 checkpoint, not from 0
+
+
+def test_worker_error_surfaces(ray_start_regular, tmp_path):
+    def loop():
+        raise ValueError("bad train fn")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t5"),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+
+
+def test_gang_schedule_too_big_fails_fast(ray_start_regular, tmp_path):
+    def loop():
+        pass
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 100}
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t6"),
+    )
+    result = trainer.fit()
+    assert result.error is not None
