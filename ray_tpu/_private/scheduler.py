@@ -207,7 +207,6 @@ class PlacementGroupState:
     bundle_available: List[Dict[str, float]] = field(default_factory=list)
     state: str = "PENDING"  # PENDING|CREATED|REMOVED
     name: str = ""
-    ready_event: threading.Event = field(default_factory=threading.Event)
 
 
 # --------------------------------------------------------------------------
@@ -950,7 +949,6 @@ class Scheduler:
         pg.bundle_nodes = [n.node_id for n in placement]
         pg.bundle_available = [dict(b) for b in pg.bundles]
         pg.state = "CREATED"
-        pg.ready_event.set()
 
     def _place_bundles(
         self, bundles, strategy, nodes: List[NodeState]

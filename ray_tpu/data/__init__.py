@@ -1,0 +1,30 @@
+"""Distributed datasets (Ray Data equivalent).
+
+Parity: ``python/ray/data`` (SURVEY.md §2.4): lazy plans over object-store
+blocks, task-parallel execution with bounded in-flight windows,
+``streaming_split`` feeding trainer workers, file datasources.
+"""
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "Dataset",
+    "DataIterator",
+    "range",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+]

@@ -1,0 +1,343 @@
+"""Dataset: lazy, distributed, streaming-consumable data.
+
+Parity: ``python/ray/data/dataset.py`` — lazy logical plan → execution over
+framework tasks with blocks in the object store; ``map_batches``
+(``dataset.py:383``), ``iter_batches`` (``:3668``), ``streaming_split``
+(``:1236``). Execution here is a pipelined pull model: consuming iterators
+launch per-block tasks with a bounded in-flight window (the role of the
+reference's ``StreamingExecutor`` backpressure, ``streaming_executor.py:48``).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Batch,
+    block_num_rows,
+    block_to_rows,
+    concat_blocks,
+    normalize_block,
+    rows_to_block,
+    slice_block,
+)
+
+# an operator is (kind, fn) applied block-wise; fused into one task per block
+_PREFETCH = 4
+
+
+def _apply_ops(block: Batch, ops) -> Batch:
+    import cloudpickle
+
+    for kind, fn_blob in ops:
+        fn = cloudpickle.loads(fn_blob)
+        if kind == "map_batches":
+            block = normalize_block(fn(block))
+        elif kind == "map":
+            block = rows_to_block([fn(r) for r in block_to_rows(block)])
+        elif kind == "filter":
+            block = rows_to_block([r for r in block_to_rows(block) if fn(r)])
+        elif kind == "flat_map":
+            out = []
+            for r in block_to_rows(block):
+                out.extend(fn(r))
+            block = rows_to_block(out)
+        else:
+            raise ValueError(kind)
+    return block
+
+
+@ray_tpu.remote
+def _exec_block(block_or_ref, ops):
+    block = block_or_ref
+    return _apply_ops(block, ops)
+
+
+class Dataset:
+    """A lazy plan: source block refs + a chain of per-block operators."""
+
+    def __init__(self, block_refs: List, ops: Optional[List] = None):
+        self._block_refs = list(block_refs)
+        self._ops = list(ops or [])
+
+    # -- transformations (lazy) -------------------------------------------
+
+    def _with_op(self, kind: str, fn: Callable) -> "Dataset":
+        import cloudpickle
+
+        return Dataset(self._block_refs, self._ops + [(kind, cloudpickle.dumps(fn))])
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_op("map", fn)
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None) -> "Dataset":
+        # batch_size=None applies fn per block (the common, fastest path)
+        if batch_size is None:
+            return self._with_op("map_batches", fn)
+        ds = self.repartition_by_rows(batch_size)
+        return ds._with_op("map_batches", fn)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_op("filter", fn)
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_op("flat_map", fn)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        if self._ops or other._ops:
+            return Dataset(
+                self.materialize()._block_refs + other.materialize()._block_refs
+            )
+        return Dataset(self._block_refs + other._block_refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned zip: right-side blocks are re-sliced to the left's
+        block boundaries (streaming, one block in driver memory at a time)."""
+        left = self.materialize()
+        right_blocks = other._iter_exec_blocks()
+        buf: List[Batch] = []
+        buffered = 0
+        refs = []
+        total_left = 0
+        for lref in left._block_refs:
+            lb = _fetch(lref)
+            n = block_num_rows(lb)
+            total_left += n
+            while buffered < n:
+                try:
+                    nb = next(right_blocks)
+                except StopIteration:
+                    raise ValueError(
+                        "zip(): datasets have different row counts"
+                    ) from None
+                buf.append(nb)
+                buffered += block_num_rows(nb)
+            merged = concat_blocks(buf)
+            rb = slice_block(merged, 0, n)
+            buf = [slice_block(merged, n, block_num_rows(merged))]
+            buffered -= n
+            out = dict(lb)
+            for k, v in rb.items():
+                out[k if k not in out else f"{k}_1"] = v
+            refs.append(ray_tpu.put(out))
+        for nb in right_blocks:
+            buffered += block_num_rows(nb)
+        if buffered:
+            raise ValueError("zip(): datasets have different row counts")
+        return Dataset(refs)
+
+    def limit(self, n: int) -> "Dataset":
+        out_blocks = []
+        taken = 0
+        for block in self._iter_exec_blocks():
+            rows = block_num_rows(block)
+            if taken + rows > n:
+                block = slice_block(block, 0, n - taken)
+                rows = block_num_rows(block)
+            if rows:
+                out_blocks.append(ray_tpu.put(block))
+                taken += rows
+            if taken >= n:
+                break
+        return Dataset(out_blocks)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Streaming repartition: two passes over materialized blocks (block
+        fetches are zero-copy shm maps), one block resident at a time."""
+        mat = self.materialize()
+        total = sum(block_num_rows(_fetch(r)) for r in mat._block_refs)
+        per = max(1, (total + num_blocks - 1) // num_blocks)
+        return mat.repartition_by_rows(per)
+
+    def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
+        """Re-slice the block stream into fixed-size blocks (streaming)."""
+        refs = []
+        pieces: List[Batch] = []
+        buffered = 0
+        for block in self._iter_exec_blocks():
+            off = 0
+            n = block_num_rows(block)
+            while off < n:
+                take = min(rows_per_block - buffered, n - off)
+                pieces.append(slice_block(block, off, off + take))
+                buffered += take
+                off += take
+                if buffered == rows_per_block:
+                    refs.append(ray_tpu.put(concat_blocks(pieces)))
+                    pieces, buffered = [], 0
+        if buffered:
+            refs.append(ray_tpu.put(concat_blocks(pieces)))
+        return Dataset(refs)
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Distributed exchange shuffle (parity: the reference's push-based
+        shuffle in ``_internal/planner/exchange/``): each source block is
+        split into k random slices by tasks, each output block merges one
+        slice from every source and permutes — no global materialization."""
+        mat = self.materialize()
+        k = max(1, len(mat._block_refs))
+        base = 0 if seed is None else int(seed)
+        split_refs = [
+            _shuffle_split.options(num_returns=k).remote(ref, k, base + i)
+            for i, ref in enumerate(mat._block_refs)
+        ]
+        if k == 1:
+            split_refs = [[r] for r in split_refs]
+        out = [
+            _shuffle_merge.remote(base + 7919 + j, *[row[j] for row in split_refs])
+            for j in range(k)
+        ]
+        return Dataset(out)
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        ds = self.materialize()
+        if equal:
+            block = concat_blocks([_fetch(r) for r in ds._block_refs])
+            total = block_num_rows(block)
+            per = total // n
+            return [
+                Dataset([ray_tpu.put(slice_block(block, i * per, (i + 1) * per))])
+                for i in range(n)
+            ]
+        shards: List[List] = [[] for _ in range(n)]
+        for i, ref in enumerate(ds._block_refs):
+            shards[i % n].append(ref)
+        return [Dataset(refs) for refs in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
+        """Per-consumer iterators over disjoint shards (parity:
+        ``dataset.py:1236``; feeds one trainer worker each)."""
+        from ray_tpu.data.iterator import DataIterator
+
+        return [DataIterator(shard) for shard in self.split(n, equal=equal)]
+
+    # -- execution ---------------------------------------------------------
+
+    def _iter_exec_block_refs(self) -> Iterator:
+        """Launch per-block tasks with a bounded in-flight window."""
+        if not self._ops:
+            yield from self._block_refs
+            return
+        pending = []
+        idx = 0
+        while idx < len(self._block_refs) or pending:
+            while idx < len(self._block_refs) and len(pending) < _PREFETCH:
+                pending.append(
+                    _exec_block.remote(self._block_refs[idx], self._ops)
+                )
+                idx += 1
+            if pending:
+                yield pending.pop(0)
+
+    def _iter_exec_blocks(self) -> Iterator[Batch]:
+        for ref in self._iter_exec_block_refs():
+            yield _fetch(ref)
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan; returns a Dataset of plain block refs."""
+        if not self._ops:
+            return self
+        return Dataset(list(self._iter_exec_block_refs()))
+
+    def to_block(self) -> Batch:
+        return concat_blocks(list(self._iter_exec_blocks()))
+
+    # -- consumption -------------------------------------------------------
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self._iter_exec_blocks())
+
+    def take(self, n: int = 20) -> List[Dict]:
+        out = []
+        for block in self._iter_exec_blocks():
+            for row in block_to_rows(block):
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict]:
+        return [r for b in self._iter_exec_blocks() for r in block_to_rows(b)]
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for block in self._iter_exec_blocks():
+            yield from block_to_rows(block)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+    ) -> Iterator[Batch]:
+        """Re-batch the block stream to exactly batch_size rows. Linear: each
+        row is copied at most once (pieces are sliced views until concat)."""
+        import collections
+
+        blocks: collections.deque = collections.deque()  # (block, offset)
+        buffered = 0
+        for block in self._iter_exec_blocks():
+            n = block_num_rows(block)
+            if n:
+                blocks.append((block, 0))
+                buffered += n
+            while buffered >= batch_size:
+                pieces = []
+                need = batch_size
+                while need:
+                    blk, off = blocks[0]
+                    n = block_num_rows(blk) - off
+                    take = min(need, n)
+                    pieces.append(slice_block(blk, off, off + take))
+                    need -= take
+                    if take == n:
+                        blocks.popleft()
+                    else:
+                        blocks[0] = (blk, off + take)
+                buffered -= batch_size
+                yield pieces[0] if len(pieces) == 1 else concat_blocks(pieces)
+        if buffered and not drop_last:
+            yield concat_blocks([slice_block(b, o, block_num_rows(b)) for b, o in blocks])
+
+    def schema(self) -> Dict[str, str]:
+        for block in self._iter_exec_blocks():
+            return {k: str(v.dtype) for k, v in block.items()}
+        return {}
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def stats(self) -> str:
+        return f"Dataset(blocks={len(self._block_refs)}, ops={len(self._ops)})"
+
+    def __repr__(self):
+        return self.stats()
+
+
+@ray_tpu.remote
+def _shuffle_split(block: Batch, k: int, seed: int):
+    """Randomly partition a block's rows into k slices."""
+    n = block_num_rows(block)
+    rng = np.random.default_rng(seed)
+    assignment = rng.integers(0, k, n)
+    out = tuple(
+        {key: v[assignment == j] for key, v in block.items()} for j in range(k)
+    )
+    return out if k > 1 else out[0]
+
+
+@ray_tpu.remote
+def _shuffle_merge(seed: int, *slices: Batch) -> Batch:
+    merged = concat_blocks(list(slices))
+    n = block_num_rows(merged)
+    perm = np.random.default_rng(seed).permutation(n)
+    return {k: v[perm] for k, v in merged.items()}
+
+
+def _fetch(ref) -> Batch:
+    if isinstance(ref, ray_tpu.ObjectRef):
+        return ray_tpu.get(ref, timeout=120)
+    return ref

@@ -1,0 +1,50 @@
+"""DataIterator: the per-trainer-worker consumption handle.
+
+Parity: ``python/ray/data/iterator.py`` (``DataIterator.iter_batches``,
+``to_tf``/``to_torch`` analogues) — plus ``iter_jax_batches`` which
+``device_put``s each batch with an optional sharding, the TPU feed path
+(SURVEY.md §7 step 5: blocks -> iter_batches -> device_put sharded).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class DataIterator:
+    def __init__(self, dataset):
+        self._ds = dataset
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False):
+        return self._ds.iter_batches(batch_size=batch_size, drop_last=drop_last)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+    def materialize(self):
+        return self._ds.materialize()
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = True,
+        sharding: Optional[Any] = None,
+        dtypes: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as (optionally sharded) jax Arrays on device."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.asarray(v)
+                if dtypes and k in dtypes:
+                    arr = arr.astype(dtypes[k])
+                out[k] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
+            yield out

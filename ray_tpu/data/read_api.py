@@ -1,0 +1,127 @@
+"""Dataset constructors.
+
+Parity: ``python/ray/data/read_api.py`` — ``range``, ``from_items``,
+``from_numpy``, ``read_parquet``, ``read_csv``, ``read_json``; file reads are
+distributed tasks, one per file (the reference's datasource split model).
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as globlib
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import rows_to_block
+from ray_tpu.data.dataset import Dataset
+
+_DEFAULT_BLOCK_ROWS = 1000
+
+
+def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    num_blocks = num_blocks or max(1, min(32, n // _DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (n + num_blocks - 1) // num_blocks)
+    if n == 0:
+        return Dataset([ray_tpu.put({"id": np.arange(0)})])
+    refs = []
+    for start in builtins.range(0, n, per):
+        end = min(start + per, n)
+        refs.append(ray_tpu.put({"id": np.arange(start, end)}))
+    return Dataset(refs)
+
+
+def from_items(items: List[Any], *, num_blocks: int = 4) -> Dataset:
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    per = max(1, (len(rows) + num_blocks - 1) // num_blocks)
+    refs = []
+    for i in builtins.range(0, len(rows), per):
+        refs.append(ray_tpu.put(rows_to_block(rows[i : i + per])))
+    return Dataset(refs)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data", num_blocks: int = 4) -> Dataset:
+    per = max(1, (len(arr) + num_blocks - 1) // num_blocks)
+    refs = []
+    for i in builtins.range(0, len(arr), per):
+        refs.append(ray_tpu.put({column: arr[i : i + per]}))
+    return Dataset(refs)
+
+
+def from_pandas(df) -> Dataset:
+    block = {c: df[c].to_numpy() for c in df.columns}
+    return Dataset([ray_tpu.put(block)])
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(globlib.glob(os.path.join(p, f"*{suffix}"))))
+        elif "*" in p:
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+@ray_tpu.remote
+def _read_parquet_file(path: str):
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    return {c: table.column(c).to_numpy(zero_copy_only=False) for c in table.column_names}
+
+
+@ray_tpu.remote
+def _read_csv_file(path: str):
+    import csv
+
+    with open(path, newline="") as fh:
+        reader = csv.DictReader(fh)
+        rows = list(reader)
+    block = rows_to_block(rows)
+    # best-effort numeric conversion
+    out = {}
+    for k, v in block.items():
+        try:
+            out[k] = v.astype(np.int64)
+        except ValueError:
+            try:
+                out[k] = v.astype(np.float64)
+            except ValueError:
+                out[k] = v
+    return out
+
+
+@ray_tpu.remote
+def _read_json_file(path: str):
+    import json
+
+    rows = []
+    with open(path) as fh:
+        first = fh.read(1)
+        fh.seek(0)
+        if first == "[":
+            rows = json.load(fh)
+        else:  # jsonl
+            rows = [json.loads(line) for line in fh if line.strip()]
+    return rows_to_block(rows)
+
+
+def read_parquet(paths) -> Dataset:
+    return Dataset([_read_parquet_file.remote(p) for p in _expand_paths(paths, ".parquet")])
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset([_read_csv_file.remote(p) for p in _expand_paths(paths, ".csv")])
+
+
+def read_json(paths) -> Dataset:
+    return Dataset([_read_json_file.remote(p) for p in _expand_paths(paths, ".json")])

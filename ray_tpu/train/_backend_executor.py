@@ -92,7 +92,9 @@ class BackendExecutor:
         self.workers = []
         for rank in range(self.scaling.num_workers):
             w = _TrainWorker.options(
-                num_cpus=res.get("CPU", 1.0),
+                # the actor's demand must equal the bundle's contents — a CPU
+                # default here would never fit a CPU-less bundle
+                num_cpus=res.get("CPU", 0.0),
                 num_tpus=res.get("TPU", 0.0),
                 resources={
                     k: v for k, v in res.items() if k not in ("CPU", "TPU")

@@ -1,0 +1,38 @@
+"""Hyperparameter tuning library (Ray Tune equivalent).
+
+Parity: ``python/ray/tune`` — ``Tuner`` /
+``TuneController`` event loop (``execution/tune_controller.py:68``) managing
+trial actors, search algorithms (``search/``), trial schedulers
+(``schedulers/``: ASHA, median stopping), ``ResultGrid``. Trials are plain
+actors of this framework's core (libraries stay pure clients, SURVEY.md §1).
+"""
+
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    randn,
+    uniform,
+)
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.tune_config import TuneConfig
+from ray_tpu.tune.tuner import Tuner
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "grid_search",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "qrandint",
+    "randn",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+]

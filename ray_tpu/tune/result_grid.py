@@ -1,0 +1,55 @@
+"""ResultGrid. Parity: ``python/ray/tune/result_grid.py``."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.train._result import Result
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: str = "min"
+    ) -> Result:
+        candidates = [
+            r for r in self._results if r.error is None and metric in r.metrics
+        ]
+        if not candidates:
+            candidates = [r for r in self._results if r.error is None]
+        if not candidates:
+            raise RuntimeError("all trials failed")
+        if metric is None:
+            return candidates[0]
+        return (max if mode == "max" else min)(
+            candidates, key=lambda r: r.metrics.get(metric, float("inf") if mode == "min" else float("-inf"))
+        )
+
+    def get_dataframe(self):
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row["error"] = str(r.error) if r.error else None
+            row["path"] = r.path
+            rows.append(row)
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows

@@ -1,0 +1,108 @@
+"""Trial schedulers: early stopping policies.
+
+Parity: ``python/ray/tune/schedulers/`` — FIFO (no-op), ASHA
+(``async_hyperband.py``: successive-halving rungs, keep top 1/reduction_factor
+per rung), median stopping rule (``median_stopping_rule.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, iteration: int, metrics: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Async successive halving.
+
+    A trial reaching rung r (iteration == grace_period * reduction_factor**r)
+    continues only if its metric is in the top 1/reduction_factor of completed
+    results at that rung.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung level -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = collections.defaultdict(list)
+
+    def _rung_levels(self):
+        level = self.grace
+        while level < self.max_t:
+            yield level
+            level *= self.rf
+
+    def on_result(self, trial_id: str, iteration: int, metrics: Dict) -> str:
+        value = metrics.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if iteration >= self.max_t:
+            return STOP
+        for level in self._rung_levels():
+            if iteration == level:
+                rung = self._rungs[level]
+                rung.append(float(value))
+                if len(rung) < self.rf:
+                    return CONTINUE  # not enough peers yet: optimistic continue
+                srt = sorted(rung, reverse=(self.mode == "max"))
+                cutoff = srt[max(0, len(rung) // self.rf - 1)]
+                good = value >= cutoff if self.mode == "max" else value <= cutoff
+                return CONTINUE if good else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric is worse than the median of
+    other trials' running averages at the same iteration."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, iteration: int, metrics: Dict) -> str:
+        value = metrics.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._history[trial_id].append(float(value))
+        if iteration < self.grace:
+            return CONTINUE
+        averages = [
+            sum(h) / len(h)
+            for tid, h in self._history.items()
+            if tid != trial_id and h
+        ]
+        if len(averages) < self.min_samples:
+            return CONTINUE
+        averages.sort()
+        median = averages[len(averages) // 2]
+        mine = sum(self._history[trial_id]) / len(self._history[trial_id])
+        if self.mode == "min":
+            return CONTINUE if mine <= median else STOP
+        return CONTINUE if mine >= median else STOP

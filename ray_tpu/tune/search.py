@@ -1,0 +1,81 @@
+"""Search spaces and variant generation.
+
+Parity: ``python/ray/tune/search/`` — ``grid_search`` + sampling domains
+(``sample.py``) and the ``BasicVariantGenerator`` cross-product expansion
+(``search/basic_variant.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+@dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.sampler(rng)
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    import math
+
+    return Domain(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def qrandint(low: int, high: int, q: int) -> Domain:
+    return Domain(lambda rng: (rng.randrange(low, high) // q) * q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Domain:
+    return Domain(lambda rng: rng.gauss(mean, sd))
+
+
+def choice(options: List[Any]) -> Domain:
+    opts = list(options)
+    return Domain(lambda rng: rng.choice(opts))
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def generate_variants(
+    param_space: Dict[str, Any], num_samples: int, seed: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """Expand grid axes into a cross product; sample Domains num_samples times
+    per grid point (parity: BasicVariantGenerator semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    grid_points = list(itertools.product(*grid_values)) if grid_keys else [()]
+    for point in grid_points:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = point[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

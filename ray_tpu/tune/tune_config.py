@@ -1,0 +1,17 @@
+"""TuneConfig. Parity: ``python/ray/tune/tune_config.py``."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"  # "min" | "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[Any] = None  # FIFOScheduler/ASHAScheduler/...
+    search_alg: Optional[Any] = None
+    seed: Optional[int] = None

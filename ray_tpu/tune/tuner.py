@@ -1,0 +1,214 @@
+"""Tuner: the trial control loop.
+
+Parity: ``Tuner`` + ``TuneController`` (``python/ray/tune/execution/
+tune_controller.py:68``; ``step:666``; trial actor scheduling ``:964``) —
+trials are actors, reports stream back through a collector actor, the
+scheduler may early-stop trials, results land in a ``ResultGrid``. Trainables
+can be plain functions (``tune.report`` via the train session) or
+``JaxTrainer`` instances (``trainer.as_trainable`` pattern,
+``base_trainer.py:819``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.train._config import RunConfig
+from ray_tpu.train._result import Result
+from ray_tpu.train._session import TrainContext, _Session, _set_session
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.tune_config import TuneConfig
+
+
+@ray_tpu.remote(num_cpus=0)
+class _TuneCollector:
+    def __init__(self):
+        self.reports: List = []
+
+    def report(self, trial_id, iteration, metrics, ckpt_path):
+        self.reports.append((trial_id, iteration, metrics, ckpt_path))
+        return True
+
+    def drain(self, start: int):
+        return self.reports[start:]
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def __init__(self, trial_id: str, trial_dir: str):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+
+    def run(self, fn_blob: bytes, config: dict, collector):
+        fn = cloudpickle.loads(fn_blob)
+        ctx = TrainContext(world_rank=0, world_size=1, trial_dir=self.trial_dir)
+        session = _Session(ctx, collector, None)
+        # reports carry the trial id instead of a worker rank
+        session.collector = _CollectorProxy(self.trial_id, collector)
+        _set_session(session)
+        try:
+            return fn(config)
+        finally:
+            _set_session(None)
+
+
+class _CollectorProxy:
+    """Duck-types the collector ActorHandle: rewrites rank -> trial_id."""
+
+    def __init__(self, trial_id: str, inner):
+        self.trial_id = trial_id
+        self.inner = inner
+
+    @property
+    def report(self):
+        proxy = self
+
+        class _M:
+            def remote(self, rank, iteration, metrics, ckpt_path):
+                return proxy.inner.report.remote(
+                    proxy.trial_id, iteration, metrics, ckpt_path
+                )
+
+        return _M()
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Any,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def _as_function(self) -> Callable:
+        t = self.trainable
+        if callable(t) and not hasattr(t, "fit"):
+            return t
+        # JaxTrainer-like: merge trial config into train_loop_config
+        if hasattr(t, "train_loop"):
+            def run_trainer(config):
+                import copy
+
+                trainer = copy.copy(t)
+                trainer.train_loop_config = {**(t.train_loop_config or {}), **config}
+                result = trainer.fit()
+                if result.error is not None:
+                    raise result.error
+                from ray_tpu.train._session import report
+
+                report(result.metrics)
+            return run_trainer
+        raise TypeError(f"unsupported trainable {type(t)}")
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        exp_name = self.run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
+        exp_dir = os.path.join(self.run_config.resolved_storage_path(), exp_name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        variants = generate_variants(self.param_space, cfg.num_samples, cfg.seed)
+        scheduler = cfg.scheduler or FIFOScheduler()
+        fn_blob = cloudpickle.dumps(self._as_function())
+        collector = _TuneCollector.remote()
+
+        max_conc = cfg.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 1))
+        )
+
+        trials: Dict[str, dict] = {}
+        queue = []
+        for i, variant in enumerate(variants):
+            tid = f"trial_{i:05d}_{uuid.uuid4().hex[:4]}"
+            trials[tid] = {
+                "config": variant,
+                "state": "PENDING",
+                "actor": None,
+                "ref": None,
+                "last_metrics": {},
+                "iteration": 0,
+                "checkpoint": None,
+                "error": None,
+                "dir": os.path.join(exp_dir, tid),
+            }
+            queue.append(tid)
+
+        running: Dict[Any, str] = {}  # ref -> trial_id
+        seen = 0
+
+        def launch(tid):
+            t = trials[tid]
+            os.makedirs(t["dir"], exist_ok=True)
+            actor = _TrialActor.remote(tid, t["dir"])
+            ref = actor.run.remote(fn_blob, t["config"], collector)
+            t.update(state="RUNNING", actor=actor, ref=ref)
+            running[ref] = tid
+
+        while queue or running:
+            while queue and len(running) < max_conc:
+                launch(queue.pop(0))
+            ready, _ = ray_tpu.wait(list(running.keys()), num_returns=1, timeout=0.5)
+            # drain reports and apply the scheduler
+            new = ray_tpu.get(collector.drain.remote(seen), timeout=60)
+            seen += len(new)
+            for tid, iteration, metrics, ckpt_path in new:
+                t = trials.get(tid)
+                if t is None or t["state"] in ("TERMINATED", "ERROR", "STOPPED"):
+                    continue
+                t["last_metrics"] = metrics
+                t["iteration"] = iteration
+                if ckpt_path:
+                    t["checkpoint"] = Checkpoint(ckpt_path)
+                if scheduler.on_result(tid, iteration, metrics) == STOP:
+                    t["state"] = "STOPPED"
+                    if t["actor"] is not None:
+                        ray_tpu.kill(t["actor"])
+                    running.pop(t["ref"], None)
+            for ref in ready:
+                tid = running.pop(ref, None)
+                if tid is None:
+                    continue
+                t = trials[tid]
+                try:
+                    ray_tpu.get(ref)
+                    t["state"] = "TERMINATED"
+                except exc.ActorDiedError:
+                    if t["state"] != "STOPPED":
+                        t["state"] = "ERROR"
+                        t["error"] = exc.ActorDiedError(reason="trial actor died")
+                except Exception as e:  # noqa: BLE001
+                    t["state"] = "ERROR"
+                    t["error"] = e
+                if t["actor"] is not None and t["state"] != "STOPPED":
+                    ray_tpu.kill(t["actor"])
+
+        results = []
+        for tid, t in trials.items():
+            metrics = dict(t["last_metrics"])
+            metrics["config"] = t["config"]
+            metrics["training_iteration"] = t["iteration"]
+            metrics["trial_id"] = tid
+            results.append(
+                Result(
+                    metrics=metrics,
+                    checkpoint=t["checkpoint"],
+                    path=t["dir"],
+                    error=t["error"],
+                )
+            )
+        return ResultGrid(results)

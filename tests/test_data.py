@@ -1,0 +1,180 @@
+"""Dataset tests. Parity: ``python/ray/data/tests`` patterns (SURVEY.md §4)."""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches(ray_start_regular):
+    ds = rd.range(100).map_batches(lambda b: {"id": b["id"] * 2})
+    assert [r["id"] for r in ds.take(3)] == [0, 2, 4]
+
+
+def test_map_and_filter(ray_start_regular):
+    ds = rd.range(20).map(lambda r: {"id": r["id"] + 1}).filter(lambda r: r["id"] % 2 == 0)
+    assert ds.count() == 10
+
+
+def test_flat_map(ray_start_regular):
+    ds = rd.from_items([1, 2]).flat_map(lambda r: [{"v": r["item"]}, {"v": r["item"] * 10}])
+    assert sorted(r["v"] for r in ds.take_all()) == [1, 2, 10, 20]
+
+
+def test_iter_batches_exact_sizes(ray_start_regular):
+    ds = rd.range(100, num_blocks=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32, drop_last=True)]
+    assert sizes == [32, 32, 32]
+
+
+def test_repartition_and_num_blocks(ray_start_regular):
+    ds = rd.range(100).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+
+
+def test_split_equal(ray_start_regular):
+    shards = rd.range(100).split(4, equal=True)
+    assert [s.count() for s in shards] == [25, 25, 25, 25]
+
+
+def test_streaming_split_feeds_workers(ray_start_regular):
+    its = rd.range(64).streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    def consume(it):
+        return sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=8))
+
+    totals = ray_tpu.get([consume.remote(it) for it in its], timeout=120)
+    assert sum(totals) == sum(range(64))
+
+
+def test_union_zip_limit(ray_start_regular):
+    a = rd.range(10)
+    b = rd.range(10).map(lambda r: {"id": r["id"] + 100})
+    u = a.union(b)
+    assert u.count() == 20
+    z = rd.range(5).zip(rd.range(5).map(lambda r: {"other": r["id"] * 2}))
+    rows = z.take_all()
+    assert rows[3]["id"] == 3 and rows[3]["other"] == 6
+    assert rd.range(100).limit(7).count() == 7
+
+
+def test_random_shuffle_preserves_rows(ray_start_regular):
+    ds = rd.range(50).random_shuffle(seed=0)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(50))
+
+
+def test_from_numpy_and_schema(ray_start_regular):
+    ds = rd.from_numpy(np.ones((10, 3), dtype=np.float32), column="x")
+    assert ds.schema() == {"x": "float32"}
+    assert ds.count() == 10
+
+
+def test_read_csv_json(ray_start_regular, tmp_path):
+    csv_path = tmp_path / "t.csv"
+    with open(csv_path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=["a", "b"])
+        w.writeheader()
+        for i in range(5):
+            w.writerow({"a": i, "b": i * 2})
+    ds = rd.read_csv(str(csv_path))
+    assert ds.count() == 5
+    assert ds.take(1)[0]["b"] == 0
+
+    json_path = tmp_path / "t.jsonl"
+    with open(json_path, "w") as fh:
+        for i in range(3):
+            fh.write(json.dumps({"v": i}) + "\n")
+    assert rd.read_json(str(json_path)).count() == 3
+
+
+def test_read_parquet(ray_start_regular, tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    table = pa.table({"x": list(range(10)), "y": [float(i) for i in range(10)]})
+    path = tmp_path / "t.parquet"
+    pq.write_table(table, str(path))
+    ds = rd.read_parquet(str(path))
+    assert ds.count() == 10
+    assert ds.map_batches(lambda b: {"x2": b["x"] * 2}).take(2)[1]["x2"] == 2
+
+
+def test_dataset_feeds_jax_trainer(ray_start_regular, tmp_path):
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rd.range(64)
+
+    def loop(config):
+        it = config["__datasets__"]["train"]
+        total = sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=16))
+        train.report({"total": total})
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="d1"),
+        datasets={"train": rd.DataIterator(ds)},
+    ).fit()
+    assert result.error is None
+    assert result.metrics["total"] == sum(range(64))
+
+
+def test_zip_misaligned_blocks(ray_start_regular):
+    a = rd.from_items([{"a": i} for i in range(6)], num_blocks=2)
+    b = rd.from_items([{"b": i} for i in range(6)], num_blocks=3)
+    rows = a.zip(b).take_all()
+    assert len(rows) == 6
+    assert all(r["a"] == r["b"] for r in rows)
+
+
+def test_zip_count_mismatch_raises(ray_start_regular):
+    with pytest.raises(ValueError):
+        rd.range(5).zip(rd.range(6)).take_all()
+
+
+def test_range_zero(ray_start_regular):
+    assert rd.range(0).count() == 0
+
+
+def test_distributed_shuffle(ray_start_regular):
+    ds = rd.range(100, num_blocks=5).random_shuffle(seed=1)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(100))
+    assert vals[:10] != list(range(10))  # actually shuffled
+
+
+def test_trainer_custom_resource_only_worker(ray_start_regular, tmp_path):
+    # resources_per_worker without CPU must not deadlock (regression)
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.get_runtime()  # ensure init
+    import ray_tpu._private.worker as w
+
+    def loop():
+        train.report({"ok": 1})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1, resources_per_worker={"CPU": 1.0}),
+        run_config=RunConfig(storage_path=str(tmp_path), name="cpuonly"),
+    ).fit()
+    assert result.error is None

@@ -1,0 +1,118 @@
+"""Tuner tests. Parity: ``python/ray/tune/tests`` patterns (SURVEY.md §4)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train, tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import ASHAScheduler, MedianStoppingRule, TuneConfig, Tuner
+
+
+def test_grid_search(ray_start_regular, tmp_path):
+    def objective(config):
+        train.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        objective,
+        param_space={"a": tune.grid_search([1, 2]), "b": tune.grid_search([3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] == 24
+    assert best.metrics["config"] == {"a": 2, "b": 4}
+
+
+def test_random_sampling(ray_start_regular, tmp_path):
+    def objective(config):
+        train.report({"val": config["x"]})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(num_samples=3, seed=42),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    vals = [r.metrics["val"] for r in grid]
+    assert all(0 <= v <= 1 for v in vals)
+    assert len(set(vals)) == 3  # distinct samples
+
+
+def test_trial_error_isolated(ray_start_regular, tmp_path):
+    def objective(config):
+        if config["i"] == 1:
+            raise RuntimeError("trial exploded")
+        train.report({"ok": 1})
+
+    tuner = Tuner(
+        objective,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    ok = [r for r in grid if r.error is None]
+    assert len(ok) == 2
+
+
+def test_asha_stops_bad_trials(ray_start_regular, tmp_path):
+    import time
+
+    def objective(config):
+        for i in range(1, 21):
+            # bad trials have high loss and would run long if not stopped
+            train.report({"loss": config["q"] + i * 0.0})
+            time.sleep(0.02)
+
+    tuner = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=TuneConfig(
+            metric="loss",
+            mode="min",
+            scheduler=ASHAScheduler(
+                metric="loss", mode="min", grace_period=2, reduction_factor=4, max_t=20
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] == 1.0
+    # at least one of the worse trials was cut before max_t
+    iters = [r.metrics["training_iteration"] for r in grid]
+    assert min(iters) < 20
+
+
+def test_tuner_wraps_jax_trainer(ray_start_regular, tmp_path):
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        train.report({"loss": 100.0 - config["lr"]})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="inner"),
+    )
+    tuner = Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] == 98.0
+
+
+def test_median_stopping_rule():
+    rule = MedianStoppingRule(metric="loss", mode="min", grace_period=0, min_samples_required=2)
+    assert rule.on_result("a", 1, {"loss": 1.0}) == "CONTINUE"
+    assert rule.on_result("b", 1, {"loss": 1.2}) == "CONTINUE"
+    assert rule.on_result("c", 1, {"loss": 50.0}) == "STOP"
