@@ -1,0 +1,160 @@
+"""Python client for the C++ shm-arena object store.
+
+Same interface as ``ray_tpu._private.object_store.ObjectStoreClient``; the
+data path is the native arena (``ray_tpu/native/object_store.cc``), with the
+file-per-object store as fallback allocator when the arena is full (parity:
+plasma's fallback allocation to disk).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Dict, Optional, Set
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError, StorePutMixin
+
+
+class _Pin:
+    """Buffer object over an arena payload holding one store pin.
+
+    Deserialized numpy views keep the exporting memoryview — and therefore
+    this object — alive; GC of the last view releases the pin, letting the
+    store's deferred delete reclaim the block. This mirrors plasma's
+    client-held object references (``plasma_store_provider.h:88``): memory is
+    never reused under a live zero-copy view.
+    """
+
+    __slots__ = ("_lib", "_h", "_id", "_arr")
+
+    def __init__(self, lib, handle, id_bytes: bytes, base: int, off: int, size: int):
+        self._lib = lib
+        self._h = handle
+        self._id = id_bytes
+        self._arr = (ctypes.c_char * size).from_address(base + off)
+
+    def __buffer__(self, flags):
+        return memoryview(self._arr).cast("B")
+
+    def __del__(self):
+        try:
+            self._lib.rt_store_release(self._h, self._id)
+        except Exception:
+            pass
+
+
+class NativeStoreClient(StorePutMixin):
+    def __init__(self, lib, arena_path: str, fallback: ObjectStoreClient, capacity: int):
+        self._lib = lib
+        self._fallback = fallback
+        self._capacity = capacity
+        table_size = max(4096, min(1 << 20, capacity // (64 * 1024)))
+        self._h = lib.rt_store_open(arena_path.encode(), capacity, table_size, 1)
+        if not self._h:
+            raise OSError(f"could not open native store arena at {arena_path}")
+        self._base = lib.rt_store_base(self._h)
+        self._creating: Dict[ObjectID, bool] = {}  # id -> in_arena
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- helpers -----------------------------------------------------------
+
+    def _view(self, offset: int, size: int) -> memoryview:
+        buf = (ctypes.c_char * size).from_address(self._base + offset)
+        return memoryview(buf).cast("B")
+
+    # -- ObjectStoreClient interface --------------------------------------
+
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        err = ctypes.c_int(0)
+        off = self._lib.rt_store_create(self._h, oid.binary(), size, ctypes.byref(err))
+        if off:
+            with self._lock:
+                self._creating[oid] = True
+            return self._view(off, size)
+        if err.value == 1:
+            raise ValueError(f"object {oid.hex()} already exists")
+        # arena full: fall back to the file store
+        with self._lock:
+            self._creating[oid] = False
+        return self._fallback.create(oid, size)
+
+    def seal(self, oid: ObjectID) -> None:
+        with self._lock:
+            in_arena = self._creating.pop(oid, None)
+        if in_arena is None:
+            raise ValueError(f"object {oid.hex()} not under creation by this client")
+        if in_arena:
+            if self._lib.rt_store_seal(self._h, oid.binary()) != 0:
+                raise ValueError(f"seal({oid.hex()}) failed")
+        else:
+            self._fallback.seal(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        if self._lib.rt_store_contains(self._h, oid.binary()):
+            return True
+        return self._fallback.contains(oid)
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0001
+        while True:
+            size = ctypes.c_uint64(0)
+            off = self._lib.rt_store_get(self._h, oid.binary(), ctypes.byref(size))
+            if off:
+                # rt_store_get took a pin; the _Pin object carries it and the
+                # returned view (plus anything deserialized from it) keeps the
+                # pin alive — deletes defer until the last view is GC'd
+                pin = _Pin(self._lib, self._h, oid.binary(), self._base, off, size.value)
+                return memoryview(pin)
+            mv = self._fallback.get(oid, timeout=0)
+            if mv is not None:
+                return mv
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+
+    def release(self, oid: ObjectID) -> None:
+        # pins are GC-driven (see _Pin); only the fallback needs explicit release
+        self._fallback.release(oid)
+
+    def delete(self, oid: ObjectID) -> None:
+        if self._lib.rt_store_delete(self._h, oid.binary()) != 0:
+            self._fallback.delete(oid)
+
+    def usage_bytes(self) -> int:
+        return int(self._lib.rt_store_used_bytes(self._h)) + self._fallback.usage_bytes()
+
+    def list_objects(self):
+        return self._fallback.list_objects()  # arena listing: not yet exposed
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._fallback.close()
+        # NOTE: the arena mapping stays alive for the process lifetime so
+        # outstanding zero-copy views never dangle; rt_store_close is only
+        # safe when no views exist, so we deliberately leak the mapping here.
+
+
+def create_store_client(shm_dir: str, fallback_dir: str, capacity: int):
+    """Factory: native arena client if the .so is available, else files."""
+    import os
+
+    fallback = ObjectStoreClient(shm_dir, fallback_dir, capacity)
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+        return fallback
+    try:
+        from ray_tpu.native import load_native
+
+        lib = load_native()
+        if lib is None:
+            return fallback
+        arena_path = os.path.join(shm_dir, "arena")
+        return NativeStoreClient(lib, arena_path, fallback, capacity)
+    except Exception:
+        return fallback

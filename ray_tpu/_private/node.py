@@ -70,7 +70,9 @@ class Node:
         self.fallback_dir = config.spill_directory or os.path.join(self.session_dir, "spill")
         config.dump(os.path.join(self.session_dir, "config.json"))
 
-        self.store_client = ObjectStoreClient(
+        from ray_tpu._private.native_store import create_store_client
+
+        self.store_client = create_store_client(
             self.shm_dir, self.fallback_dir, config.object_store_memory
         )
 

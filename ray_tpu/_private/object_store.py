@@ -35,7 +35,25 @@ class StoreFullError(Exception):
     pass
 
 
-class ObjectStoreClient:
+class StorePutMixin:
+    """Shared idempotent put; both store clients implement create/seal/contains."""
+
+    def put_bytes(self, oid: ObjectID, data: bytes) -> None:
+        # idempotent: a retried task re-stores the same deterministic return
+        # id; object values are immutable so the first sealed copy wins
+        if self.contains(oid):
+            return
+        try:
+            buf = self.create(oid, len(data))
+        except ValueError:
+            if self.contains(oid):
+                return  # lost the race to a concurrent identical store
+            raise  # a live creator owns it, or an unreclaimable orphan: loud
+        buf[:] = data
+        self.seal(oid)
+
+
+class ObjectStoreClient(StorePutMixin):
     """Client handle to the shm store; safe to use from one process."""
 
     def __init__(self, shm_dir: str, fallback_dir: str, capacity: int):
@@ -67,6 +85,8 @@ class ObjectStoreClient:
 
     def create(self, oid: ObjectID, size: int) -> memoryview:
         """Allocate a writable buffer of ``size`` bytes; returns the data view."""
+        if self._find_sealed(oid) is not None:
+            raise ValueError(f"object {oid.hex()} already exists")
         total = _HEADER + size
         fallback = False
         path = self._path(oid, False)
@@ -84,6 +104,19 @@ class ObjectStoreClient:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
             os.ftruncate(fd, total)
         except FileExistsError:
+            # a .building file with no live writer (creator crashed between
+            # create and seal) is reclaimed after a grace period so retried
+            # tasks can re-store the deterministic return id
+            try:
+                age = time.time() - os.stat(path).st_mtime
+            except FileNotFoundError:
+                age = None
+            if age is not None and age > 10.0:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                return self.create(oid, size)
             raise ValueError(f"object {oid.hex()} already being created")
         m = mmap.mmap(fd, total)
         os.close(fd)
@@ -106,11 +139,6 @@ class ObjectStoreClient:
         os.rename(src, dst)
         with self._lock:
             self._maps[oid] = (m, mv, False)
-
-    def put_bytes(self, oid: ObjectID, data: bytes) -> None:
-        buf = self.create(oid, len(data))
-        buf[:] = data
-        self.seal(oid)
 
     def contains(self, oid: ObjectID) -> bool:
         return self._find_sealed(oid) is not None

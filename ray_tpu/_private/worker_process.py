@@ -4,20 +4,25 @@ Design parity: the reference worker = CoreWorker task execution path
 (``CoreWorker::ExecuteTask`` ``core_worker.cc:2906`` → Cython
 ``task_execution_handler`` ``python/ray/_raylet.pyx:2218``): receive task,
 resolve args (inline / shm / pull from owner), execute user code, write returns
-(small inline in the reply, large to the shm store), loop. Actor workers keep
-instance state between tasks and execute calls in submission order (parity:
-``ActorSchedulingQueue``).
+(small inline in the reply, large to the shm store), loop.
+
+Concurrency model: a dedicated reader thread demultiplexes the pipe (replies
+routed by request id, tasks onto an execution queue). Serial actors and normal
+tasks execute in submission order on the main thread (parity:
+``ActorSchedulingQueue``); actors created with ``max_concurrency > 1`` execute
+on a thread pool (parity: threaded actors /
+``out_of_order_actor_scheduling_queue.h`` + ``concurrency_group_manager.h``).
 """
 
 from __future__ import annotations
 
-import collections
-import os
 import pickle
+import queue
 import sys
 import threading
 import time
 import traceback
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -25,7 +30,7 @@ import cloudpickle
 from ray_tpu import exceptions as exc
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID, _Counter
-from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError
+from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, TaskSpec, TaskType
 
 
@@ -33,19 +38,33 @@ class WorkerRuntime:
     """Per-worker runtime; installed as the global runtime inside workers so
     ``ray_tpu.get/put/remote`` work from task code (nested tasks)."""
 
-    def __init__(self, conn, worker_id: WorkerID, store: ObjectStoreClient, config):
+    def __init__(self, conn, worker_id: WorkerID, store, config):
         self.conn = conn
         self.worker_id = worker_id
         self.store = store
         self.config = config
         self.serde = serialization.get_context()
-        self._inbox: collections.deque = collections.deque()
         self._req_counter = _Counter()
         self._actor_instance: Any = None
         self._actor_id = None
-        self.current_task_id: Optional[TaskID] = None
+        self._tls = threading.local()
         self._put_counter = _Counter()
         self._send_lock = threading.Lock()
+        # reader-thread demux state
+        self._responses: Dict[int, "queue.SimpleQueue"] = {}
+        self._responses_lock = threading.Lock()
+        self.exec_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._stopped = threading.Event()
+
+    # -- task context (per executing thread) ------------------------------
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._tls, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value):
+        self._tls.task_id = value
 
     # -- transport ---------------------------------------------------------
 
@@ -53,20 +72,38 @@ class WorkerRuntime:
         with self._send_lock:
             self.conn.send(msg)
 
-    def _recv(self, want_kind: str, req_id: Optional[int] = None, timeout=None):
-        """Receive the next message of ``want_kind`` (matching req_id),
-        buffering anything else (e.g. queued actor calls) in the inbox."""
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            remaining = None if deadline is None else max(0, deadline - time.monotonic())
-            if not self.conn.poll(remaining if remaining is not None else 1.0):
-                if deadline is not None and time.monotonic() >= deadline:
-                    return None
-                continue
-            msg = self.conn.recv()
-            if msg[0] == want_kind and (req_id is None or msg[1] == req_id):
-                return msg
-            self._inbox.append(msg)
+    def reader_loop(self):
+        """Runs on a dedicated thread: demultiplexes the pipe."""
+        try:
+            while True:
+                msg = self.conn.recv()
+                kind = msg[0]
+                if kind in ("pull_reply", "rpc_reply"):
+                    with self._responses_lock:
+                        q = self._responses.get(msg[1])
+                    if q is not None:
+                        q.put(msg)
+                elif kind == "exec":
+                    self.exec_queue.put(msg[1])
+                elif kind == "exit":
+                    break
+                # unknown messages dropped
+        except (EOFError, OSError):
+            pass
+        finally:
+            self._stopped.set()
+            self.exec_queue.put(None)
+
+    def _register_req(self) -> Tuple[int, "queue.SimpleQueue"]:
+        req_id = self._req_counter.next()
+        q: "queue.SimpleQueue" = queue.SimpleQueue()
+        with self._responses_lock:
+            self._responses[req_id] = q
+        return req_id, q
+
+    def _unregister_req(self, req_id: int):
+        with self._responses_lock:
+            self._responses.pop(req_id, None)
 
     # -- object plane ------------------------------------------------------
 
@@ -83,49 +120,61 @@ class WorkerRuntime:
         errs: Dict[ObjectID, bool] = {}
         missing = []
         for oid in oids:
+            if oid in out:
+                continue
             mv = self.store.get(oid, timeout=0)
             if mv is not None:
                 out[oid] = self.serde.deserialize_from(mv)
                 errs[oid] = False
             else:
                 missing.append(oid)
+        missing = list(dict.fromkeys(missing))
         if missing:
             self._send(("block_begin",))
+            req_id, q = self._register_req()
             try:
                 deadline = None if timeout is None else time.monotonic() + timeout
                 pending = set(missing)
+                self._send(("pull", req_id, missing))
+                # the scheduler always replies once immediately (inline values
+                # arrive only through that reply) — a user timeout shorter
+                # than the round-trip must not fail already-complete gets, so
+                # the deadline only applies after the initial reply
+                got_initial = False
+                initial_deadline = time.monotonic() + 30.0
                 while pending:
-                    req_id = self._req_counter.next()
-                    self._send(("pull", req_id, list(pending)))
-                    reply = self._recv("pull_reply", req_id)
-                    got_any = False
-                    for oid, entry in reply[2].items():
-                        if entry[0] == "pending":
-                            continue
-                        out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
-                        pending.discard(oid)
-                        got_any = True
-                    # a later pull_reply for a registered waiter may arrive
-                    while pending:
-                        mv = self.store.get(next(iter(pending)), timeout=0)
-                        if mv is None:
-                            break
-                        oid = next(iter(pending))
-                        out[oid] = self.serde.deserialize_from(mv)
-                        errs[oid] = False
-                        pending.discard(oid)
-                    if not pending:
-                        break
-                    if deadline is not None and time.monotonic() >= deadline:
-                        raise exc.GetTimeoutError(f"get timed out on {len(pending)} objects")
-                    if not got_any:
-                        msg = self._recv("pull_reply", None, timeout=0.2)
-                        if msg is not None:
-                            for oid, entry in msg[2].items():
-                                if oid in pending and entry[0] != "pending":
-                                    out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
-                                    pending.discard(oid)
+                    try:
+                        remaining = 0.2 if deadline is None else min(
+                            0.2, max(0.01, deadline - time.monotonic())
+                        )
+                        msg = q.get(timeout=remaining)
+                    except queue.Empty:
+                        msg = None
+                    if msg is not None:
+                        got_initial = True
+                        for oid, entry in msg[2].items():
+                            if oid in pending and entry[0] != "pending":
+                                out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
+                                pending.discard(oid)
+                    # objects can also appear directly in the store
+                    for oid in list(pending):
+                        mv = self.store.get(oid, timeout=0)
+                        if mv is not None:
+                            out[oid] = self.serde.deserialize_from(mv)
+                            errs[oid] = False
+                            pending.discard(oid)
+                    now = time.monotonic()
+                    if pending and deadline is not None and now >= deadline:
+                        if got_initial:
+                            raise exc.GetTimeoutError(
+                                f"get timed out on {len(pending)} objects"
+                            )
+                        if now >= initial_deadline:
+                            raise exc.GetTimeoutError("no reply from scheduler")
+                    if self._stopped.is_set():
+                        raise exc.RayTpuError("worker shutting down during get")
             finally:
+                self._unregister_req(req_id)
                 self._send(("block_end",))
         results = []
         for oid in oids:
@@ -151,28 +200,42 @@ class WorkerRuntime:
             return self.serde.deserialize_from(mv), False
         return exc.RayTpuError(f"bad entry {kind}"), True
 
+    def object_ready_local(self, oid: ObjectID) -> bool:
+        return self.store.contains(oid)
+
     def wait(self, oids, num_returns, timeout):
-        ready, not_ready = [], list(oids)
+        """One pull registration for the whole wait; readiness arrives via the
+        initial reply plus per-object follow-ups (no per-poll churn)."""
+        ready: List[ObjectID] = []
+        pending = list(dict.fromkeys(oids))
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            still = []
-            for oid in not_ready:
-                if self.store.contains(oid):
-                    ready.append(oid)
-                    continue
-                req_id = self._req_counter.next()
-                self._send(("pull", req_id, [oid]))
-                reply = self._recv("pull_reply", req_id)
-                if reply and reply[2][oid][0] != "pending":
-                    ready.append(oid)
-                else:
-                    still.append(oid)
-            not_ready = still
-            if len(ready) >= num_returns or not not_ready:
-                return ready[:num_returns], [o for o in oids if o not in ready[:num_returns]]
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready, not_ready
-            time.sleep(0.005)
+        req_id, q = self._register_req()
+        try:
+            self._send(("pull", req_id, pending))
+            pending = set(pending)
+            while True:
+                for oid in list(pending):
+                    if self.store.contains(oid):
+                        ready.append(oid)
+                        pending.discard(oid)
+                try:
+                    msg = q.get(timeout=0.05)
+                except queue.Empty:
+                    msg = None
+                if msg is not None:
+                    for oid, entry in msg[2].items():
+                        if oid in pending and entry[0] != "pending":
+                            ready.append(oid)
+                            pending.discard(oid)
+                if len(ready) >= num_returns or not pending:
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+        finally:
+            self._unregister_req(req_id)
+        sel = ready[:num_returns]
+        sel_set = set(sel)
+        return sel, [o for o in oids if o not in sel_set]
 
     def submit(self, spec: TaskSpec):
         arg_refs = spec.arg_ref_ids()
@@ -181,9 +244,14 @@ class WorkerRuntime:
         self._send(("submit", spec))
 
     def rpc(self, op: str, *args):
-        req_id = self._req_counter.next()
-        self._send(("rpc", req_id, op, args))
-        reply = self._recv("rpc_reply", req_id)
+        req_id, q = self._register_req()
+        try:
+            self._send(("rpc", req_id, op, args))
+            reply = q.get(timeout=30)
+        except queue.Empty:
+            raise exc.RayTpuError(f"rpc {op} timed out") from None
+        finally:
+            self._unregister_req(req_id)
         result = reply[2]
         if isinstance(result, Exception):
             raise result
@@ -274,7 +342,10 @@ class WorkerRuntime:
                 args, kwargs = self._resolve_args(spec)
                 if method_name == "__ray_terminate__":
                     self._send(("actor_exit",))
-                    sys.exit(0)
+                    # unblock the main loop (works from pool threads too,
+                    # where SystemExit would only kill the thread)
+                    self.exec_queue.put(None)
+                    return []
                 method = getattr(self._actor_instance, method_name)
                 result = method(*args, **kwargs)
             else:
@@ -321,39 +392,57 @@ class WorkerRuntime:
 def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, config_blob: bytes):
     """Entry point for spawned worker processes."""
     import ray_tpu._private.worker as worker_mod
+    from ray_tpu._private.native_store import create_store_client
 
     config = pickle.loads(config_blob)
     worker_id = WorkerID(worker_id_bin)
-    store = ObjectStoreClient(shm_dir, fallback_dir, config.object_store_memory)
+    store = create_store_client(shm_dir, fallback_dir, config.object_store_memory)
     rt = WorkerRuntime(conn, worker_id, store, config)
     worker_mod._set_worker_runtime(rt)
+
+    reader = threading.Thread(target=rt.reader_loop, name="reader", daemon=True)
+    reader.start()
     conn.send(("ready",))
+
+    pool: Optional[ThreadPoolExecutor] = None
+
+    def run_one(spec: TaskSpec):
+        try:
+            results = rt.execute(spec)
+        except SystemExit:
+            # sys.exit() in a threaded-actor task must still kill the worker
+            # (a pool future would swallow it and strand the caller)
+            try:
+                rt._send(("actor_exit",))
+            except (EOFError, OSError):
+                pass
+            rt.exec_queue.put(None)
+            return
+        try:
+            rt._send(("task_done", spec.task_id, results))
+        except (EOFError, OSError):
+            pass
+
     try:
         while True:
-            if rt._inbox:
-                msg = rt._inbox.popleft()
-            else:
-                try:
-                    msg = conn.recv()
-                except (EOFError, OSError):
-                    break
-            kind = msg[0]
-            if kind == "exec":
-                spec: TaskSpec = msg[1]
-                results = rt.execute(spec)
-                try:
-                    conn.send(("task_done", spec.task_id, results))
-                except (EOFError, OSError):
-                    break
-            elif kind == "exit":
+            spec = rt.exec_queue.get()
+            if spec is None:
                 break
-            elif kind == "pull_reply":
-                pass  # stale reply from a timed-out get; drop
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                run_one(spec)
+                if spec.max_concurrency > 1:
+                    pool = ThreadPoolExecutor(
+                        max_workers=spec.max_concurrency, thread_name_prefix="actor"
+                    )
+            elif spec.task_type == TaskType.ACTOR_TASK and pool is not None:
+                pool.submit(run_one, spec)
             else:
-                pass
+                run_one(spec)
     except SystemExit:
         pass
     finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
         store.close()
         try:
             conn.close()

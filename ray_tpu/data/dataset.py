@@ -180,7 +180,12 @@ class Dataset:
         slice from every source and permutes — no global materialization."""
         mat = self.materialize()
         k = max(1, len(mat._block_refs))
-        base = 0 if seed is None else int(seed)
+        if seed is None:
+            import os as _os
+
+            base = int.from_bytes(_os.urandom(4), "little")  # random per call
+        else:
+            base = int(seed)
         split_refs = [
             _shuffle_split.options(num_returns=k).remote(ref, k, base + i)
             for i, ref in enumerate(mat._block_refs)

@@ -1,0 +1,77 @@
+"""Native (C++) components, loaded via ctypes.
+
+Parity: the reference's C++ core (SURVEY.md §2.1). Built with ``make`` in this
+directory; pure-Python fallbacks exist for every component so the framework
+degrades gracefully on hosts without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB = None
+_LIB_TRIED = False
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libray_tpu_native.so")
+
+
+def _try_build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True, timeout=120
+        )
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def load_native():
+    """Returns the loaded CDLL or None (builds on first use if needed)."""
+    global _LIB, _LIB_TRIED
+    if _LIB is not None or _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    if not os.path.exists(_SO) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.rt_store_open.restype = ctypes.c_void_p
+    lib.rt_store_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.rt_store_close.argtypes = [ctypes.c_void_p]
+    lib.rt_store_create.restype = ctypes.c_uint64
+    lib.rt_store_create.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_get.restype = ctypes.c_uint64
+    lib.rt_store_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_used_bytes.restype = ctypes.c_uint64
+    lib.rt_store_used_bytes.argtypes = [ctypes.c_void_p]
+    lib.rt_store_num_objects.restype = ctypes.c_uint64
+    lib.rt_store_num_objects.argtypes = [ctypes.c_void_p]
+    lib.rt_store_base.restype = ctypes.c_void_p
+    lib.rt_store_base.argtypes = [ctypes.c_void_p]
+    lib.rt_store_capacity.restype = ctypes.c_uint64
+    lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB

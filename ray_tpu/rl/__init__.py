@@ -1,0 +1,15 @@
+"""Reinforcement learning library (RLlib equivalent, new-stack shape).
+
+Parity: ``rllib/`` (SURVEY.md §2.4) — ``Algorithm``/``AlgorithmConfig``
+(``algorithms/algorithm.py:229``), EnvRunner actors sampling episodes
+(``env/single_agent_env_runner.py:131``), a Learner holding the jitted update
+(``core/learner/``). The torch-DDP learner group
+(``torch_learner.py:397``) becomes one SPMD jit program; env runners stay CPU
+actors. In-tree algorithms: PPO (CartPole learning target: return >= 150,
+``tuned_examples/ppo/cartpole-ppo.yaml:5-7``).
+"""
+
+from ray_tpu.rl.env import CartPoleEnv, EnvSpec, make_env, register_env
+from ray_tpu.rl.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "CartPoleEnv", "make_env", "register_env", "EnvSpec"]

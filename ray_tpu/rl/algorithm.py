@@ -1,0 +1,88 @@
+"""Algorithm base + fluent config.
+
+Parity: ``rllib/algorithms/algorithm.py:229`` (Tune-Trainable shape:
+``train()`` returns a result dict; ``save``/``restore``) and the fluent
+``AlgorithmConfig`` (``algorithm_config.py``): ``.environment(...)``
+``.env_runners(...)`` ``.training(...)`` ``.build()``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env = "CartPole-v1"
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 16
+        self.rollout_len = 128
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.seed = 0
+        self.hidden = (64, 64)
+
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int = 0, num_envs_per_env_runner: int = 16,
+                    rollout_fragment_length: int = 128) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: int = 0) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def build(self):
+        raise NotImplementedError
+
+
+class Algorithm:
+    """Base: iteration counter, checkpointing, Tune-compatible train()."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        result = self.training_step()
+        result["training_iteration"] = self.iteration
+        return result
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as fh:
+            pickle.dump({"iteration": self.iteration, "state": self.get_state()}, fh)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as fh:
+            blob = pickle.load(fh)
+        self.iteration = blob["iteration"]
+        self.set_state(blob["state"])
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,124 @@
+"""EnvRunner: samples rollouts with the current policy.
+
+Parity: ``SingleAgentEnvRunner.sample`` (``rllib/env/single_agent_env_runner.py:131``)
+— remote actors (or a driver-local runner for ``num_env_runners=0``) stepping
+vectorized envs with jitted policy inference; the EnvRunnerGroup tolerates
+runner loss (``rllib/utils/actor_manager.py`` role).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class EnvRunner:
+    """Plain class; wrapped as a remote actor by EnvRunnerGroup."""
+
+    def __init__(self, env_creator, num_envs: int, rollout_len: int, seed: int):
+        import jax
+
+        from ray_tpu.rl.env import VectorEnv
+        from ray_tpu.rl.models import sample_actions
+
+        self._jax = jax
+        self.vec = VectorEnv(env_creator, num_envs, seed=seed)
+        self.rollout_len = rollout_len
+        self.obs = self.vec.reset()
+        self.key = jax.random.PRNGKey(seed)
+        self._sample_fn = jax.jit(sample_actions)
+        # per-env episode bookkeeping for return metrics
+        self._ep_return = np.zeros(num_envs)
+        self._completed: List[float] = []
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        jax = self._jax
+        T, N = self.rollout_len, self.vec.n
+        obs_buf = np.empty((T, N, self.obs.shape[-1]), np.float32)
+        act_buf = np.empty((T, N), np.int32)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), bool)
+        for t in range(T):
+            self.key, sub = jax.random.split(self.key)
+            actions, logp, value = self._sample_fn(params, self.obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rew, done = self.vec.step(actions)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._ep_return += rew
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        # bootstrap value for the final observation
+        self.key, sub = jax.random.split(self.key)
+        _, _, last_val = self._sample_fn(params, self.obs, sub)
+        episode_returns, self._completed = self._completed, []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_values": np.asarray(last_val),
+            "episode_returns": np.array(episode_returns, np.float32),
+        }
+
+
+RemoteEnvRunner = ray_tpu.remote(EnvRunner)
+
+
+class EnvRunnerGroup:
+    """num_env_runners remote runners, or one local (in-driver) runner."""
+
+    def __init__(self, env_creator, num_env_runners: int, num_envs_per_runner: int,
+                 rollout_len: int, seed: int = 0):
+        self.local: Optional[EnvRunner] = None
+        self.remote: List = []
+        if num_env_runners == 0:
+            self.local = EnvRunner(env_creator, num_envs_per_runner, rollout_len, seed)
+        else:
+            self.remote = [
+                RemoteEnvRunner.remote(
+                    env_creator, num_envs_per_runner, rollout_len, seed + 1000 * i
+                )
+                for i in range(num_env_runners)
+            ]
+
+    def sample(self, params) -> List[Dict[str, np.ndarray]]:
+        if self.local is not None:
+            return [self.local.sample(params)]
+        host_params = _to_host(params)
+        refs = [r.sample.remote(host_params) for r in self.remote]
+        out = []
+        for r, ref in zip(list(self.remote), refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=300))
+            except Exception:
+                # elastic sampling: drop the dead runner, keep the rest
+                self.remote.remove(r)
+        if not out:
+            raise RuntimeError("all env runners failed")
+        return out
+
+    def stop(self):
+        for r in self.remote:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+def _to_host(params):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), params)

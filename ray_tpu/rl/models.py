@@ -1,0 +1,48 @@
+"""RL policy/value networks (pure jax).
+
+Parity: RLlib's ``RLModule`` role (``rllib/core/rl_module/``) — the
+policy+value function behind both sampling and learning, with explicit params
+so env runners and learners exchange plain pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_policy(key, obs_dim: int, num_actions: int, hidden: Tuple[int, ...] = (64, 64)):
+    sizes = (obs_dim,) + tuple(hidden)
+    params = {"layers": [], "pi": None, "vf": None}
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i in range(len(hidden)):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * jnp.sqrt(2.0 / sizes[i])
+        params["layers"].append({"w": w, "b": jnp.zeros(sizes[i + 1])})
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (sizes[-1], num_actions)) * 0.01,
+        "b": jnp.zeros(num_actions),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+        "b": jnp.zeros(1),
+    }
+    return params
+
+
+def apply_mlp_policy(params, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    h = obs
+    for layer in params["layers"]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def sample_actions(params, obs, key):
+    logits, value = apply_mlp_policy(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), actions]
+    return actions, logp, value

@@ -1,0 +1,33 @@
+"""Model serving library (Ray Serve equivalent).
+
+Parity: ``python/ray/serve`` (SURVEY.md §2.4, §3.5) — control plane:
+``ServeController`` actor reconciling deployments into replica actors
+(``_private/controller.py:86``, ``deployment_state.py``); data plane:
+``DeploymentHandle`` → power-of-two-choices replica routing
+(``pow_2_scheduler.py:49``) → replica actors (threaded for concurrent
+requests); HTTP proxy actor; dynamic batching (``batching.py``); model
+composition via ``.bind()``.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    deployment,
+    get_app_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "run",
+    "shutdown",
+    "delete",
+    "status",
+    "get_app_handle",
+    "batch",
+    "DeploymentHandle",
+    "DeploymentResponse",
+]

@@ -1,0 +1,104 @@
+"""HTTP proxy actor.
+
+Parity: ``python/ray/serve/_private/proxy.py`` — per-cluster HTTP ingress
+routing requests to application handles. The reference embeds uvicorn; here a
+stdlib ThreadingHTTPServer runs inside a threaded actor (no extra deps), with
+JSON request/response bodies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+import ray_tpu
+
+_PROXY_NAME = "SERVE_PROXY"
+DEFAULT_PORT = 8700
+
+
+class _NoRouteError(Exception):
+    """Distinguishes route misses from user KeyErrors (which must be 500s)."""
+
+
+@ray_tpu.remote(max_concurrency=16)
+class HTTPProxy:
+    def __init__(self, port: int = DEFAULT_PORT):
+        self.routes: Dict[str, str] = {}  # route_prefix -> app name
+        self._handles: Dict[str, object] = {}
+        self.port = port
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _dispatch(self):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    payload = json.loads(body) if body else None
+                    result = proxy._route(self.path, payload)
+                    blob = json.dumps({"result": result}, default=str).encode()
+                    self.send_response(200)
+                except _NoRouteError:
+                    blob = json.dumps({"error": f"no route for {self.path}"}).encode()
+                    self.send_response(404)
+                except Exception as e:  # noqa: BLE001
+                    blob = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _route(self, path: str, payload):
+        for prefix, app in sorted(self.routes.items(), key=lambda kv: -len(kv[0])):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                handle = self._handles[app]
+                if payload is None:
+                    resp = handle.remote()
+                else:
+                    resp = handle.remote(payload)
+                return resp.result(timeout_s=120)
+        raise _NoRouteError(path)
+
+    def add_route(self, route_prefix: str, app_name: str, handle):
+        self.routes[route_prefix] = app_name
+        self._handles[app_name] = handle
+        return self.port
+
+    def remove_route(self, route_prefix: str):
+        app = self.routes.pop(route_prefix, None)
+        if app:
+            self._handles.pop(app, None)
+        return True
+
+    def address(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.port)
+
+
+def ensure_proxy(controller, app_name: str, route_prefix: str, port: int = DEFAULT_PORT):
+    from ray_tpu.serve.api import get_app_handle
+
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+    except ValueError:
+        try:
+            proxy = HTTPProxy.options(name=_PROXY_NAME, num_cpus=0).remote(port)
+        except ValueError:
+            proxy = ray_tpu.get_actor(_PROXY_NAME)
+    handle = get_app_handle(app_name)
+    ray_tpu.get(proxy.add_route.remote(route_prefix, app_name, handle), timeout=60)
+    return proxy

@@ -1,0 +1,41 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Parity: ``python/ray/serve/_private/replica.py`` — executes requests against
+the user class/function; threaded (``max_concurrency = max_ongoing_requests``)
+so concurrent requests overlap; exposes a health-check probe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import cloudpickle
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Replica:
+    def __init__(self, callable_blob: bytes, init_args, init_kwargs):
+        # nested DeploymentHandles (model composition) arrive pre-resolved
+        # inside init_args/kwargs
+        target = cloudpickle.loads(callable_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        elif init_args or init_kwargs:
+            import functools
+
+            self._callable = functools.partial(target, *init_args, **init_kwargs)
+        else:
+            self._callable = target
+
+    def handle_request(self, method: str, args: List, kwargs: Dict):
+        if method == "__call__":
+            return self._callable(*args, **kwargs)
+        return getattr(self._callable, method)(*args, **kwargs)
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True

@@ -1,0 +1,343 @@
+"""Serve public API: deployments, applications, run/shutdown.
+
+Parity: ``python/ray/serve/api.py`` (``serve.run`` ``:535``) +
+``ServeController`` (``_private/controller.py:86``): a detached named
+controller actor owns the deployment table and reconciles replica actors
+(restart on death); ``.bind()`` builds composition graphs whose nested nodes
+become DeploymentHandles (``deployment_graph_build.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve._replica import Replica
+from ray_tpu.serve.handle import DeploymentHandle
+
+_CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclass
+class Application:
+    """A bound deployment graph node."""
+
+    deployment: "Deployment"
+    args: tuple
+    kwargs: dict
+
+
+class Deployment:
+    def __init__(self, target, *, name=None, num_replicas=1, max_ongoing_requests=8,
+                 ray_actor_options=None, health_check_period_s=5.0):
+        self._target = target
+        self.name = name or getattr(target, "__name__", "deployment")
+        self.num_replicas = num_replicas
+        self.max_ongoing_requests = max_ongoing_requests
+        self.ray_actor_options = ray_actor_options or {}
+        self.health_check_period_s = health_check_period_s
+
+    def options(self, **updates) -> "Deployment":
+        new = Deployment(
+            self._target,
+            name=updates.get("name", self.name),
+            num_replicas=updates.get("num_replicas", self.num_replicas),
+            max_ongoing_requests=updates.get("max_ongoing_requests", self.max_ongoing_requests),
+            ray_actor_options=updates.get("ray_actor_options", self.ray_actor_options),
+        )
+        return new
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def spec(self) -> dict:
+        return {
+            "name": self.name,
+            "callable_blob": cloudpickle.dumps(self._target),
+            "num_replicas": self.num_replicas,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "ray_actor_options": self.ray_actor_options,
+        }
+
+
+def deployment(target=None, **options):
+    """``@serve.deployment`` decorator (parity: ``api.py``)."""
+    if target is not None and callable(target):
+        return Deployment(target)
+
+    def wrap(t):
+        return Deployment(t, **options)
+
+    return wrap
+
+
+@ray_tpu.remote(max_concurrency=8)
+class ServeController:
+    """Control plane: deployment table + replica reconciliation."""
+
+    def __init__(self):
+        import threading
+
+        # app -> deployment name -> {spec, replicas: [handles]}
+        self.apps: Dict[str, Dict[str, dict]] = {}
+        self._stop = False
+        # guards self.apps mutations against the reconciler thread (this actor
+        # is threaded, so handlers run concurrently)
+        self._lock = threading.Lock()
+        self._reconciler = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._reconciler.start()
+
+    # -- deploy ------------------------------------------------------------
+
+    def deploy_application(self, app_name: str, specs: List[dict], edges: Dict[str, List]):
+        """specs are topologically ordered; edges[name] = list of
+        (arg_index_or_kwarg, child_name) to replace with handles."""
+        deployments: Dict[str, dict] = {}
+        handles: Dict[str, DeploymentHandle] = {}
+        for spec in specs:
+            name = spec["name"]
+            init_args = list(spec["init_args"])
+            init_kwargs = dict(spec["init_kwargs"])
+            for key, child in edges.get(name, []):
+                if isinstance(key, int):
+                    init_args[key] = handles[child]
+                else:
+                    init_kwargs[key] = handles[child]
+            replicas = self._start_replicas(spec, init_args, init_kwargs)
+            deployments[name] = {
+                "spec": spec,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "replicas": replicas,
+            }
+            handles[name] = DeploymentHandle(name, app_name, replicas)
+        # tear down a previous version of the app
+        with self._lock:
+            old = self.apps.get(app_name)
+            self.apps[app_name] = deployments
+        if old:
+            self._teardown(old)
+        return True
+
+    def _start_replicas(self, spec: dict, init_args, init_kwargs):
+        opts = dict(spec["ray_actor_options"])
+        replicas = []
+        for _ in range(spec["num_replicas"]):
+            r = Replica.options(
+                max_concurrency=spec["max_ongoing_requests"],
+                num_cpus=opts.get("num_cpus", 0.0),
+                num_tpus=opts.get("num_tpus", 0.0),
+                resources=opts.get("resources"),
+            ).remote(spec["callable_blob"], init_args, init_kwargs)
+            replicas.append(r)
+        # wait until they respond (surface init errors early)
+        ray_tpu.get([r.check_health.remote() for r in replicas], timeout=120)
+        return replicas
+
+    def _teardown(self, deployments: Dict[str, dict]):
+        for d in deployments.values():
+            for r in d["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+
+    # -- data-plane discovery ---------------------------------------------
+
+    def get_handle_info(self, app_name: str, deployment_name: Optional[str] = None):
+        app = self.apps.get(app_name)
+        if app is None:
+            return None
+        if deployment_name is None:
+            deployment_name = next(reversed(app))  # ingress = last deployed
+        d = app.get(deployment_name)
+        if d is None:
+            return None
+        return (deployment_name, d["replicas"])
+
+    def status(self):
+        return {
+            app: {
+                name: {
+                    "num_replicas": len(d["replicas"]),
+                    "target": d["spec"]["num_replicas"],
+                }
+                for name, d in deps.items()
+            }
+            for app, deps in self.apps.items()
+        }
+
+    def delete_application(self, app_name: str):
+        with self._lock:
+            app = self.apps.pop(app_name, None)
+        if app:
+            self._teardown(app)
+        return True
+
+    def shutdown_all(self):
+        self._stop = True
+        for app in list(self.apps):
+            self.delete_application(app)
+        return True
+
+    # -- reconciliation (parity: DeploymentState reconcile loop) ----------
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            time.sleep(1.0)
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+
+    def _reconcile_once(self):
+        with self._lock:
+            snapshot = list(self.apps.items())
+        for app_name, deployments in snapshot:
+            for name, d in deployments.items():
+                alive = []
+                for r in list(d["replicas"]):
+                    try:
+                        ray_tpu.get(r.check_health.remote(), timeout=10)
+                        alive.append(r)
+                    except Exception:
+                        pass
+                want = d["spec"]["num_replicas"]
+                fresh = []
+                if len(alive) < want:
+                    fresh = self._start_replicas(
+                        {**d["spec"], "num_replicas": want - len(alive)},
+                        d["init_args"],
+                        d["init_kwargs"],
+                    )
+                # only commit if this app/deployment is still current —
+                # a concurrent redeploy/delete must not get replicas
+                # resurrected into its orphaned table
+                with self._lock:
+                    current = self.apps.get(app_name)
+                    if current is not None and current.get(name) is d:
+                        d["replicas"] = alive + fresh
+                    else:
+                        for r in fresh:
+                            try:
+                                ray_tpu.kill(r)
+                            except Exception:
+                                pass
+
+
+# --------------------------------------------------------------------------
+# module-level API
+# --------------------------------------------------------------------------
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        pass
+    try:
+        return ServeController.options(name=_CONTROLLER_NAME, num_cpus=0).remote()
+    except ValueError:
+        return ray_tpu.get_actor(_CONTROLLER_NAME)
+
+
+def _flatten_graph(app: Application):
+    """DFS the bound graph; returns (ordered specs, edges)."""
+    specs: List[dict] = []
+    edges: Dict[str, List] = {}
+    seen: Dict[int, str] = {}
+
+    def visit(node: Application) -> str:
+        if id(node) in seen:
+            return seen[id(node)]
+        name = node.deployment.name
+        my_edges = []
+        args = []
+        for i, a in enumerate(node.args):
+            if isinstance(a, Application):
+                child = visit(a)
+                my_edges.append((i, child))
+                args.append(None)
+            else:
+                args.append(a)
+        kwargs = {}
+        for k, v in node.kwargs.items():
+            if isinstance(v, Application):
+                child = visit(v)
+                my_edges.append((k, child))
+                kwargs[k] = None
+            else:
+                kwargs[k] = v
+        spec = node.deployment.spec()
+        spec["init_args"] = args
+        spec["init_kwargs"] = kwargs
+        specs.append(spec)
+        edges[name] = my_edges
+        seen[id(node)] = name
+        return name
+
+    visit(app)
+    return specs, edges
+
+
+def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects a bound deployment: use .bind()")
+    controller = _get_or_create_controller()
+    specs, edges = _flatten_graph(app)
+    ray_tpu.get(controller.deploy_application.remote(name, specs, edges), timeout=180)
+    if route_prefix is not None:
+        from ray_tpu.serve._proxy import ensure_proxy
+
+        ensure_proxy(controller, name, route_prefix)
+    return get_app_handle(name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_handle_info.remote(name), timeout=60)
+    if info is None:
+        raise ValueError(f"no serve application named '{name}'")
+    dep_name, replicas = info
+    return DeploymentHandle(dep_name, name, replicas)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    info = ray_tpu.get(
+        controller.get_handle_info.remote(app_name, deployment_name), timeout=60
+    )
+    if info is None:
+        raise ValueError(f"no deployment '{deployment_name}' in app '{app_name}'")
+    dep_name, replicas = info
+    return DeploymentHandle(dep_name, app_name, replicas)
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    return ray_tpu.get(controller.status.remote(), timeout=60)
+
+
+def delete(name: str):
+    controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,112 @@
+"""DeploymentHandle: the data-plane RPC handle between callers and replicas.
+
+Parity: ``python/ray/serve/handle.py`` + the power-of-two-choices replica
+scheduler (``replica_scheduler/pow_2_scheduler.py:49``): pick two random
+replicas, send to the one with fewer requests outstanding *from this handle*
+(queue-length probes are local bookkeeping here — replicas are threaded actors
+so accepted requests run concurrently).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future for one deployment call (parity: ``DeploymentResponse``)."""
+
+    def __init__(self, ref: ray_tpu.ObjectRef, on_done=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._settled = False
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+        return value
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            if self._on_done:
+                self._on_done()
+
+    def __del__(self):
+        # fire-and-forget callers never call result(); settle on GC so the
+        # replica's outstanding counter doesn't inflate forever
+        try:
+            self._settle()
+        except Exception:
+            pass
+
+    def _to_object_ref(self) -> ray_tpu.ObjectRef:
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str, replicas: List[Any]):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._replicas = list(replicas)
+        self._outstanding: Dict[int, int] = {i: 0 for i in range(len(replicas))}
+        self._lock = threading.Lock()
+
+    def _update_replicas(self, replicas: List[Any]):
+        with self._lock:
+            self._replicas = list(replicas)
+            self._outstanding = {i: 0 for i in range(len(replicas))}
+
+    def _pick(self) -> int:
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name} has no replicas"
+                )
+            if n == 1:
+                return 0
+            i, j = random.sample(range(n), 2)
+            return i if self._outstanding[i] <= self._outstanding[j] else j
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        idx = self._pick()
+        with self._lock:
+            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+            replica = self._replicas[idx]
+
+        def done():
+            with self._lock:
+                if idx in self._outstanding:
+                    self._outstanding[idx] -= 1
+
+        ref = replica.handle_request.remote(method, list(args), dict(kwargs))
+        return DeploymentResponse(ref, on_done=done)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def options(self, **_ignored) -> "DeploymentHandle":
+        return self
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name, self._replicas))

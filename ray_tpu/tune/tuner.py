@@ -104,14 +104,23 @@ class Tuner:
         if hasattr(t, "train_loop"):
             def run_trainer(config):
                 import copy
+                import dataclasses
+
+                from ray_tpu.train._session import get_context, report
 
                 trainer = copy.copy(t)
                 trainer.train_loop_config = {**(t.train_loop_config or {}), **config}
+                # each trial gets its own storage dir — a shared inner
+                # run_config would make concurrent trials prune each other's
+                # checkpoints
+                trial_dir = get_context().get_trial_dir()
+                if trial_dir:
+                    trainer.run_config = dataclasses.replace(
+                        t.run_config, storage_path=trial_dir, name="trainer"
+                    )
                 result = trainer.fit()
                 if result.error is not None:
                     raise result.error
-                from ray_tpu.train._session import report
-
                 report(result.metrics)
             return run_trainer
         raise TypeError(f"unsupported trainable {type(t)}")

@@ -1,0 +1,163 @@
+"""Serve tests. Parity: ``python/ray/serve/tests`` patterns (SURVEY.md §4)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def echo(payload=None):
+        return {"echo": payload}
+
+    handle = serve.run(echo.bind(), name="echo_app")
+    assert handle.remote({"x": 1}).result(timeout_s=60) == {"echo": {"x": 1}}
+
+
+def test_class_deployment_and_methods(serve_cluster):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def __call__(self, k=1):
+            self.v += k
+            return self.v
+
+        def value(self):
+            return self.v
+
+    handle = serve.run(Counter.bind(10), name="counter_app")
+    assert handle.remote(5).result(timeout_s=60) == 15
+    assert handle.value.remote().result(timeout_s=60) == 15
+
+
+def test_multiple_replicas_spread_load(serve_cluster):
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self):
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind(), name="pids")
+    pids = {handle.remote().result(timeout_s=60) for _ in range(20)}
+    assert len(pids) == 2
+
+
+def test_model_composition(serve_cluster):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout_s=60)
+            return y + 1
+
+    handle = serve.run(Model.bind(Preprocess.bind()), name="composed")
+    assert handle.remote(10).result(timeout_s=60) == 21
+
+
+def test_replica_death_reconciled(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self):
+            return "alive"
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind(), name="fragile")
+    assert handle.remote().result(timeout_s=60) == "alive"
+    try:
+        handle.die.remote().result(timeout_s=30)
+    except Exception:
+        pass
+    # reconciler restarts the replica within a few seconds
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            fresh = serve.get_app_handle("fragile")
+            assert fresh.remote().result(timeout_s=30) == "alive"
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        pytest.fail("replica was not restarted")
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    def double(payload=None):
+        return {"doubled": payload["x"] * 2}
+
+    serve.run(double.bind(), name="http_app", route_prefix="/double")
+    req = urllib.request.Request(
+        "http://127.0.0.1:8700/double",
+        data=json.dumps({"x": 21}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert body["result"]["doubled"] == 42
+    # 404 on unknown route
+    try:
+        urllib.request.urlopen("http://127.0.0.1:8700/nope", timeout=30)
+        pytest.fail("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(max_ongoing_requests=8)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), name="batched")
+    responses = [handle.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout_s=60) for r in responses)
+    assert out == [0, 10, 20, 30, 40, 50, 60, 70]
+    sizes = handle.sizes.remote().result(timeout_s=60)
+    assert max(sizes) > 1  # batching actually coalesced requests
+
+
+def test_status_and_delete(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    def f(p=None):
+        return 1
+
+    serve.run(f.bind(), name="stat_app")
+    st = serve.status()
+    assert st["stat_app"]["f"]["num_replicas"] == 2
+    serve.delete("stat_app")
+    with pytest.raises(ValueError):
+        serve.get_app_handle("stat_app")
