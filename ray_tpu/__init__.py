@@ -22,6 +22,7 @@ from ray_tpu._private.worker import (
 )
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill
 from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
 
@@ -36,6 +37,7 @@ __all__ = [
     "kill",
     "cancel",
     "get_actor",
+    "get_runtime_context",
     "ObjectRef",
     "ObjectRefGenerator",
     "ActorClass",

@@ -1062,6 +1062,71 @@ class Scheduler:
         if op == "pg_state":
             pg = self.placement_groups.get(args[0])
             return None if pg is None else pg.state
+        if op == "list_tasks":
+            return [
+                {
+                    "task_id": t.spec.task_id.hex(),
+                    "name": t.spec.name,
+                    "type": t.spec.task_type.name,
+                    "state": t.state,
+                    "worker_id": t.worker_id.hex() if t.worker_id else None,
+                    "retries_left": t.retries_left,
+                }
+                for t in list(self.tasks.values())
+            ]
+        if op == "list_actors":
+            return [
+                {
+                    "actor_id": a.actor_id.hex(),
+                    "state": a.state,
+                    "name": a.name,
+                    "namespace": a.namespace,
+                    "pending_calls": len(a.pending_calls),
+                    "restarts_left": a.restarts_left,
+                }
+                for a in list(self.actors.values())
+            ]
+        if op == "list_workers":
+            return [
+                {
+                    "worker_id": w.worker_id.hex(),
+                    "node_id": w.node_id.hex(),
+                    "state": w.state,
+                    "actor_id": w.actor_id.hex() if w.actor_id else None,
+                    "pid": w.proc.pid if w.proc is not None else None,
+                }
+                for w in list(self.workers.values())
+            ]
+        if op == "list_placement_groups":
+            return [
+                {
+                    "placement_group_id": pg.pg_id.hex(),
+                    "state": pg.state,
+                    "strategy": pg.strategy,
+                    "bundles": pg.bundles,
+                    "name": pg.name,
+                }
+                for pg in list(self.placement_groups.values())
+            ]
+        if op == "list_objects":
+            store = self._node.store_client
+            out = []
+            if store is not None:
+                for oid, size in store.list_objects():
+                    out.append(
+                        {
+                            "object_id": oid.hex(),
+                            "size_bytes": size,
+                            "ref_count": self._ref_counts.get(oid, 0),
+                        }
+                    )
+            return out
+        if op == "summarize_tasks":
+            summary: Dict[str, Dict[str, int]] = {}
+            for t in list(self.tasks.values()):
+                row = summary.setdefault(t.spec.name or "unnamed", {})
+                row[t.state] = row.get(t.state, 0) + 1
+            return summary
         if op == "list_nodes":
             return [
                 {

@@ -1,0 +1,198 @@
+"""Lazy DAG API + compiled execution.
+
+Parity: ``python/ray/dag`` — ``.bind()`` builds ``FunctionNode`` /
+``ClassNode`` / ``ClassMethodNode`` / ``InputNode`` graphs (``dag_node.py``),
+``.execute()`` walks them; ``experimental_compile`` returns a ``CompiledDAG``
+(``compiled_dag_node.py:391``).
+
+TPU-native compiled path: where the reference lowers compiled DAGs to mutable
+plasma channels + NCCL p2p, stages that are pure jax functions fuse into ONE
+jitted XLA program (``compile_jax_pipeline``) so inter-stage edges become
+in-program values on-device — the aDAG analogue described in SURVEY.md §2.3.
+Non-fusable (stateful-actor) stages run as pre-planned actor calls with the
+object store carrying edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    def execute(self, *input_args, **input_kwargs):
+        return _execute(self, input_args, input_kwargs, {})
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value supplied at ``execute()`` time."""
+
+    def __init__(self, index: int = 0):
+        self.index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key):
+        self.parent = parent
+        self.key = key
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        self.fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ClassNode(DAGNode):
+    """A bound actor constructor; instantiated once per executing DAG."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def bind_method(self, name):
+        raise AttributeError(name)
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name in ("actor_cls", "args", "kwargs"):
+            raise AttributeError(name)
+
+        class _M:
+            def __init__(_s, node, method):
+                _s.node = node
+                _s.method = method
+
+            def bind(_s, *args, **kwargs):
+                return BoundClassMethodNode(_s.node, _s.method, args, kwargs)
+
+        return _M(self, name)
+
+
+class BoundClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        self.class_node = class_node
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ClassMethodNode(DAGNode):
+    """Method bind on an existing actor handle."""
+
+    def __init__(self, handle, method: str, args, kwargs):
+        self.handle = handle
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+
+def _execute(node, input_args, input_kwargs, memo: Dict[int, Any]):
+    """Post-order walk; returns an ObjectRef (or plain value for inputs)."""
+    if id(node) in memo:
+        return memo[id(node)]
+
+    def rec(v):
+        if isinstance(v, DAGNode):
+            return _execute(v, input_args, input_kwargs, memo)
+        return v
+
+    if isinstance(node, InputNode):
+        result = input_args[node.index] if input_args else None
+    elif isinstance(node, InputAttributeNode):
+        base = rec(node.parent)
+        if isinstance(base, ray_tpu.ObjectRef):
+            base = ray_tpu.get(base)
+        result = base[node.key]
+    elif isinstance(node, FunctionNode):
+        args = [rec(a) for a in node.args]
+        kwargs = {k: rec(v) for k, v in node.kwargs.items()}
+        result = node.fn.remote(*args, **kwargs)
+    elif isinstance(node, ClassNode):
+        args = [rec(a) for a in node.args]
+        kwargs = {k: rec(v) for k, v in node.kwargs.items()}
+        result = node.actor_cls.remote(*args, **kwargs)
+    elif isinstance(node, BoundClassMethodNode):
+        handle = rec(node.class_node)
+        args = [rec(a) for a in node.args]
+        kwargs = {k: rec(v) for k, v in node.kwargs.items()}
+        result = getattr(handle, node.method).remote(*args, **kwargs)
+    elif isinstance(node, ClassMethodNode):
+        args = [rec(a) for a in node.args]
+        kwargs = {k: rec(v) for k, v in node.kwargs.items()}
+        result = getattr(node.handle, node.method).remote(*args, **kwargs)
+    else:
+        raise TypeError(f"unknown DAG node {type(node)}")
+    memo[id(node)] = result
+    return result
+
+
+class CompiledDAG:
+    """Pre-planned execution: actors in the graph are instantiated once and
+    reused across ``execute()`` calls (the reference's compiled DAGs likewise
+    pin actors + channels; here edges ride the object store)."""
+
+    def __init__(self, output_node: DAGNode):
+        self.output = output_node
+        self._actor_cache: Dict[int, Any] = {}
+        self._instantiate_actors(output_node)
+
+    def _instantiate_actors(self, node):
+        if isinstance(node, ClassNode) and id(node) not in self._actor_cache:
+            args = [a for a in node.args if not isinstance(a, DAGNode)]
+            kwargs = {k: v for k, v in node.kwargs.items() if not isinstance(v, DAGNode)}
+            self._actor_cache[id(node)] = node.actor_cls.remote(*args, **kwargs)
+        for child in _children(node):
+            self._instantiate_actors(child)
+
+    def execute(self, *input_args, **input_kwargs):
+        memo = {nid: handle for nid, handle in self._actor_cache.items()}
+        return _execute(self.output, input_args, input_kwargs, memo)
+
+    def teardown(self):
+        for handle in self._actor_cache.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+
+
+def _children(node) -> List[DAGNode]:
+    out = []
+    for attr in ("args", "kwargs", "class_node", "parent"):
+        v = getattr(node, attr, None)
+        if isinstance(v, DAGNode):
+            out.append(v)
+        elif isinstance(v, (list, tuple)):
+            out.extend(x for x in v if isinstance(x, DAGNode))
+        elif isinstance(v, dict):
+            out.extend(x for x in v.values() if isinstance(x, DAGNode))
+    return out
+
+
+def compile_jax_pipeline(stages, donate: bool = False):
+    """Fuse a chain of pure-jax stage functions into one jitted program.
+
+    The TPU-native compiled-DAG fast path: stage boundaries become in-program
+    values (XLA schedules/overlaps them; on a sharded mesh the edges lower to
+    ICI transfers), instead of host round-trips through the object store.
+    """
+    import jax
+
+    def fused(x):
+        for stage in stages:
+            x = stage(x)
+        return x
+
+    return jax.jit(fused, donate_argnums=(0,) if donate else ())

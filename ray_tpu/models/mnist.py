@@ -1,0 +1,81 @@
+"""MNIST-scale models (MLP + small CNN) for the DP benchmark config.
+
+Parity target: BASELINE.json config #2 "Ray Train MNIST -> JaxTrainer
+(4-chip DP)". Pure-jax params/apply so the same code runs the 8-device CPU
+test mesh and real chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, hidden: Tuple[int, ...] = (128, 128), num_classes: int = 10,
+             input_dim: int = 784) -> Dict:
+    sizes = (input_dim,) + tuple(hidden) + (num_classes,)
+    keys = jax.random.split(key, len(sizes) - 1)
+    return {
+        "layers": [
+            {
+                "w": jax.random.normal(k, (sizes[i], sizes[i + 1]))
+                * jnp.sqrt(2.0 / sizes[i]),
+                "b": jnp.zeros(sizes[i + 1]),
+            }
+            for i, k in enumerate(keys)
+        ]
+    }
+
+
+def apply_mlp(params: Dict, x: jax.Array) -> jax.Array:
+    h = x.reshape(x.shape[0], -1)
+    for i, layer in enumerate(params["layers"]):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params["layers"]) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def init_cnn(key, num_classes: int = 10) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "conv1": jax.random.normal(k1, (3, 3, 1, 16)) * 0.1,
+        "conv2": jax.random.normal(k2, (3, 3, 16, 32)) * 0.1,
+        "fc1": {
+            "w": jax.random.normal(k3, (7 * 7 * 32, 128)) * 0.02,
+            "b": jnp.zeros(128),
+        },
+        "fc2": {
+            "w": jax.random.normal(k4, (128, num_classes)) * 0.02,
+            "b": jnp.zeros(num_classes),
+        },
+    }
+
+
+def apply_cnn(params: Dict, x: jax.Array) -> jax.Array:
+    """x: (B, 28, 28, 1) -> logits (B, 10)."""
+    h = jax.lax.conv_general_dilated(
+        x, params["conv1"], (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    h = jax.lax.conv_general_dilated(
+        h, params["conv2"], (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    h = h.reshape(h.shape[0], -1)
+    h = jax.nn.relu(h @ params["fc1"]["w"] + params["fc1"]["b"])
+    return h @ params["fc2"]["w"] + params["fc2"]["b"]
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

@@ -1,0 +1,98 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Absent from the reference (SURVEY.md §2.3: expert parallel row — "absent");
+first-class here: GShard-style top-k gating with capacity, dispatch/combine
+einsums whose expert dimension shards over the ``expert`` mesh axis — GSPMD
+lowers the dispatch to all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 128
+    d_ff: int = 512
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Tuple = jnp.float32
+
+
+def init_moe_params(key, cfg: MoEConfig) -> Dict[str, jax.Array]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(cfg.d_model)
+    scale_out = 1.0 / jnp.sqrt(cfg.d_ff)
+    return {
+        "router": (jax.random.normal(k1, (cfg.d_model, cfg.num_experts)) * scale_in).astype(cfg.dtype),
+        "w_in": (jax.random.normal(k2, (cfg.num_experts, cfg.d_model, cfg.d_ff)) * scale_in).astype(cfg.dtype),
+        "w_out": (jax.random.normal(k3, (cfg.num_experts, cfg.d_ff, cfg.d_model)) * scale_out).astype(cfg.dtype),
+    }
+
+
+def moe_param_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "router": ("embed", None),
+        "w_in": ("expert", "embed", "mlp"),
+        "w_out": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_mlp(params: Dict[str, jax.Array], x: jax.Array, cfg: MoEConfig):
+    """x: (B, S, D) -> (y (B, S, D), aux_loss).
+
+    GShard dispatch: tokens are routed to their top-k experts with a per-
+    expert capacity; overflow tokens are dropped (their residual passes
+    through). aux_loss is the standard load-balancing loss.
+    """
+    B, S, D = x.shape
+    T = B * S
+    E, K = cfg.num_experts, cfg.top_k
+    xt = x.reshape(T, D)
+
+    logits = (xt.astype(jnp.float32) @ params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+
+    # top-k selection
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)  # (T, K)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    capacity = max(1, int(cfg.capacity_factor * T * K / E))
+
+    # position of each (token, k) within its expert's capacity
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (T, K, E)
+    flat = onehot.reshape(T * K, E)
+    pos_in_expert = jnp.cumsum(flat, axis=0) * flat - 1  # (T*K, E)
+    pos = pos_in_expert.reshape(T, K, E).max(-1)  # (T, K) position, -1 if none
+    within = (pos >= 0) & (pos < capacity)
+
+    # dispatch tensor (T, E, C) and combine weights
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    t_idx = jnp.arange(T)[:, None].repeat(K, 1)
+    safe_pos = jnp.clip(pos, 0, capacity - 1)
+    dispatch = dispatch.at[t_idx, expert_idx, safe_pos].add(within.astype(jnp.float32))
+    combine = combine.at[t_idx, expert_idx, safe_pos].add(
+        (gate_vals * within).astype(jnp.float32)
+    )
+
+    # expert compute: (E, C, D) — expert dim shards over the 'expert' axis
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(jnp.float32)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(jnp.float32))
+    yt = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # load-balancing loss (Shazeer et al.): E * sum_e f_e * p_e
+    token_frac = jnp.mean(
+        jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0
+    )
+    prob_frac = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(token_frac * prob_frac)
+
+    return yt.reshape(B, S, D).astype(x.dtype), aux_loss

@@ -56,7 +56,7 @@ def resolve_strategy(opts) -> SchedulingStrategy:
 class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._function = fn
-        self._name = getattr(fn, "__qualname__", getattr(fn, "__name__", "fn"))
+        self._name = getattr(fn, "__name__", "fn")
         self._options = dict(_DEFAULT_TASK_OPTIONS)
         self._options.update(options or {})
         self._pickled: Optional[bytes] = None

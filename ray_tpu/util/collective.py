@@ -1,0 +1,127 @@
+"""Host-level collective communication.
+
+Parity: ``ray.util.collective`` (``collective.py:120-531``) — group
+management + allreduce/allgather/reducescatter/broadcast/barrier for host
+(numpy) tensors, rendezvous through a named actor (the reference stores the
+NCCL unique id in a named ``Rendezvous`` actor, ``nccl_collective_group.py:29``).
+
+Device tensors deliberately take the other plane: on TPU, collectives between
+chips belong *inside* compiled XLA programs over ICI (``jax.lax.psum`` et al,
+SURVEY.md §5 "Distributed communication backend") — this module is the
+DCN/host path for CPU data and control coordination.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_GROUP_PREFIX = "COLLECTIVE_GROUP:"
+
+
+@ray_tpu.remote(num_cpus=0, max_concurrency=16)
+class _GroupActor:
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        # (round, op) -> {rank: array}
+        self.contribs: Dict[tuple, Dict[int, Any]] = {}
+        self.results: Dict[tuple, Any] = {}
+
+    def contribute(self, key: tuple, rank: int, value):
+        entry = self.contribs.setdefault(key, {})
+        entry[rank] = value
+        if len(entry) == self.world_size:
+            self.results[key] = self._finish(key, entry)
+            del self.contribs[key]
+        return True
+
+    def _finish(self, key, entry):
+        op = key[1]
+        parts = [entry[r] for r in range(self.world_size)]
+        if op == "allreduce_sum":
+            return sum(parts[1:], parts[0])
+        if op == "allreduce_max":
+            out = parts[0]
+            for p in parts[1:]:
+                out = np.maximum(out, p)
+            return out
+        if op == "allgather":
+            return parts
+        if op == "reducescatter":
+            total = sum(parts[1:], parts[0])
+            return np.array_split(total, self.world_size)
+        if op == "broadcast":
+            return next(p for p in parts if p is not None)
+        if op == "barrier":
+            return True
+        raise ValueError(op)
+
+    def fetch(self, key: tuple):
+        return self.results.get(key)
+
+    def gc(self, before_round: int):
+        for k in [k for k in self.results if k[0] < before_round]:
+            del self.results[k]
+        return True
+
+
+class CollectiveGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._round = 0
+        name = _GROUP_PREFIX + group_name
+        try:
+            self._actor = ray_tpu.get_actor(name)
+        except ValueError:
+            try:
+                self._actor = _GroupActor.options(name=name).remote(world_size)
+            except ValueError:
+                self._actor = ray_tpu.get_actor(name)
+
+    def _run(self, op: str, value, timeout: float = 300.0):
+        self._round += 1
+        key = (self._round, op)
+        ray_tpu.get(self._actor.contribute.remote(key, self.rank, value), timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            result = ray_tpu.get(self._actor.fetch.remote(key), timeout=timeout)
+            if result is not None:
+                if self._round % 100 == 0:
+                    self._actor.gc.remote(self._round - 10)
+                return result
+            time.sleep(0.002)
+        raise TimeoutError(f"collective {op} timed out (round {self._round})")
+
+    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        return self._run(f"allreduce_{op}", np.asarray(tensor))
+
+    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
+        return self._run("allgather", np.asarray(tensor))
+
+    def reducescatter(self, tensor: np.ndarray) -> np.ndarray:
+        return self._run("reducescatter", np.asarray(tensor))[self.rank]
+
+    def broadcast(self, tensor: Optional[np.ndarray], src_rank: int = 0) -> np.ndarray:
+        value = np.asarray(tensor) if self.rank == src_rank else None
+        return self._run("broadcast", value)
+
+    def barrier(self) -> None:
+        self._run("barrier", True)
+
+
+def init_collective_group(world_size: int, rank: int, group_name: str = "default") -> CollectiveGroup:
+    """Parity: ``ray.util.collective.init_collective_group``."""
+    return CollectiveGroup(group_name, world_size, rank)
+
+
+def destroy_collective_group(group: CollectiveGroup) -> None:
+    try:
+        ray_tpu.kill(group._actor)
+    except Exception:
+        pass

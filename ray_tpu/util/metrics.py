@@ -1,0 +1,136 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Parity: ``python/ray/util/metrics.py`` + the metrics agent's Prometheus
+exposition (``python/ray/_private/metrics_agent.py:483``). Metrics recorded in
+any process are aggregated in the GCS KV (namespace ``metrics``) and exposed
+in Prometheus text format via :func:`prometheus_text`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.worker import get_runtime
+
+_NS = "metrics"
+_lock = threading.Lock()
+# local shadow (flushed to GCS KV on record): name -> {labels_json: value}
+_local: Dict[str, Dict[str, object]] = {}
+
+
+def _flush(name: str, kind: str, description: str, data: Dict[str, object]):
+    try:
+        rt = get_runtime()
+        blob = json.dumps({"kind": kind, "description": description, "data": data}).encode()
+        if hasattr(rt, "scheduler_rpc"):
+            rt.scheduler_rpc("kv_put", (_NS, name.encode(), blob, True))
+        else:
+            rt.rpc("kv_put", _NS, name.encode(), blob, True)
+    except Exception:
+        pass  # metrics never break the app
+
+
+class _Metric:
+    KIND = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        with _lock:
+            _local.setdefault(name, {})
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> str:
+        merged = {**self._default_tags, **(tags or {})}
+        return json.dumps(merged, sort_keys=True)
+
+    def _store(self, key: str, value):
+        with _lock:
+            _local[self._name][key] = value
+            snapshot = dict(_local[self._name])
+        _flush(self._name, self.KIND, self._description, snapshot)
+
+
+class Counter(_Metric):
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with _lock:
+            current = _local[self._name].get(key, 0.0)
+        self._store(key, current + value)
+
+
+class Gauge(_Metric):
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._store(self._key(tags), value)
+
+
+class Histogram(_Metric):
+    KIND = "histogram"
+
+    def __init__(self, name, description="", boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = boundaries or [0.1, 1, 10, 100, 1000]
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with _lock:
+            entry = _local[self._name].get(key) or {
+                "count": 0,
+                "sum": 0.0,
+                "buckets": [0] * (len(self._boundaries) + 1),
+            }
+            entry = json.loads(json.dumps(entry))  # copy
+        entry["count"] += 1
+        entry["sum"] += value
+        for i, b in enumerate(self._boundaries):
+            if value <= b:
+                entry["buckets"][i] += 1
+                break
+        else:
+            entry["buckets"][-1] += 1
+        entry["boundaries"] = self._boundaries
+        self._store(key, entry)
+
+
+def prometheus_text() -> str:
+    """All recorded metrics in Prometheus exposition format (driver-side)."""
+    rt = get_runtime()
+    if hasattr(rt, "scheduler_rpc"):
+        keys = rt.scheduler_rpc("kv_keys", (_NS, b""))
+        get = lambda k: rt.scheduler_rpc("kv_get", (_NS, k))  # noqa: E731
+    else:
+        keys = rt.rpc("kv_keys", _NS, b"")
+        get = lambda k: rt.rpc("kv_get", _NS, k)  # noqa: E731
+    lines = []
+    for key in keys:
+        raw = get(key)
+        if raw is None:
+            continue
+        payload = json.loads(raw)
+        name = key.decode()
+        kind = payload["kind"]
+        lines.append(f"# HELP {name} {payload.get('description', '')}")
+        lines.append(f"# TYPE {name} {kind if kind != 'untyped' else 'gauge'}")
+        for labels_json, value in payload["data"].items():
+            labels = json.loads(labels_json)
+            label_str = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            label_part = "{" + label_str + "}" if label_str else ""
+            if kind == "histogram" and isinstance(value, dict):
+                lines.append(f"{name}_count{label_part} {value['count']}")
+                lines.append(f"{name}_sum{label_part} {value['sum']}")
+            else:
+                lines.append(f"{name}{label_part} {value}")
+    return "\n".join(lines) + "\n"

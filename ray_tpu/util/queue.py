@@ -1,0 +1,100 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity: ``python/ray/util/queue.py`` — Queue with put/get/qsize, usable from
+any task/actor.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote(num_cpus=0)
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self.maxsize = maxsize
+        self.q = collections.deque()
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.q) >= self.maxsize:
+            return False
+        self.q.append(item)
+        return True
+
+    def get_nowait(self):
+        if not self.q:
+            return (False, None)
+        return (True, self.q.popleft())
+
+    def qsize(self) -> int:
+        return len(self.q)
+
+    def empty(self) -> bool:
+        return not self.q
+
+    def get_batch(self, n: int) -> List:
+        out = []
+        while self.q and len(out) < n:
+            out.append(self.q.popleft())
+        return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        self._actor = _QueueActor.options(**(actor_options or {})).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = ray_tpu.get(self._actor.put.remote(item), timeout=60)
+            if ok:
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get_nowait.remote(), timeout=60)
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=60)
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self._actor.empty.remote(), timeout=60)
+
+    def get_batch(self, n: int) -> List:
+        return ray_tpu.get(self._actor.get_batch.remote(n), timeout=60)
+
+    def shutdown(self):
+        ray_tpu.kill(self._actor)

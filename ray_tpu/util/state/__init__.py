@@ -1,0 +1,27 @@
+"""State API: typed listers over live cluster state.
+
+Parity: ``python/ray/util/state/api.py`` (``list_tasks``, ``list_actors``,
+``list_objects``, ``list_nodes``, ``list_workers``, ``summarize_tasks``)
+backed by the scheduler's task-event buffer and tables (the reference's
+``GcsTaskManager`` + ``state_aggregator.py``).
+"""
+
+from ray_tpu.util.state.api import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_tasks",
+    "list_actors",
+    "list_objects",
+    "list_nodes",
+    "list_workers",
+    "list_placement_groups",
+    "summarize_tasks",
+]

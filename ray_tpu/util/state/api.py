@@ -1,0 +1,59 @@
+"""Typed state listers (see package docstring)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.worker import get_runtime
+
+
+def _rpc(op: str, *args):
+    rt = get_runtime()
+    if hasattr(rt, "scheduler_rpc"):
+        return rt.scheduler_rpc(op, args)
+    return rt.rpc(op, *args)
+
+
+def _filtered(rows: List[dict], filters) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op == "=" and have != value:
+                ok = False
+            elif op == "!=" and have == value:
+                ok = False
+        if ok:
+            out.append(row)
+    return out
+
+
+def list_tasks(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_tasks"), filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_actors"), filters)[:limit]
+
+
+def list_workers(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_workers"), filters)[:limit]
+
+
+def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_nodes"), filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_objects"), filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 10_000) -> List[dict]:
+    return _filtered(_rpc("list_placement_groups"), filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    return _rpc("summarize_tasks")

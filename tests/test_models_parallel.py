@@ -1,0 +1,116 @@
+"""Pipeline parallelism, MoE expert parallelism, MNIST models (CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+
+
+def test_pipeline_matches_sequential(cpu_mesh_devices):
+    from ray_tpu.parallel.pipeline import make_pipeline_fn
+
+    mesh = create_mesh(MeshConfig(pipeline=4, data=2))
+    P_stages, M, mb, d = 4, 8, 4, 16
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    key = jax.random.PRNGKey(0)
+    stacked = {
+        "w": jax.random.normal(key, (P_stages, d, d)) * 0.5,
+        "b": jnp.zeros((P_stages, d)),
+    }
+    microbatches = jax.random.normal(jax.random.fold_in(key, 1), (M, mb, d))
+    ref = microbatches
+    for s in range(P_stages):
+        ref = jnp.tanh(ref @ stacked["w"][s] + stacked["b"][s])
+
+    pipe = make_pipeline_fn(stage_fn, mesh)
+    sharded = jax.device_put(stacked, NamedSharding(mesh, P("pipeline")))
+    out = jax.jit(pipe)(sharded, microbatches)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_expert_parallel_matches_single(cpu_mesh_devices):
+    from ray_tpu.models.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_mlp,
+        moe_param_logical_axes,
+    )
+    from ray_tpu.parallel.sharding import DEFAULT_LM_RULES, infer_param_sharding
+
+    cfg = MoEConfig(d_model=32, d_ff=64, num_experts=8, top_k=2, capacity_factor=2.0)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+    y_ref, aux_ref = moe_mlp(params, x, cfg)
+
+    mesh = create_mesh(MeshConfig(expert=8))
+    shardings = infer_param_sharding(moe_param_logical_axes(), DEFAULT_LM_RULES, mesh)
+    params_sh = jax.tree.map(lambda p, s: jax.device_put(p, s), params, shardings)
+    y_ep, aux_ep = jax.jit(lambda p, xx: moe_mlp(p, xx, cfg))(params_sh, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref), atol=1e-5)
+    assert abs(float(aux_ep) - float(aux_ref)) < 1e-5
+
+
+def test_moe_capacity_drops_overflow():
+    from ray_tpu.models.moe import MoEConfig, init_moe_params, moe_mlp
+
+    # capacity far below demand: outputs are partially zero but finite
+    cfg = MoEConfig(d_model=16, d_ff=32, num_experts=2, top_k=1, capacity_factor=0.25)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 16))
+    y, aux = moe_mlp(params, x, cfg)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_mnist_mlp_learns_synthetic(cpu_mesh_devices):
+    import optax
+
+    from ray_tpu.models.mnist import accuracy, apply_mlp, cross_entropy_loss, init_mlp
+    from ray_tpu.parallel.sharding import batch_sharding
+
+    mesh = create_mesh(MeshConfig(data=8))
+    rng = np.random.default_rng(0)
+    # synthetic separable data: class = argmax of 10 fixed projections
+    w_true = rng.normal(size=(784, 10))
+    xs = rng.normal(size=(512, 784)).astype(np.float32)
+    ys = np.argmax(xs @ w_true, axis=1).astype(np.int32)
+
+    params = init_mlp(jax.random.PRNGKey(0), hidden=(64,))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss(p):
+            return cross_entropy_loss(apply_mlp(p, x), y)
+
+        lval, grads = jax.value_and_grad(loss)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, lval
+
+    sh = batch_sharding(mesh)
+    xd = jax.device_put(xs, sh)
+    yd = jax.device_put(ys, sh)
+    first = None
+    for i in range(30):
+        params, opt_state, lval = step(params, opt_state, xd, yd)
+        # sync every step: queuing many async 8-way collectives starves the
+        # XLA-CPU rendezvous on a 1-core host and aborts the process
+        lval = float(lval)
+        first = first if first is not None else lval
+    assert lval < first * 0.6
+    acc = float(accuracy(apply_mlp(params, xd), yd))
+    assert acc > 0.5
+
+
+def test_mnist_cnn_shapes():
+    from ray_tpu.models.mnist import apply_cnn, init_cnn
+
+    params = init_cnn(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 28, 28, 1))
+    logits = apply_cnn(params, x)
+    assert logits.shape == (2, 10)

@@ -1,0 +1,197 @@
+"""Tests: state API, runtime context, metrics, queue, collective, DAG.
+
+Parity: ``python/ray/tests/test_state_api*.py``, ``test_metrics*.py``,
+``test_queue.py``, ``util/collective`` tests, ``test_dag*.py`` (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_state_api_lists(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get([f.remote(), a.ping.remote()])
+
+    tasks = state.list_tasks()
+    assert any(t["name"] == "f" and t["state"] == "FINISHED" for t in tasks)
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    workers = state.list_workers()
+    assert any(w["state"] in ("idle", "busy") for w in workers)
+    summary = state.summarize_tasks()
+    assert summary["f"]["FINISHED"] >= 1
+
+
+def test_state_api_filters(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def g():
+        return 1
+
+    ray_tpu.get(g.remote())
+    done = state.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert all(t["state"] == "FINISHED" for t in done)
+
+
+def test_runtime_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_job_id() is not None
+
+    @ray_tpu.remote
+    def whoami():
+        c = ray_tpu.get_runtime_context()
+        return (c.get_task_id(), c.get_worker_id())
+
+    task_id, worker_id = ray_tpu.get(whoami.remote())
+    assert task_id is not None and worker_id is not None
+
+    @ray_tpu.remote
+    class Who:
+        def me(self):
+            return ray_tpu.get_runtime_context().get_actor_id()
+
+    w = Who.remote()
+    assert ray_tpu.get(w.me.remote()) is not None
+
+
+def test_metrics_and_prometheus(ray_start_regular):
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram, prometheus_text
+
+    c = Counter("requests_total", description="total requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = Gauge("temperature")
+    g.set(42.5)
+    h = Histogram("latency_ms", boundaries=[1, 10, 100])
+    h.observe(5.0)
+    h.observe(50.0)
+
+    text = prometheus_text()
+    assert 'requests_total{route="/a"} 3.0' in text
+    assert "temperature 42.5" in text
+    assert "latency_ms_count 2" in text
+
+
+def test_metrics_from_worker(ray_start_regular):
+    from ray_tpu.util.metrics import prometheus_text
+
+    @ray_tpu.remote
+    def record():
+        from ray_tpu.util.metrics import Counter
+
+        Counter("worker_side_total").inc(7.0)
+        return True
+
+    ray_tpu.get(record.remote())
+    assert "worker_side_total 7.0" in prometheus_text()
+
+
+def test_queue(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=10)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+
+    @ray_tpu.remote
+    def consume(q):
+        return q.get(timeout=30)
+
+    ref = consume.remote(q)
+    assert ray_tpu.get(ref, timeout=60) == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_collective_allreduce(ray_start_regular):
+    from ray_tpu.util.collective import init_collective_group
+
+    @ray_tpu.remote
+    def member(rank, world):
+        g = init_collective_group(world, rank, group_name="t1")
+        out = g.allreduce(np.full(4, rank + 1.0))
+        gathered = g.allgather(np.array([float(rank)]))
+        g.barrier()
+        return out.tolist(), [x.tolist() for x in gathered]
+
+    results = ray_tpu.get([member.remote(r, 2) for r in range(2)], timeout=120)
+    for out, gathered in results:
+        assert out == [3.0, 3.0, 3.0, 3.0]  # 1+2
+        assert gathered == [[0.0], [1.0]]
+
+
+def test_collective_broadcast(ray_start_regular):
+    from ray_tpu.util.collective import init_collective_group
+
+    @ray_tpu.remote
+    def member(rank, world):
+        g = init_collective_group(world, rank, group_name="t2")
+        return g.broadcast(np.arange(3.0) if rank == 0 else None, src_rank=0).tolist()
+
+    results = ray_tpu.get([member.remote(r, 2) for r in range(2)], timeout=120)
+    assert results == [[0.0, 1.0, 2.0]] * 2
+
+
+def test_dag_functions(ray_start_regular):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def plus(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def times(a, k):
+        return a * k
+
+    with InputNode() as inp:
+        dag = times.bind(plus.bind(inp, 10), 3)
+    assert ray_tpu.get(dag.execute(5), timeout=60) == 45
+
+
+def test_dag_with_actors(ray_start_regular):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    node = Acc.bind()
+    with InputNode() as inp:
+        dag = node.add.bind(inp)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(5), timeout=60) == 5
+    assert ray_tpu.get(compiled.execute(7), timeout=60) == 12  # same actor reused
+    compiled.teardown()
+
+
+def test_compile_jax_pipeline():
+    import jax.numpy as jnp
+
+    from ray_tpu.dag import compile_jax_pipeline
+
+    fused = compile_jax_pipeline([lambda x: x + 1, lambda x: x * 2, jnp.sum])
+    assert float(fused(jnp.ones(4))) == 16.0
