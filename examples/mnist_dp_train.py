@@ -1,0 +1,75 @@
+"""MNIST data-parallel training with JaxTrainer (BASELINE.json config #2).
+
+Runs on any device set: real TPU chips or the virtual CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu).
+Uses synthetic MNIST-shaped data so the example is hermetic (zero egress);
+point ``load_data`` at real MNIST arrays to train the real thing.
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd, train
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+def load_data(n=8192):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    w = rng.normal(size=(784, 10))
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def train_loop(config):
+    import jax
+    import optax
+
+    from ray_tpu.models.mnist import accuracy, apply_mlp, cross_entropy_loss, init_mlp
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.sharding import batch_sharding
+
+    mesh = create_mesh(MeshConfig(data=-1))  # pure DP over all local devices
+    params = init_mlp(jax.random.PRNGKey(0), hidden=(128, 128))
+    opt = optax.adam(config["lr"])
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss(p):
+            return cross_entropy_loss(apply_mlp(p, x), y)
+
+        lval, grads = jax.value_and_grad(loss)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, lval
+
+    sh = batch_sharding(mesh)
+    it = config["__datasets__"]["train"]
+    for epoch in range(config["epochs"]):
+        losses = []
+        for batch in it.iter_batches(batch_size=config["batch_size"], drop_last=True):
+            x = jax.device_put(batch["x"], sh)
+            y = jax.device_put(batch["y"], sh)
+            params, opt_state, lval = step(params, opt_state, x, y)
+            losses.append(float(lval))
+        train.report({"epoch": epoch, "loss": float(np.mean(losses))})
+
+
+def main():
+    ray_tpu.init(ignore_reinit_error=True)
+    x, y = load_data()
+    ds = rd.Dataset(
+        [ray_tpu.put({"x": x[i : i + 1024], "y": y[i : i + 1024]}) for i in range(0, len(x), 1024)]
+    )
+    result = JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 1e-3, "epochs": 3, "batch_size": 256},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="mnist_dp"),
+        datasets={"train": rd.DataIterator(ds)},
+    ).fit()
+    print("final:", result.metrics)
+
+
+if __name__ == "__main__":
+    main()

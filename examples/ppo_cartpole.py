@@ -1,0 +1,30 @@
+"""PPO on CartPole (BASELINE.json #1): reaches return >= 150 in < 100k steps."""
+
+import ray_tpu
+from ray_tpu.rl import PPOConfig
+
+
+def main():
+    ray_tpu.init(ignore_reinit_error=True)
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128)
+        .training(lr=3e-4)
+        .build()
+    )
+    for i in range(50):
+        result = algo.train()
+        print(
+            f"iter {i}: return={result['episode_return_mean']:.1f} "
+            f"steps={result['num_env_steps_sampled_lifetime']}"
+        )
+        if result["episode_return_mean"] >= 150:
+            print("solved")
+            break
+    algo.stop()
+
+
+if __name__ == "__main__":
+    main()

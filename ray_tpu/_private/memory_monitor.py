@@ -1,0 +1,112 @@
+"""Memory monitor: node-level OOM protection.
+
+Parity: ``MemoryMonitor`` (``src/ray/common/memory_monitor.h:52``) + the
+retriable-FIFO worker-killing policy (``worker_killing_policy.h:34``): a
+periodic thread watches /proc (cgroup-aware where present); when usage
+crosses the threshold it kills the most-recently-started retriable task's
+worker, which surfaces to the owner as ``OutOfMemoryError``-flavored retry.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+def system_memory_fraction() -> float:
+    """Used/total memory fraction; cgroup limits win over host totals."""
+    # cgroup v2
+    try:
+        with open("/sys/fs/cgroup/memory.max") as fh:
+            limit_raw = fh.read().strip()
+        if limit_raw != "max":
+            limit = int(limit_raw)
+            with open("/sys/fs/cgroup/memory.current") as fh:
+                current = int(fh.read())
+            return current / max(1, limit)
+    except (FileNotFoundError, ValueError, OSError):
+        pass
+    # host
+    try:
+        total = available = None
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    available = int(line.split()[1])
+        if total and available is not None:
+            return 1.0 - available / total
+    except OSError:
+        pass
+    return 0.0
+
+
+class MemoryMonitor:
+    def __init__(
+        self,
+        threshold: float = 0.95,
+        period_s: float = 1.0,
+        usage_fn: Optional[Callable[[], float]] = None,
+        kill_fn: Optional[Callable[[], bool]] = None,
+    ):
+        self.threshold = threshold
+        self.period_s = period_s
+        self.usage_fn = usage_fn or system_memory_fraction
+        self.kill_fn = kill_fn
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True, name="mem-monitor")
+        self._thread.start()
+
+    def check_once(self) -> bool:
+        """Returns True if over threshold (and a kill was attempted)."""
+        if self.usage_fn() >= self.threshold:
+            if self.kill_fn is not None and self.kill_fn():
+                self.kills += 1
+            return True
+        return False
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except Exception:
+                pass
+            self._stop.wait(self.period_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
+    """Retriable-last-started-first kill policy (parity:
+    ``worker_killing_policy_group_by_owner.h:85`` simplified)."""
+
+    def kill() -> bool:
+        candidates = []
+        for rec in scheduler.tasks.values():
+            if rec.state == "RUNNING" and rec.worker_id is not None:
+                w = scheduler.workers.get(rec.worker_id)
+                if w is None or w.proc is None:
+                    continue
+                retriable = rec.retries_left > 0
+                candidates.append((not retriable, -(rec.start_time or 0), w))
+        if not candidates:
+            return False
+        candidates.sort()
+        _, _, victim = candidates[0]
+        try:
+            victim.proc.terminate()
+            return True
+        except Exception:
+            return False
+
+    return kill

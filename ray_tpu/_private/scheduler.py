@@ -1121,6 +1121,18 @@ class Scheduler:
                         }
                     )
             return out
+        if op == "pending_demand":
+            # resource shapes the scheduler cannot currently place (autoscaler
+            # input; parity: GcsAutoscalerStateManager cluster_resource_state)
+            demand: List[Dict[str, float]] = []
+            for tid in list(self._pending):
+                rec = self.tasks.get(tid)
+                if rec is not None and rec.state == "PENDING":
+                    demand.append(dict(rec.spec.resources))
+            for pg in self.placement_groups.values():
+                if pg.state == "PENDING":
+                    demand.extend(dict(b) for b in pg.bundles)
+            return demand
         if op == "summarize_tasks":
             summary: Dict[str, Dict[str, int]] = {}
             for t in list(self.tasks.values()):

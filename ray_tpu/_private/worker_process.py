@@ -328,8 +328,32 @@ class WorkerRuntime:
                     )
         return out
 
+    def _apply_runtime_env(self, spec: TaskSpec):
+        """Minimal runtime_env: env_vars applied around execution (parity:
+        python/ray/_private/runtime_env — the full conda/pip/working_dir
+        machinery is a per-node agent in the reference; env_vars is the
+        process-level slice that applies to pre-spawned workers)."""
+        import os
+
+        env = (spec.runtime_env or {}).get("env_vars") or {}
+        saved = {}
+        for k, v in env.items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        return saved
+
+    def _restore_env(self, saved):
+        import os
+
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
     def execute(self, spec: TaskSpec) -> List[Tuple]:
         self.current_task_id = spec.task_id
+        saved_env = self._apply_runtime_env(spec) if spec.runtime_env else {}
         try:
             if spec.task_type == TaskType.ACTOR_CREATION:
                 cls = cloudpickle.loads(spec.function)
@@ -386,6 +410,8 @@ class WorkerRuntime:
                 blob = pickle.dumps(err)
             return [("error", blob)] * max(1, spec.num_returns)
         finally:
+            if saved_env:
+                self._restore_env(saved_env)
             self.current_task_id = None
 
 

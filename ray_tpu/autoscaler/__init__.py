@@ -1,0 +1,21 @@
+"""Autoscaler (v2-reconciler style).
+
+Parity: ``python/ray/autoscaler/v2`` — ``Autoscaler`` (``v2/autoscaler.py:42``)
+reading cluster state + pending demand from the control plane, a ``Scheduler``
+bin-packing demand onto node types, an instance manager driving a
+``NodeProvider`` plugin. Providers: a fake in-process provider (parity:
+``fake_multi_node``, used by the tests) and a TPU-VM provider skeleton (the
+GCE surface of ``autoscaler/gcp/tpu_command_runner.py``); slice-atomicity:
+TPU node types scale in whole slices.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeType
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "NodeType",
+    "NodeProvider",
+    "FakeNodeProvider",
+]

@@ -1,0 +1,134 @@
+"""The reconciler: demand in, launch/terminate decisions out.
+
+Parity: ``StandardAutoscaler.update`` (``autoscaler.py:172,374``) +
+``resource_demand_scheduler.py`` bin-packing, restructured as the v2
+reconciler: each ``update()`` computes a target node set from (pending
+demand, current nodes, min/max bounds, idle timeout) and drives the provider
+toward it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclass
+class NodeType:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType] = field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    upscaling_speed: float = 1.0  # max new nodes per update = max(1, speed * current)
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
+        self.config = config
+        self.provider = provider
+        self._idle_since: Dict[str, float] = {}
+
+    # -- inputs ------------------------------------------------------------
+
+    def _pending_demand(self) -> List[Dict[str, float]]:
+        from ray_tpu._private.worker import get_driver
+
+        return get_driver().scheduler_rpc("pending_demand", ())
+
+    def _node_utilization(self) -> Dict[str, float]:
+        """node_id -> max resource utilization fraction."""
+        import ray_tpu
+
+        out = {}
+        for n in ray_tpu.nodes():
+            if not n["alive"]:
+                continue
+            fracs = [
+                1.0 - n["available"].get(k, 0.0) / t
+                for k, t in n["total"].items()
+                if t > 0
+            ]
+            out[n["node_id"]] = max(fracs) if fracs else 0.0
+        return out
+
+    # -- reconcile ---------------------------------------------------------
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass; returns {launched: n, terminated: m}."""
+        demand = self._pending_demand()
+        nodes = self.provider.non_terminated_nodes()
+        by_type: Dict[str, List[dict]] = {}
+        for n in nodes:
+            by_type.setdefault(n["node_type"], []).append(n)
+
+        launched = 0
+        terminated = 0
+
+        # 1. satisfy min_workers
+        for nt in self.config.node_types:
+            have = len(by_type.get(nt.name, []))
+            while have < nt.min_workers:
+                self.provider.create_node(nt.name, nt.resources)
+                have += 1
+                launched += 1
+
+        # 2. bin-pack unplaced demand onto hypothetical new nodes
+        to_launch: Dict[str, int] = {}
+        remaining = [dict(d) for d in demand if d]
+        for nt in self.config.node_types:
+            base = len(by_type.get(nt.name, []))
+            while remaining and base + to_launch.get(nt.name, 0) < nt.max_workers:
+                # greedily fill one hypothetical node of this type
+                free = dict(nt.resources)
+                packed = []
+                for d in remaining:
+                    if all(free.get(k, 0.0) >= v for k, v in d.items()):
+                        for k, v in d.items():
+                            free[k] -= v
+                        packed.append(d)
+                if not packed:
+                    break
+                for d in packed:
+                    remaining.remove(d)
+                to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+        cap = max(1, int(self.config.upscaling_speed * max(1, len(nodes))))
+        for name, count in to_launch.items():
+            nt = next(t for t in self.config.node_types if t.name == name)
+            for _ in range(min(count, cap)):
+                self.provider.create_node(nt.name, nt.resources)
+                launched += 1
+
+        # 3. terminate idle nodes beyond min_workers
+        util = self._node_utilization()
+        now = time.monotonic()
+        for nt in self.config.node_types:
+            current = self.provider.non_terminated_nodes()
+            mine = [n for n in current if n["node_type"] == nt.name]
+            for n in mine:
+                nid = n["node_id"]
+                if util.get(nid, 0.0) <= 0.0:
+                    self._idle_since.setdefault(nid, now)
+                else:
+                    self._idle_since.pop(nid, None)
+            idle_long = [
+                n
+                for n in mine
+                if now - self._idle_since.get(n["node_id"], now)
+                >= self.config.idle_timeout_s
+            ]
+            removable = len(mine) - nt.min_workers
+            for n in idle_long[: max(0, removable)]:
+                self.provider.terminate_node(n["node_id"])
+                self._idle_since.pop(n["node_id"], None)
+                terminated += 1
+
+        return {"launched": launched, "terminated": terminated}

@@ -1,0 +1,117 @@
+"""Node providers.
+
+Parity: ``python/ray/autoscaler/node_provider.py`` (NodeProvider plugin
+surface: ``create_node`` / ``terminate_node`` / ``non_terminated_nodes``) with
+the fake multi-node provider for tests
+(``autoscaler/_private/fake_multi_node``) and a TPU-VM provider skeleton
+covering the reference's GCP TPU support (``gcp/tpu.yaml``,
+``tpu_command_runner.py``).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[dict]:
+        """[{node_id, node_type, resources, launched_at}]"""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Creates virtual nodes in the live cluster (workers are real processes)."""
+
+    def __init__(self):
+        self._nodes: Dict[str, dict] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        from ray_tpu._private.worker import get_driver
+
+        driver = get_driver()
+        res = dict(resources)
+        num_cpus = res.pop("CPU", 1.0)
+        num_tpus = res.pop("TPU", 0.0)
+        nid = driver.node.add_virtual_node(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=res
+        )
+        node_id = nid.hex()
+        self._nodes[node_id] = {
+            "node_id": node_id,
+            "node_type": node_type,
+            "resources": dict(resources),
+            "launched_at": time.time(),
+            "_internal_id": nid,
+        }
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        from ray_tpu._private.worker import get_driver
+
+        get_driver().node.remove_virtual_node(info["_internal_id"])
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return [
+            {k: v for k, v in n.items() if k != "_internal_id"}
+            for n in self._nodes.values()
+        ]
+
+
+class TPUVMNodeProvider(NodeProvider):
+    """TPU-VM (GCE) provider skeleton.
+
+    Issues ``gcloud compute tpus tpu-vm`` commands (create/delete/list) —
+    slice-granular: one "node" here is one pod slice (indivisible across
+    jobs, SURVEY.md §7 step 4). Requires gcloud credentials on the head;
+    raises a clear error when unavailable instead of silently no-oping.
+    """
+
+    def __init__(self, project: str, zone: str, version: str = "tpu-ubuntu2204-base"):
+        self.project = project
+        self.zone = zone
+        self.version = version
+        self._nodes: Dict[str, dict] = {}
+
+    def _gcloud(self, *args: str) -> str:
+        import subprocess
+
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
+               f"--project={self.project}", f"--zone={self.zone}", "--format=json"]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(f"gcloud failed: {proc.stderr[-2000:]}")
+        return proc.stdout
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        # node_type is the accelerator type, e.g. "v5litepod-16"
+        name = f"ray-tpu-{node_type}-{uuid.uuid4().hex[:6]}"
+        self._gcloud(
+            "create", name,
+            f"--accelerator-type={node_type}",
+            f"--version={self.version}",
+        )
+        self._nodes[name] = {
+            "node_id": name,
+            "node_type": node_type,
+            "resources": dict(resources),
+            "launched_at": time.time(),
+        }
+        return name
+
+    def terminate_node(self, node_id: str) -> None:
+        self._gcloud("delete", node_id, "--quiet")
+        self._nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return list(self._nodes.values())

@@ -1,0 +1,119 @@
+"""Dashboard: HTTP observability surface.
+
+Parity: ``python/ray/dashboard`` (head process serving cluster state over
+HTTP; SURVEY.md §2.2). The reference ships an aiohttp + React SPA; here a
+stdlib HTTP server in the driver serves the same data as JSON:
+
+  /api/cluster_status   resources + nodes
+  /api/tasks            task table            /api/actors     actor table
+  /api/objects          object store          /api/jobs       job table
+  /metrics              Prometheus exposition
+  /                     minimal HTML overview
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(port: int = 8765) -> int:
+    """Start the dashboard server in this (driver) process; returns port."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            import ray_tpu
+            from ray_tpu.util import state
+
+            try:
+                if self.path == "/api/cluster_status":
+                    body = {
+                        "total": ray_tpu.cluster_resources(),
+                        "available": ray_tpu.available_resources(),
+                        "nodes": state.list_nodes(),
+                    }
+                elif self.path == "/api/tasks":
+                    body = state.list_tasks()
+                elif self.path == "/api/actors":
+                    body = state.list_actors()
+                elif self.path == "/api/workers":
+                    body = state.list_workers()
+                elif self.path == "/api/objects":
+                    body = state.list_objects()
+                elif self.path == "/api/placement_groups":
+                    body = state.list_placement_groups()
+                elif self.path == "/api/jobs":
+                    from ray_tpu.job_submission import JobSubmissionClient
+
+                    body = JobSubmissionClient().list_jobs()
+                elif self.path == "/metrics":
+                    from ray_tpu.util.metrics import prometheus_text
+
+                    blob = prometheus_text().encode()
+                    self._reply(200, blob, "text/plain; version=0.0.4")
+                    return
+                elif self.path == "/":
+                    blob = _overview_html().encode()
+                    self._reply(200, blob, "text/html")
+                    return
+                else:
+                    self._reply(404, b'{"error": "not found"}', "application/json")
+                    return
+                self._reply(200, json.dumps(body, default=str).encode(), "application/json")
+            except Exception as e:  # noqa: BLE001
+                self._reply(500, json.dumps({"error": str(e)}).encode(), "application/json")
+
+        def _reply(self, code: int, blob: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+    _server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True).start()
+    return _server.server_address[1]
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None
+
+
+def _overview_html() -> str:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    rows = "".join(
+        f"<tr><td>{k}</td><td>{avail.get(k, 0):.1f}</td><td>{v:.1f}</td></tr>"
+        for k, v in sorted(total.items())
+    )
+    summary = state.summarize_tasks()
+    tasks = "".join(
+        f"<tr><td>{name}</td><td>{counts}</td></tr>" for name, counts in summary.items()
+    )
+    return f"""<html><head><title>ray_tpu dashboard</title></head><body>
+<h1>ray_tpu</h1>
+<h2>Resources</h2>
+<table border=1><tr><th>resource</th><th>available</th><th>total</th></tr>{rows}</table>
+<h2>Tasks</h2>
+<table border=1><tr><th>name</th><th>states</th></tr>{tasks}</table>
+<p>APIs: /api/cluster_status /api/tasks /api/actors /api/workers /api/objects
+/api/placement_groups /api/jobs /metrics</p>
+</body></html>"""

@@ -1,0 +1,181 @@
+"""Job submission: run entrypoint commands as supervised cluster jobs.
+
+Parity: ``python/ray/dashboard/modules/job`` — ``JobSubmissionClient`` /
+``JobManager`` (``job_manager.py:57``): each job gets a detached
+``JobSupervisor`` actor (``job_supervisor.py:51``) running the entrypoint as a
+subprocess, status + logs recorded (here: GCS KV + log files in the session
+dir).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+_NS = "jobs"
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@ray_tpu.remote(max_concurrency=4)
+class JobSupervisor:
+    """Runs one entrypoint subprocess; parity: job_supervisor.py:51."""
+
+    def __init__(self, job_id: str, entrypoint: str, log_path: str, env: Optional[dict]):
+        import subprocess
+        import threading
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self.returncode: Optional[int] = None
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        self._logf = open(log_path, "wb")
+        self.proc = subprocess.Popen(
+            entrypoint,
+            shell=True,
+            stdout=self._logf,
+            stderr=subprocess.STDOUT,
+            env=full_env,
+        )
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _wait(self):
+        self.returncode = self.proc.wait()
+        self._logf.flush()
+
+    def status(self) -> str:
+        if self.returncode is None:
+            return JobStatus.RUNNING
+        return JobStatus.SUCCEEDED if self.returncode == 0 else JobStatus.FAILED
+
+    def stop(self) -> bool:
+        if self.returncode is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                self.proc.kill()
+        return True
+
+    def logs(self) -> str:
+        self._logf.flush()
+        try:
+            with open(self.log_path, "rb") as fh:
+                return fh.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+
+class JobSubmissionClient:
+    """Parity: ``ray.job_submission.JobSubmissionClient`` (in-process mode)."""
+
+    def __init__(self, address: Optional[str] = None):
+        self._rt = ray_tpu.get_runtime()
+
+    def _kv_put(self, job_id: str, record: dict):
+        blob = json.dumps(record).encode()
+        rt = ray_tpu.get_runtime()
+        if hasattr(rt, "scheduler_rpc"):
+            rt.scheduler_rpc("kv_put", (_NS, job_id.encode(), blob, True))
+        else:
+            rt.rpc("kv_put", _NS, job_id.encode(), blob, True)
+
+    def _kv_get(self, job_id: str) -> Optional[dict]:
+        rt = ray_tpu.get_runtime()
+        if hasattr(rt, "scheduler_rpc"):
+            raw = rt.scheduler_rpc("kv_get", (_NS, job_id.encode()))
+        else:
+            raw = rt.rpc("kv_get", _NS, job_id.encode())
+        return json.loads(raw) if raw else None
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+    ) -> str:
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        driver = ray_tpu.get_runtime()
+        log_dir = os.path.join(driver.node.session_dir, "logs") if hasattr(driver, "node") else "/tmp"
+        log_path = os.path.join(log_dir, f"job-{job_id}.log")
+        env = (runtime_env or {}).get("env_vars")
+        supervisor = JobSupervisor.options(
+            name=f"_job_supervisor:{job_id}", num_cpus=0
+        ).remote(job_id, entrypoint, log_path, env)
+        self._kv_put(
+            job_id,
+            {
+                "job_id": job_id,
+                "entrypoint": entrypoint,
+                "submitted_at": time.time(),
+                "metadata": metadata or {},
+                "log_path": log_path,
+            },
+        )
+        # surface immediate spawn failures
+        ray_tpu.get(supervisor.status.remote(), timeout=60)
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        try:
+            sup = self._supervisor(job_id)
+        except ValueError:
+            rec = self._kv_get(job_id)
+            if rec is None:
+                raise ValueError(f"unknown job {job_id}") from None
+            return JobStatus.STOPPED
+        return JobStatus(ray_tpu.get(sup.status.remote(), timeout=60))
+
+    def get_job_logs(self, job_id: str) -> str:
+        sup = self._supervisor(job_id)
+        return ray_tpu.get(sup.logs.remote(), timeout=60)
+
+    def stop_job(self, job_id: str) -> bool:
+        sup = self._supervisor(job_id)
+        return ray_tpu.get(sup.stop.remote(), timeout=60)
+
+    def list_jobs(self) -> List[dict]:
+        rt = ray_tpu.get_runtime()
+        if hasattr(rt, "scheduler_rpc"):
+            keys = rt.scheduler_rpc("kv_keys", (_NS, b""))
+        else:
+            keys = rt.rpc("kv_keys", _NS, b"")
+        out = []
+        for k in keys:
+            rec = self._kv_get(k.decode())
+            if rec:
+                try:
+                    rec["status"] = self.get_job_status(rec["job_id"]).value
+                except Exception:
+                    rec["status"] = "UNKNOWN"
+                out.append(rec)
+        return out
+
+    def wait_until_finished(self, job_id: str, timeout: float = 600.0) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

@@ -1,0 +1,169 @@
+"""CLI: ``python -m ray_tpu <command>``.
+
+Parity: ``python/ray/scripts/scripts.py`` (``ray start/stop/status``,
+``ray job submit/status/logs/stop/list``, ``ray summary``, ``ray timeline``,
+``ray memory``). Cluster-lifecycle commands operate on a head started in this
+process (``start --block``) since the transport is in-process for now.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _init(args):
+    import ray_tpu
+
+    return ray_tpu.init(
+        num_cpus=getattr(args, "num_cpus", None),
+        num_tpus=getattr(args, "num_tpus", None),
+        ignore_reinit_error=True,
+    )
+
+
+def cmd_start(args):
+    import ray_tpu
+
+    _init(args)
+    print(f"ray_tpu head started. resources: {ray_tpu.cluster_resources()}")
+    if args.block:
+        print("blocking; Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        ray_tpu.shutdown()
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    _init(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("== cluster resources ==")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):.1f} / {total[k]:.1f} available")
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes()
+    print(f"== nodes ({len(nodes)}) ==")
+    for n in nodes:
+        print(f"  {n['node_id'][:12]} alive={n['alive']} total={n['total']}")
+
+
+def cmd_summary(args):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _init(args)
+    print(json.dumps(state.summarize_tasks(), indent=2))
+
+
+def cmd_memory(args):
+    from ray_tpu.util import state
+
+    _init(args)
+    rows = state.list_objects()
+    total = sum(r["size_bytes"] for r in rows)
+    print(f"{len(rows)} objects, {total / 1e6:.1f} MB total")
+    for r in rows[:50]:
+        print(f"  {r['object_id'][:16]} {r['size_bytes']:>12} bytes refs={r['ref_count']}")
+
+
+def cmd_timeline(args):
+    import ray_tpu
+
+    _init(args)
+    events = ray_tpu.timeline()
+    out = args.output or "timeline.json"
+    with open(out, "w") as fh:
+        json.dump(events, fh)
+    print(f"wrote {len(events)} events to {out} (chrome://tracing)")
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    _init(args)
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(f"submitted: {job_id}")
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(f"status: {status.value}")
+            print(client.get_job_logs(job_id))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id).value)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        client.stop_job(args.job_id)
+        print("stopped")
+    elif args.job_cmd == "list":
+        for rec in client.list_jobs():
+            print(f"{rec['job_id']}  {rec.get('status')}  {rec['entrypoint'][:60]}")
+
+
+def cmd_dashboard(args):
+    from ray_tpu.dashboard import start_dashboard
+
+    _init(args)
+    port = start_dashboard(port=args.port)
+    print(f"dashboard at http://127.0.0.1:{port}/  (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node in this process")
+    p.add_argument("--num-cpus", type=int, dest="num_cpus")
+    p.add_argument("--num-tpus", type=int, dest="num_tpus")
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster resources and nodes")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary", help="task state summary")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("memory", help="object store contents")
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    p.add_argument("--output", "-o")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("job", help="job submission")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    ps.add_argument("--wait", action="store_true")
+    jsub.add_parser("status").add_argument("job_id")
+    jsub.add_parser("logs").add_argument("job_id")
+    jsub.add_parser("stop").add_argument("job_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("dashboard", help="start the HTTP dashboard")
+    p.add_argument("--port", type=int, default=8765)
+    p.set_defaults(fn=cmd_dashboard)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

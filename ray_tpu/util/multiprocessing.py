@@ -1,0 +1,107 @@
+"""multiprocessing.Pool shim over cluster tasks.
+
+Parity: ``ray.util.multiprocessing.Pool`` — drop-in Pool whose workers are
+cluster tasks, so ``pool.map`` scales past one machine.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_chunk(fn_blob: bytes, chunk: List[tuple], is_star: bool):
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_blob)
+    if is_star:
+        return [fn(*args) for args in chunk]
+    return [fn(args) for args in chunk]
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or int(ray_tpu.cluster_resources().get("CPU", 1))
+        self._closed = False
+
+    def _chunks(self, iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (4 * self._processes) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i : i + chunksize]
+
+    def _map(self, func, iterable, chunksize, is_star) -> List[Any]:
+        import cloudpickle
+
+        if self._closed:
+            raise ValueError("Pool is closed")
+        blob = cloudpickle.dumps(func)
+        refs = [
+            _run_chunk.remote(blob, chunk, is_star)
+            for chunk in self._chunks(iterable, chunksize)
+        ]
+        return list(itertools.chain.from_iterable(ray_tpu.get(refs)))
+
+    def map(self, func: Callable, iterable: Iterable, chunksize: Optional[int] = None):
+        return self._map(func, iterable, chunksize, is_star=False)
+
+    def starmap(self, func: Callable, iterable: Iterable, chunksize: Optional[int] = None):
+        return self._map(func, iterable, chunksize, is_star=True)
+
+    def apply(self, func: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        import cloudpickle
+
+        blob = cloudpickle.dumps(lambda: func(*args, **(kwds or {})))
+
+        @ray_tpu.remote
+        def _run(b):
+            import cloudpickle as cp
+
+            return cp.loads(b)()
+
+        ref = _run.remote(blob)
+
+        class _Result:
+            def get(self, timeout: Optional[float] = None):
+                return ray_tpu.get(ref, timeout=timeout)
+
+            def ready(self):
+                done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                return bool(done)
+
+        return _Result()
+
+    def imap(self, func: Callable, iterable: Iterable, chunksize: int = 1):
+        import cloudpickle
+
+        blob = cloudpickle.dumps(func)
+        refs = [
+            _run_chunk.remote(blob, chunk, False)
+            for chunk in self._chunks(iterable, chunksize)
+        ]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,11 @@
+"""Durable workflows: exactly-once DAG execution with resume.
+
+Parity: ``python/ray/workflow`` — ``WorkflowExecutor``
+(``workflow_executor.py:32``) walking a DAG of tasks, persisting every task
+output (``workflow_storage.py``) so a crashed/restarted run resumes from
+completed steps instead of recomputing them.
+"""
+
+from ray_tpu.workflow.api import get_output, get_status, resume, run, run_async
+
+__all__ = ["run", "run_async", "resume", "get_status", "get_output"]

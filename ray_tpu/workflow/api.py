@@ -1,0 +1,173 @@
+"""Workflow execution engine (see package docstring)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.dag import (
+    BoundClassMethodNode,
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+)
+
+_DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+
+def _storage_dir(workflow_id: str, storage: Optional[str]) -> str:
+    d = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    os.makedirs(os.path.join(d, "steps"), exist_ok=True)
+    return d
+
+
+def _node_key(node: DAGNode, memo: Dict[int, str]) -> str:
+    """Deterministic step id: function name + structural hash of the subtree."""
+    if id(node) in memo:
+        return memo[id(node)]
+    h = hashlib.sha1()
+    if isinstance(node, FunctionNode):
+        h.update(getattr(node.fn, "_name", "fn").encode())
+        for a in node.args:
+            h.update(
+                _node_key(a, memo).encode() if isinstance(a, DAGNode) else repr(a).encode()
+            )
+        for k in sorted(node.kwargs):
+            v = node.kwargs[k]
+            h.update(k.encode())
+            h.update(
+                _node_key(v, memo).encode() if isinstance(v, DAGNode) else repr(v).encode()
+            )
+        name = getattr(node.fn, "_name", "fn")
+    elif isinstance(node, InputNode):
+        name, h = "input", hashlib.sha1(b"input")
+    else:
+        raise TypeError(
+            f"workflows support function DAGs (got {type(node).__name__}); "
+            "wrap stateful steps in functions"
+        )
+    key = f"{name}-{h.hexdigest()[:12]}"
+    memo[id(node)] = key
+    return key
+
+
+def _mark(d: str, status: str, error: str = ""):
+    with open(os.path.join(d, "status.json"), "w") as fh:
+        json.dump({"status": status, "error": error, "time": time.time()}, fh)
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None, args: tuple = ()) -> Any:
+    """Execute durably; returns the final output (blocking)."""
+    workflow_id = workflow_id or f"wf_{int(time.time())}_{os.getpid()}"
+    d = _storage_dir(workflow_id, storage)
+    with open(os.path.join(d, "workflow.pkl"), "wb") as fh:
+        import cloudpickle
+
+        cloudpickle.dump({"dag": dag, "args": args}, fh)
+    _mark(d, "RUNNING")
+    try:
+        result = _execute(dag, args, d, {})
+        value = ray_tpu.get(result) if isinstance(result, ray_tpu.ObjectRef) else result
+        with open(os.path.join(d, "output.pkl"), "wb") as fh:
+            pickle.dump(value, fh)
+        _mark(d, "SUCCESSFUL")
+        return value
+    except Exception as e:  # noqa: BLE001
+        _mark(d, "FAILED", error=repr(e))
+        raise
+
+
+def run_async(dag: DAGNode, **kwargs):
+    """Run in a background task; returns an ObjectRef of the output."""
+    import cloudpickle
+
+    blob = cloudpickle.dumps((dag, kwargs))
+
+    @ray_tpu.remote
+    def _driver(blob):
+        import cloudpickle as cp
+
+        dag, kwargs = cp.loads(blob)
+        return run(dag, **kwargs)
+
+    return _driver.remote(blob)
+
+
+def _execute(node: DAGNode, input_args: tuple, d: str, memo: Dict[int, Any]):
+    if id(node) in memo:
+        return memo[id(node)]
+    if isinstance(node, InputNode):
+        result = input_args[node.index] if input_args else None
+        memo[id(node)] = result
+        return result
+    if not isinstance(node, FunctionNode):
+        raise TypeError(f"workflows support function DAGs, got {type(node).__name__}")
+    key = _node_key(node, {})
+    step_path = os.path.join(d, "steps", key + ".pkl")
+    if os.path.exists(step_path):
+        with open(step_path, "rb") as fh:
+            result = pickle.load(fh)
+        memo[id(node)] = result
+        return result
+
+    def rec(v):
+        out = _execute(v, input_args, d, memo) if isinstance(v, DAGNode) else v
+        return ray_tpu.get(out) if isinstance(out, ray_tpu.ObjectRef) else out
+
+    args = [rec(a) for a in node.args]
+    kwargs = {k: rec(v) for k, v in node.kwargs.items()}
+    value = ray_tpu.get(node.fn.remote(*args, **kwargs))
+    # durably record the step output BEFORE it is consumed downstream
+    tmp = step_path + ".tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(value, fh)
+    os.replace(tmp, step_path)
+    memo[id(node)] = value
+    return value
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow; completed steps are restored, not recomputed."""
+    import cloudpickle
+
+    d = _storage_dir(workflow_id, storage)
+    wf_path = os.path.join(d, "workflow.pkl")
+    if not os.path.exists(wf_path):
+        raise ValueError(f"no workflow {workflow_id}")
+    with open(wf_path, "rb") as fh:
+        blob = cloudpickle.load(fh)
+    _mark(d, "RUNNING")
+    try:
+        result = _execute(blob["dag"], blob["args"], d, {})
+        value = ray_tpu.get(result) if isinstance(result, ray_tpu.ObjectRef) else result
+        with open(os.path.join(d, "output.pkl"), "wb") as fh:
+            pickle.dump(value, fh)
+        _mark(d, "SUCCESSFUL")
+        return value
+    except Exception as e:  # noqa: BLE001
+        _mark(d, "FAILED", error=repr(e))
+        raise
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None) -> str:
+    d = _storage_dir(workflow_id, storage)
+    try:
+        with open(os.path.join(d, "status.json")) as fh:
+            return json.load(fh)["status"]
+    except FileNotFoundError:
+        return "UNKNOWN"
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    d = _storage_dir(workflow_id, storage)
+    with open(os.path.join(d, "output.pkl"), "rb") as fh:
+        return pickle.load(fh)

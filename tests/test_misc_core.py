@@ -1,0 +1,91 @@
+"""runtime_env env_vars, memory monitor, multiprocessing Pool shim."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_runtime_env_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote(), timeout=60) == "on"
+    # env restored after the task
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_runtime_env_on_actor(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "yes"}})
+    class A:
+        def __init__(self):
+            self.at_init = os.environ.get("ACTOR_FLAG")
+
+        def get(self):
+            return self.at_init
+
+    a = A.remote()
+    assert ray_tpu.get(a.get.remote(), timeout=60) == "yes"
+
+
+def test_memory_monitor_threshold_and_kill():
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    readings = iter([0.5, 0.97, 0.5])
+    kills = []
+    mon = MemoryMonitor(
+        threshold=0.9,
+        usage_fn=lambda: next(readings),
+        kill_fn=lambda: kills.append(1) or True,
+    )
+    assert not mon.check_once()
+    assert mon.check_once()
+    assert not mon.check_once()
+    assert kills == [1]
+    assert mon.kills == 1
+
+
+def test_memory_monitor_system_reading():
+    from ray_tpu._private.memory_monitor import system_memory_fraction
+
+    frac = system_memory_fraction()
+    assert 0.0 <= frac <= 1.0
+
+
+def test_memory_monitor_kill_policy(ray_start_regular):
+    from ray_tpu._private.memory_monitor import make_scheduler_kill_policy
+
+    rt = ray_tpu.get_runtime()
+
+    @ray_tpu.remote(max_retries=2)
+    def hog():
+        time.sleep(60)
+        return 1
+
+    ref = hog.remote()
+    time.sleep(1.0)  # let it start
+    kill = make_scheduler_kill_policy(rt.scheduler)
+    assert kill()  # terminates the running retriable worker
+    # task retries and would eventually run again; just assert no crash here
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+    assert not_ready  # still pending/retrying
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(lambda a: a * 10, (7,))
+        assert r.get(timeout=60) == 70
+        assert list(pool.imap(lambda x: x + 1, range(5))) == [1, 2, 3, 4, 5]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
