@@ -81,6 +81,41 @@ class ObjectStoreClient(StorePutMixin):
             return p
         return None
 
+    def _reserve_shm(self, total: int) -> None:
+        """Raise OSError when the allocation would overrun the store budget.
+
+        Cheap checks only (this is the put hot path): the filesystem must
+        keep a safety margin of free space, and allocations over 8 MiB are
+        additionally charged against the configured capacity (small objects
+        can't meaningfully overrun it between large-object scans).
+        """
+        try:
+            st = os.statvfs(self._shm_dir)
+            free = st.f_bavail * st.f_frsize
+            fs_size = st.f_blocks * st.f_frsize
+        except OSError:
+            return
+        # safety margin scales with the filesystem (64 MiB shm in default
+        # docker would otherwise never admit anything)
+        margin = min(64 * 1024 * 1024, max(1024 * 1024, fs_size // 20))
+        if free < total + margin:
+            raise OSError(f"shm nearly full ({free} free, need {total})")
+        if self._capacity and total > 8 * 1024 * 1024:
+            # budget only the shm dir (spilled bytes must not poison the
+            # budget forever) — scanned only on large allocations
+            used = 0
+            try:
+                with os.scandir(self._shm_dir) as it:
+                    for e in it:
+                        try:
+                            used += e.stat().st_size
+                        except FileNotFoundError:
+                            pass
+            except FileNotFoundError:
+                pass
+            if used + total > self._capacity:
+                raise OSError(f"store capacity {self._capacity} exceeded")
+
     # -- API --------------------------------------------------------------
 
     def create(self, oid: ObjectID, size: int) -> memoryview:
@@ -93,7 +128,11 @@ class ObjectStoreClient(StorePutMixin):
         try:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
             try:
-                os.ftruncate(fd, total)
+                self._reserve_shm(total)
+                # posix_fallocate reserves pages now, so tmpfs exhaustion
+                # surfaces here as ENOSPC -> disk fallback, instead of
+                # SIGBUS on the first write into the sparse mapping
+                os.posix_fallocate(fd, 0, total)
             except OSError:
                 os.close(fd)
                 os.unlink(path)
@@ -102,7 +141,14 @@ class ObjectStoreClient(StorePutMixin):
             fallback = True
             path = self._path(oid, False, fallback=True)
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
-            os.ftruncate(fd, total)
+            try:
+                os.posix_fallocate(fd, 0, total)
+            except OSError:
+                os.close(fd)
+                os.unlink(path)
+                raise StoreFullError(
+                    f"fallback dir full allocating {total} bytes"
+                )
         except FileExistsError:
             # a .building file with no live writer (creator crashed between
             # create and seal) is reclaimed after a grace period so retried

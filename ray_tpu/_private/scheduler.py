@@ -173,7 +173,10 @@ class WorkerState:
 @dataclass
 class ActorState:
     actor_id: ActorID
-    creation_spec: TaskSpec
+    # None only for a pre-registered placeholder: the name was claimed via
+    # GCS RPC but the ACTOR_CREATION spec has not reached the scheduler yet
+    # (method calls racing through that window queue in pending_calls).
+    creation_spec: Optional[TaskSpec]
     worker_id: Optional[WorkerID] = None
     state: str = "PENDING"  # PENDING|ALIVE|RESTARTING|DEAD
     restarts_left: int = 0
@@ -183,6 +186,8 @@ class ActorState:
     pending_calls: Deque[TaskSpec] = field(default_factory=collections.deque)
     death_cause: Optional[str] = None
     num_handles: int = 1
+    detached: bool = False
+    max_task_retries: int = 0
 
 
 @dataclass
@@ -284,6 +289,9 @@ class Scheduler:
         # object ref counts (owner-side): oid -> count; deletion when 0
         self._ref_counts: Dict[ObjectID, int] = collections.defaultdict(int)
         self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
+        # name-claimed actors whose creation spec has not arrived yet:
+        # actor_id -> deadline for the spec to land
+        self._placeholder_deadlines: Dict[ActorID, float] = {}
 
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
@@ -447,8 +455,14 @@ class Scheduler:
             if st is not None:
                 st.num_handles += delta
                 # out-of-scope actors terminate like the reference's
-                # GcsActorManager handle tracking
-                if st.num_handles <= 0 and st.name is None and st.state != "DEAD":
+                # GcsActorManager handle tracking; named and detached actors
+                # live until an explicit kill
+                if (
+                    st.num_handles <= 0
+                    and st.name is None
+                    and not st.detached
+                    and st.state != "DEAD"
+                ):
                     self._kill_actor(actor_id, no_restart=True)
         elif kind == "create_pg":
             self._create_pg(cmd[1])
@@ -483,14 +497,44 @@ class Scheduler:
         self.tasks[spec.task_id] = rec
         self._record_event(spec, "PENDING")
         if spec.task_type == TaskType.ACTOR_CREATION:
-            st = ActorState(
-                actor_id=spec.actor_id,
-                creation_spec=spec,
-                restarts_left=spec.max_restarts,
-                name=spec.actor_name,
-                namespace=spec.namespace or "default",
-            )
-            self.actors[spec.actor_id] = st
+            st = self.actors.get(spec.actor_id)
+            if st is not None and st.creation_spec is None and st.state == "DEAD":
+                # the placeholder deadline expired and released the name;
+                # resurrecting it could shadow a newer claimant of that name
+                self._fail_task(
+                    rec,
+                    exc.ActorDiedError(
+                        spec.actor_id, st.death_cause or "actor creation timed out"
+                    ),
+                )
+                return
+            if st is not None and st.creation_spec is None:
+                # fill in the placeholder pre-registered at name-claim time;
+                # method calls that raced ahead are queued in pending_calls
+                st.creation_spec = spec
+                st.restarts_left = spec.max_restarts
+                st.name = spec.actor_name
+                st.namespace = spec.namespace or "default"
+                st.detached = spec.detached
+                st.max_task_retries = spec.max_task_retries
+                self._placeholder_deadlines.pop(spec.actor_id, None)
+                # calls queued against the placeholder inherited a zero
+                # retry budget; backfill it
+                for queued in st.pending_calls:
+                    qrec = self.tasks.get(queued.task_id)
+                    if qrec is not None and qrec.retries_left == 0:
+                        qrec.retries_left = spec.max_task_retries
+            else:
+                st = ActorState(
+                    actor_id=spec.actor_id,
+                    creation_spec=spec,
+                    restarts_left=spec.max_restarts,
+                    name=spec.actor_name,
+                    namespace=spec.namespace or "default",
+                    detached=spec.detached,
+                    max_task_retries=spec.max_task_retries,
+                )
+                self.actors[spec.actor_id] = st
             if spec.actor_name:
                 self.gcs.claim_actor_name(st.namespace, spec.actor_name, spec.actor_id)
         if spec.task_type == TaskType.ACTOR_TASK:
@@ -501,6 +545,8 @@ class Scheduler:
                     rec, exc.ActorDiedError(spec.actor_id, reason or "actor died")
                 )
                 return
+            # method calls inherit the actor's per-task retry budget
+            rec.retries_left = actor.max_task_retries
         # dependency check
         deps = self._unresolved_deps(spec)
         if deps:
@@ -531,6 +577,19 @@ class Scheduler:
 
         Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
         (``cluster_task_manager.cc:136``)."""
+        if self._placeholder_deadlines:
+            now = time.monotonic()
+            for aid in [
+                a for a, d in self._placeholder_deadlines.items() if d < now
+            ]:
+                del self._placeholder_deadlines[aid]
+                st = self.actors.get(aid)
+                if st is not None and st.creation_spec is None:
+                    st.state = "DEAD"
+                    st.death_cause = "actor creation spec never arrived"
+                    if st.name:
+                        self.gcs.named_actors.pop((st.namespace, st.name), None)
+                    self._drain_actor_queue(st)
         for pg in self.placement_groups.values():
             if pg.state == "PENDING":
                 self._create_pg(pg)
@@ -677,6 +736,29 @@ class Scheduler:
         w = self.workers[wid]
         rec = self.tasks.get(task_id)
         spec = rec.spec if rec else None
+        # retry_exceptions: re-execute on matching application exception
+        # instead of committing the error (ref: TaskManager retries,
+        # src/ray/core_worker/task_manager.h:208)
+        if (
+            rec is not None
+            and spec is not None
+            and spec.task_type == TaskType.NORMAL_TASK
+            and not spec.is_streaming  # earlier stream items are committed
+            and spec.retry_exceptions
+            and rec.retries_left > 0
+            and results
+            and results[0][0] == "error"
+            and self._retryable_app_error(results[0], spec.retry_exceptions)
+        ):
+            rec.retries_left -= 1
+            self._record_event(spec, "RETRY")
+            if w.state in ("busy", "blocked"):
+                self._release_resources(w)
+                w.current_task = None
+                w.state = "idle"
+                self._idle_by_node[w.node_id].append(wid)
+            self._make_schedulable(rec)
+            return
         if rec is not None:
             rec.state = "FINISHED"
             rec.end_time = time.monotonic()
@@ -728,6 +810,23 @@ class Scheduler:
                 self._idle_by_node[w.node_id].append(wid)
         elif spec is not None and spec.task_type == TaskType.ACTOR_TASK:
             w.current_task = None
+
+    @staticmethod
+    def _retryable_app_error(entry: Tuple, retry_exceptions) -> bool:
+        if retry_exceptions is True:
+            return True
+        try:
+            err = pickle.loads(entry[1])
+        except Exception:
+            return False
+        cause = getattr(err, "cause", None) or err
+        # match by qualified name across the cause's MRO (subclasses retry
+        # too); class identity does not survive by-value pickling
+        wanted = set(retry_exceptions)
+        for c in type(cause).__mro__:
+            if f"{c.__module__}.{c.__qualname__}" in wanted:
+                return True
+        return False
 
     def _unpin(self, oids):
         for oid in oids:
@@ -838,16 +937,25 @@ class Scheduler:
         if w.actor_id is not None:
             actor = self.actors.get(w.actor_id)
             if actor is not None and actor.state != "DEAD":
-                # fail all in-flight calls on this actor
+                will_restart = not graceful and actor.restarts_left != 0
+                # in-flight calls: requeue onto the restarted actor when a
+                # max_task_retries budget remains, else fail
                 for rec in list(self.tasks.values()):
                     if (
                         rec.spec.task_type == TaskType.ACTOR_TASK
                         and rec.spec.actor_id == w.actor_id
                         and rec.state == "RUNNING"
                     ):
-                        self._fail_task(
-                            rec, exc.ActorDiedError(w.actor_id, "actor worker died")
-                        )
+                        if will_restart and rec.retries_left != 0:
+                            if rec.retries_left > 0:
+                                rec.retries_left -= 1
+                            rec.state = "PENDING"
+                            rec.worker_id = None
+                            actor.pending_calls.append(rec.spec)
+                        else:
+                            self._fail_task(
+                                rec, exc.ActorDiedError(w.actor_id, "actor worker died")
+                            )
                 if graceful:
                     actor.state = "DEAD"
                     actor.death_cause = "actor exited"
@@ -1053,7 +1161,24 @@ class Scheduler:
             ns, name = args
             return self.gcs.named_actors.get((ns, name))
         if op == "claim_actor_name":
-            return self.gcs.claim_actor_name(*args)
+            ns, name, actor_id = args
+            claimed = self.gcs.claim_actor_name(ns, name, actor_id)
+            if claimed and actor_id not in self.actors:
+                # Pre-register so a method call submitted through another
+                # pipe before the ACTOR_CREATION spec lands queues instead of
+                # failing with "actor not found" (the get_actor-by-name race;
+                # ref: GcsActorManager registers state with the name,
+                # gcs_actor_manager.h:278). If the claimant crashes before
+                # submitting the creation spec, the deadline sweep fails the
+                # queued calls instead of hanging them forever.
+                self.actors[actor_id] = ActorState(
+                    actor_id=actor_id,
+                    creation_spec=None,
+                    name=name,
+                    namespace=ns,
+                )
+                self._placeholder_deadlines[actor_id] = time.monotonic() + 30.0
+            return claimed
         if op == "actor_state":
             st = self.actors.get(args[0])
             return None if st is None else st.state

@@ -14,6 +14,7 @@ numpy array view is aligned for dlpack/device_put.
 
 from __future__ import annotations
 
+import collections
 import io
 import pickle
 import struct
@@ -37,12 +38,15 @@ class SerializationContext:
 
     def __init__(self):
         self._custom: dict = {}
+        self._pickler_cls = None  # cache, rebuilt on (de)registration
 
     def register_serializer(self, cls, *, serializer: Callable, deserializer: Callable):
         self._custom[cls] = (serializer, deserializer)
+        self._pickler_cls = None
 
     def deregister_serializer(self, cls):
         self._custom.pop(cls, None)
+        self._pickler_cls = None
 
     # -- wire format ------------------------------------------------------
 
@@ -54,21 +58,39 @@ class SerializationContext:
             buffers.append(buf)
             return False  # do not serialize in-band
 
-        class _Pickler(cloudpickle.Pickler):
-            pass
+        sio = io.BytesIO()
+        p = self._get_pickler_cls()(sio, protocol=5, buffer_callback=buffer_callback)
+        p.dump(value)
+        return sio.getvalue(), buffers
 
+    def _get_pickler_cls(self):
+        if self._pickler_cls is not None:
+            return self._pickler_cls
+        if not self._custom:
+            self._pickler_cls = cloudpickle.Pickler
+            return self._pickler_cls
+        # Dispatch table scoped to a context-owned subclass, so custom
+        # reducers never leak into cloudpickle's process-global table and
+        # deregistration actually takes effect. (The C pickler snapshots
+        # dispatch_table at construction, so it must be a class attribute
+        # before instantiation.)
+        custom_reducers = {}
         for cls, (ser, des) in self._custom.items():
             def make_reduce(ser=ser, des=des):
                 def _reduce(obj):
                     return (_deserialize_custom, (cloudpickle.dumps(des), ser(obj)))
                 return _reduce
-            _Pickler.dispatch_table = getattr(_Pickler, "dispatch_table", {})
-            _Pickler.dispatch_table[cls] = make_reduce()
-
-        sio = io.BytesIO()
-        p = _Pickler(sio, protocol=5, buffer_callback=buffer_callback)
-        p.dump(value)
-        return sio.getvalue(), buffers
+            custom_reducers[cls] = make_reduce()
+        base = getattr(cloudpickle.Pickler, "dispatch_table", None)
+        table = (
+            collections.ChainMap(custom_reducers, base)
+            if base is not None
+            else custom_reducers
+        )
+        self._pickler_cls = type(
+            "_ContextPickler", (cloudpickle.Pickler,), {"dispatch_table": table}
+        )
+        return self._pickler_cls
 
     def serialized_size(self, pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
         n = _HDR.size + 8 * len(buffers)

@@ -63,9 +63,14 @@ class TaskSpec:
     max_concurrency: int = 1
     actor_name: Optional[str] = None
     namespace: Optional[str] = None
+    # detached actors outlive their handles (reaped only via kill)
+    detached: bool = False
+    # default retry budget for this actor's method calls on actor restart
+    max_task_retries: int = 0
     # retries
     max_retries: int = 0
-    retry_exceptions: bool = False
+    # False | True (retry any app exception) | list of exception types
+    retry_exceptions: Any = False
     # scheduling
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Optional[dict] = None

@@ -404,7 +404,10 @@ class WorkerRuntime:
                     spec.name or "task", tb, e if isinstance(e, Exception) else None
                 )
             try:
-                blob = pickle.dumps(err)
+                # cloudpickle: user exception classes defined in the driver's
+                # __main__ don't exist in this process and need by-value
+                # pickling to survive the trip back
+                blob = cloudpickle.dumps(err)
             except Exception:
                 err = exc.TaskError(spec.name or "task", tb, None)
                 blob = pickle.dumps(err)

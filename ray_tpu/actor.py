@@ -181,6 +181,8 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=int(opts.get("max_restarts") or 0),
             max_concurrency=int(opts.get("max_concurrency") or 1),
+            max_task_retries=int(opts.get("max_task_retries") or 0),
+            detached=opts.get("lifetime") == "detached",
             actor_name=name,
             namespace=namespace,
             scheduling_strategy=resolve_strategy(opts),

@@ -34,7 +34,17 @@ def load_native():
     if _LIB is not None or _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    if not os.path.exists(_SO) and not _try_build():
+    # Rebuild (atomically, via make temp+rename) only when the source is
+    # newer than the .so — a plain mtime compare keeps worker startup free
+    # of subprocess overhead. A stale .so is never silently preferred.
+    src = os.path.join(_DIR, "object_store.cc")
+    try:
+        stale = not os.path.exists(_SO) or (
+            os.path.getmtime(src) > os.path.getmtime(_SO)
+        )
+    except OSError:
+        stale = True
+    if stale and not _try_build() and not os.path.exists(_SO):
         return None
     try:
         lib = ctypes.CDLL(_SO)

@@ -44,6 +44,30 @@ def resolve_resources(opts: Dict[str, Any]) -> Dict[str, float]:
     return res
 
 
+def _normalize_retry_exceptions(value):
+    """False | True | exception class | list of classes -> False|True|names.
+
+    Classes are stored as qualified-name strings: TaskSpec travels to workers
+    over plain pickle (user classes may not import there), and pickling
+    ``__main__`` classes by value breaks ``isinstance`` identity. The
+    scheduler matches names against the raised cause's MRO.
+    """
+    if not value:
+        return False
+    if value is True:
+        return True
+    if isinstance(value, type) and issubclass(value, BaseException):
+        value = [value]
+    names = []
+    for v in value:
+        if not (isinstance(v, type) and issubclass(v, BaseException)):
+            raise TypeError(
+                f"retry_exceptions entries must be exception types, got {v!r}"
+            )
+        names.append(f"{v.__module__}.{v.__qualname__}")
+    return names
+
+
 def resolve_strategy(opts) -> SchedulingStrategy:
     strat = opts.get("scheduling_strategy")
     if strat is None:
@@ -95,7 +119,9 @@ class RemoteFunction:
             resources=resolve_resources(opts),
             name=opts.get("name") or self._name,
             max_retries=int(opts.get("max_retries") or 0),
-            retry_exceptions=bool(opts.get("retry_exceptions")),
+            retry_exceptions=_normalize_retry_exceptions(
+                opts.get("retry_exceptions")
+            ),
             scheduling_strategy=resolve_strategy(opts),
             runtime_env=opts.get("runtime_env"),
             is_streaming=streaming,

@@ -91,3 +91,113 @@ def test_actor_method_num_returns(ray_start_regular):
     s = Splitter.remote()
     r1, r2 = s.pair.remote()
     assert ray_tpu.get([r1, r2], timeout=60) == ["a", "b"]
+
+
+def test_retry_exceptions_true(ray_start_regular, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"attempt {n}")
+        return n
+
+    assert ray_tpu.get(flaky.remote(), timeout=60) == 2
+
+
+def test_retry_exceptions_list_no_match(ray_start_regular, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=[KeyError])
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(flaky.remote(), timeout=60)
+    assert marker.read_text() == "1"  # no retries on a non-matching type
+
+
+def test_retry_exceptions_list_match(ray_start_regular, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=[ValueError])
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n == 0:
+            raise ValueError("retry me")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote(), timeout=60) == "ok"
+
+
+def test_detached_actor_survives_handle_drop(ray_start_regular):
+    import gc
+
+    @ray_tpu.remote(lifetime="detached", name="det1")
+    class Holder:
+        def __init__(self):
+            self.v = 41
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.bump.remote(), timeout=60) == 42
+    aid = h._actor_id
+    del h
+    gc.collect()
+    time.sleep(0.3)
+    h2 = ray_tpu.get_actor("det1")
+    assert ray_tpu.get(h2.bump.remote(), timeout=60) == 43
+    ray_tpu.kill(h2)
+
+
+def test_actor_max_task_retries_on_restart(ray_start_regular, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Crashy:
+        def work(self):
+            import os
+
+            n = int(marker.read_text()) if marker.exists() else 0
+            marker.write_text(str(n + 1))
+            if n == 0:
+                os._exit(1)  # kill the actor worker mid-call
+            return n
+
+    c = Crashy.remote()
+    assert ray_tpu.get(c.work.remote(), timeout=60) == 1
+
+
+def test_custom_serializer_scoped_and_deregisterable(ray_start_regular):
+    import cloudpickle
+
+    from ray_tpu._private.serialization import get_context
+
+    class Odd:
+        def __init__(self, x):
+            self.x = x
+
+    ctx = get_context()
+    ctx.register_serializer(
+        Odd, serializer=lambda o: o.x * 10, deserializer=lambda p: Odd(p)
+    )
+    try:
+        blob = ctx.serialize_to_bytes(Odd(3))
+        out = ctx.deserialize_from(memoryview(blob))
+        assert isinstance(out, Odd) and out.x == 30
+        # the registration must not leak into plain cloudpickle
+        plain = cloudpickle.loads(cloudpickle.dumps(Odd(5)))
+        assert plain.x == 5
+    finally:
+        ctx.deregister_serializer(Odd)
+    blob = ctx.serialize_to_bytes(Odd(7))
+    out = ctx.deserialize_from(memoryview(blob))
+    assert out.x == 7  # default path after deregistration
