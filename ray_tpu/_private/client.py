@@ -1,0 +1,86 @@
+"""Remote driver: connect to a running cluster over its head socket.
+
+Design parity: ``ray.init(address=...)`` attaching a driver to an existing
+cluster (``python/ray/_private/worker.py:1225``, the ``address="auto"`` path).
+The remote driver reuses the worker wire protocol (submit/pull/rpc over one
+socket) — it is a worker that never executes tasks — so the head needs no
+driver-specific plumbing beyond the handshake (``head.py``). For same-machine
+drivers the head's shm store is mapped directly; objects on other nodes are
+pulled into it by the scheduler on demand.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from multiprocessing.connection import Client
+from typing import Optional
+
+from ray_tpu._private.ids import JobID, TaskID, WorkerID
+from ray_tpu._private.worker_process import WorkerRuntime
+
+
+class RemoteDriverRuntime(WorkerRuntime):
+    """Driver attached to a remote head. API-compatible with DriverRuntime."""
+
+    def __init__(self, address, auth_key: str):
+        if isinstance(address, str):
+            host, port = address.rsplit(":", 1)
+            address = (host, int(port))
+        key = auth_key.encode() if isinstance(auth_key, str) else auth_key
+        conn = Client(tuple(address), authkey=key)
+        conn.send(("register_driver", os.getpid()))
+        kind, info = conn.recv()
+        assert kind == "driver_registered", kind
+        config = pickle.loads(info["config_blob"])
+
+        # remote drivers must share the head's shm in this version: verify
+        # the head's session marker instead of silently creating an empty
+        # store at the same path on a different machine
+        marker = os.path.join(info["shm_dir"], ".cluster_session")
+        session = info.get("session_name", "")
+        try:
+            with open(marker) as fh:
+                found = fh.read().strip()
+        except OSError:
+            found = None
+        if found != session:
+            conn.close()
+            raise RuntimeError(
+                "ray_tpu.init(address=...) requires the driver to run on the "
+                "head machine (head shm not visible at "
+                f"{info['shm_dir']!r}); run the driver there or submit a job"
+            )
+
+        from ray_tpu._private.native_store import create_store_client
+
+        store = create_store_client(
+            info["shm_dir"], info["fallback_dir"], config.object_store_memory
+        )
+        super().__init__(conn, WorkerID(info["worker_id"]), store, config)
+        # unique put-id namespace per driver (workers get theirs per-task)
+        self.job_id = JobID.from_int(int.from_bytes(os.urandom(3), "little"))
+        self.current_task_id = TaskID.for_driver(self.job_id)
+        self.closed = False
+        self._reader = threading.Thread(
+            target=self.reader_loop, name="client-reader", daemon=True
+        )
+        self._reader.start()
+
+    def shutdown(self):
+        """Disconnect from the cluster (the cluster keeps running)."""
+        self.closed = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+
+def connect(address, auth_key: Optional[str] = None) -> RemoteDriverRuntime:
+    auth_key = auth_key or os.environ.get("RAY_TPU_AUTH", "")
+    return RemoteDriverRuntime(address, auth_key)

@@ -48,6 +48,10 @@ class Config:
     # --- health / fault tolerance ---
     health_check_period_ms: int = 1000  # ref: gcs_health_check_manager.h:55
     health_check_failure_threshold: int = 5
+    health_check_timeout_s: float = 10.0  # daemon declared dead after this
+    # --- multi-host cluster ---
+    cluster_host: str = "127.0.0.1"  # head listener bind address
+    cluster_auth_key: str = ""  # shared secret; generated per session if empty
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
     # --- events / metrics ---

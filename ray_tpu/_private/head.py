@@ -1,0 +1,144 @@
+"""Head server: the cluster's socket front door.
+
+Design parity: the GCS server process boundary
+(``src/ray/gcs/gcs_server/gcs_server.h:78``) — node daemons register here
+(``GcsNodeManager``, ``gcs_node_manager.h:44``), remote drivers connect here,
+and the head exposes its own object server so daemons can pull driver-put
+objects (``object_manager.h:117``). The scheduler stays the single brain
+(actor/PG/task placement — the reference's ``ScheduleByGcs`` mode,
+``gcs_actor_scheduler.cc:60``); daemons relay their local workers' pipe
+traffic over one multiplexed socket each.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import secrets
+import threading
+from multiprocessing.connection import Listener
+from typing import Optional
+
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_transfer import ObjectServer
+from ray_tpu._private.scheduler import NodeState, WorkerState
+
+logger = logging.getLogger(__name__)
+
+
+class HeadServer:
+    """Listens for node daemons and remote drivers; hands live connections to
+    the scheduler loop."""
+
+    def __init__(self, node, config):
+        self._node = node
+        self._config = config
+        if not config.cluster_auth_key:
+            config.cluster_auth_key = secrets.token_hex(16)
+        self.auth_key = config.cluster_auth_key.encode()
+        self._listener = Listener((config.cluster_host, 0), authkey=self.auth_key)
+        self.address = self._listener.address
+        # object server over the head's local store (daemons pull driver puts
+        # and head-computed results from here)
+        self._object_server = ObjectServer(
+            node.store_client, config.cluster_host, self.auth_key
+        )
+        node.scheduler.head_object_addr = self._object_server.address
+        # session marker: lets a connecting driver detect whether it really
+        # shares this machine's shm (remote drivers would silently create an
+        # empty store at the same path otherwise)
+        import os
+
+        try:
+            with open(os.path.join(node.shm_dir, ".cluster_session"), "w") as fh:
+                fh.write(node.session_name)
+        except OSError:
+            pass
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="head-server", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._stop:
+                    return
+                continue
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn):
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "register_node":
+            info = msg[1]
+            total = {k: float(v) for k, v in info["resources"].items()}
+            ns = NodeState(
+                node_id=NodeID(info["node_id"]),
+                total=dict(total),
+                available=dict(total),
+                labels=dict(info.get("labels") or {}),
+                daemon_conn=conn,
+                object_addr=info["object_addr"],
+            )
+            conn.send(
+                (
+                    "registered",
+                    {
+                        "session_name": self._node.session_name,
+                        "config_blob": pickle.dumps(self._config),
+                        "node_id": ns.node_id.binary(),
+                    },
+                )
+            )
+            self._node.scheduler.post(("register_daemon", conn, ns))
+            logger.info(
+                "node %s registered (%s)", ns.node_id.hex()[:8], info["resources"]
+            )
+        elif kind == "register_driver":
+            wid = WorkerID.from_random()
+            conn.send(
+                (
+                    "driver_registered",
+                    {
+                        "worker_id": wid.binary(),
+                        "shm_dir": self._node.shm_dir,
+                        "fallback_dir": self._node.fallback_dir,
+                        "config_blob": pickle.dumps(self._config),
+                        "node_id": self._node.head_node_id.binary(),
+                        "session_name": self._node.session_name,
+                    },
+                )
+            )
+            # a remote driver is a worker that never executes tasks: register
+            # it so replies route through the normal worker plumbing
+            ws = WorkerState(
+                worker_id=wid,
+                conn=conn,
+                proc=None,
+                node_id=self._node.head_node_id,
+                state="driver",
+            )
+            self._node.scheduler.post(("worker_spawned", ws))
+        else:
+            logger.warning("unknown handshake %r", kind)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        for closable in (self._listener, self._object_server):
+            try:
+                closable.close()
+            except OSError:
+                pass

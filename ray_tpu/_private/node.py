@@ -114,12 +114,31 @@ class Node:
 
         self._config_blob = pickle.dumps(config)
         self._ctx = _get_ctx()
+        self.head_server = None  # started on demand (start_head_server)
         atexit.register(self._atexit)
         self._closed = False
 
         if config.prestart_workers:
             for _ in range(min(2, int(num_cpus))):
                 self.spawn_worker(self.head_node_id)
+
+    # -- multi-host --------------------------------------------------------
+
+    def start_head_server(self):
+        """Open the cluster socket front door (idempotent); returns address.
+
+        Parity: starting the GCS server + exposing the head's object plane
+        (``gcs_server.h:78``, ``object_manager.h:117``).
+        """
+        if self.head_server is None:
+            from ray_tpu._private.head import HeadServer
+
+            self.head_server = HeadServer(self, self.config)
+        return self.head_server.address
+
+    @property
+    def cluster_address(self):
+        return None if self.head_server is None else self.head_server.address
 
     # -- virtual nodes (parity: cluster_utils.Cluster.add_node) -----------
 
@@ -148,6 +167,26 @@ class Node:
     def spawn_worker(self, node_id: NodeID) -> WorkerID:
         from ray_tpu._private import worker_process
 
+        # daemon-backed node: instruct the remote raylet to spawn; its worker
+        # pipe traffic is relayed over the daemon socket (called from the
+        # scheduler thread, so reading scheduler.nodes is safe)
+        ns = self.scheduler.nodes.get(node_id)
+        if ns is not None and ns.daemon_conn is not None:
+            from ray_tpu._private.scheduler import DaemonWorkerChannel
+
+            wid = WorkerID.from_random()
+            lock = self.scheduler._daemon_send_locks.get(ns.daemon_conn)
+            channel = DaemonWorkerChannel(ns.daemon_conn, wid.binary(), lock)
+            try:
+                with lock:
+                    ns.daemon_conn.send(("spawn_worker", wid.binary()))
+            except (OSError, EOFError):
+                self.scheduler._on_daemon_death(ns.daemon_conn)
+                return wid
+            ws = WorkerState(worker_id=wid, conn=channel, proc=None, node_id=node_id)
+            self.scheduler.post(("worker_spawned", ws))
+            return wid
+
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         wid = WorkerID.from_random()
         proc = self._ctx.Process(
@@ -168,6 +207,8 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        if self.head_server is not None:
+            self.head_server.close()
         self.scheduler.shutdown()
         self.store_client.close()
         destroy_store(self.shm_dir)

@@ -52,6 +52,23 @@ class StorePutMixin:
         buf[:] = data
         self.seal(oid)
 
+    def put_serialized(self, oid: ObjectID, serde, value) -> None:
+        """Serialize straight into the store buffer (one copy fewer than
+        serialize-to-bytes + put_bytes; parity: plasma clients write into the
+        create()d buffer, ``plasma_store_provider.h:88``)."""
+        pickled, buffers = serde.serialize(value)
+        size = serde.serialized_size(pickled, buffers)
+        if self.contains(oid):
+            return
+        try:
+            buf = self.create(oid, size)
+        except ValueError:
+            if self.contains(oid):
+                return
+            raise
+        serde.write_to(pickled, buffers, buf)
+        self.seal(oid)
+
 
 class ObjectStoreClient(StorePutMixin):
     """Client handle to the shm store; safe to use from one process."""

@@ -1,0 +1,314 @@
+"""Node daemon: the per-node process of the multi-host runtime.
+
+Design parity: the raylet (``src/ray/raylet/raylet.h:35``) reduced to its
+node-plane duties — worker pool hosting (``worker_pool.h:83``), local object
+store ownership (plasma runs inside the raylet, ``store_runner.h:14``), and
+the node half of inter-node object transfer (``object_manager.h:117``).
+Scheduling decisions stay at the head (the reference's ScheduleByGcs mode);
+this process relays its workers' pipe traffic over one socket to the head,
+spawns/kills workers on command, heartbeats, and serves/fetches objects.
+
+Runs standalone:  python -m ray_tpu._private.raylet --address HOST:PORT \
+    --auth-key-env RAY_TPU_AUTH --num-cpus 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import threading
+import time
+from multiprocessing import connection as mpc
+from multiprocessing.connection import Client
+from typing import Dict
+
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_PERIOD_S = 1.0
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        head_addr,
+        auth_key: bytes,
+        num_cpus: float,
+        num_tpus: float = 0.0,
+        resources: Dict[str, float] | None = None,
+        labels: Dict[str, str] | None = None,
+        host: str = "127.0.0.1",
+    ):
+        self.node_id = NodeID.from_random()
+        self.auth_key = auth_key
+        self.conn = Client(tuple(head_addr), authkey=auth_key)
+        self._send_lock = threading.Lock()
+
+        total: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update({k: float(v) for k, v in (resources or {}).items()})
+
+        # local store dirs (one per daemon: a real separate node plane even
+        # when colocated on one machine for tests)
+        shm_root = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+        suffix = f"ray_tpu_node_{self.node_id.hex()[:12]}"
+        self.shm_dir = os.path.join(shm_root, suffix)
+        self.fallback_dir = os.path.join("/tmp", suffix + "_spill")
+
+        from ray_tpu._private.native_store import create_store_client
+        from ray_tpu._private.object_transfer import ObjectServer
+
+        # the object server starts before the store exists (its address goes
+        # into the registration); the store is created with the head's
+        # configured capacity once the config arrives in the reply. The head
+        # never directs fetches at this node before registration completes.
+        self.store = None
+        self.object_server = ObjectServer(lambda: self.store, host, auth_key)
+
+        self._send(
+            (
+                "register_node",
+                {
+                    "node_id": self.node_id.binary(),
+                    "resources": total,
+                    "labels": labels or {},
+                    "object_addr": self.object_server.address,
+                    "pid": os.getpid(),
+                },
+            )
+        )
+        reply = self.conn.recv()
+        assert reply[0] == "registered", reply
+        self.session_name = reply[1]["session_name"]
+        self.config = pickle.loads(reply[1]["config_blob"])
+        self._config_blob = reply[1]["config_blob"]
+        self.store = create_store_client(
+            self.shm_dir, self.fallback_dir, self.config.object_store_memory
+        )
+
+        import multiprocessing as mp
+
+        method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
+        self._ctx = mp.get_context(method)
+        # wid -> (proc, pipe)
+        self.workers: Dict[WorkerID, tuple] = {}
+        self._pipe_to_wid: Dict[object, WorkerID] = {}
+        self._stop = False
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self):
+        last_beat = 0.0
+        try:
+            while not self._stop:
+                now = time.monotonic()
+                if now - last_beat >= HEARTBEAT_PERIOD_S:
+                    last_beat = now
+                    try:
+                        self._send(("heartbeat", now))
+                    except (OSError, EOFError):
+                        break
+                waitables = [self.conn] + list(self._pipe_to_wid.keys())
+                try:
+                    ready = mpc.wait(waitables, timeout=0.2)
+                except OSError:
+                    ready = []
+                for r in ready:
+                    if r is self.conn:
+                        if not self._drain_head():
+                            return
+                    else:
+                        self._drain_worker_pipe(r)
+        finally:
+            self._shutdown()
+
+    def _drain_head(self) -> bool:
+        try:
+            while self.conn.poll(0):
+                msg = self.conn.recv()
+                if not self._handle_head_msg(msg):
+                    return False
+        except (EOFError, OSError):
+            logger.info("head connection lost; exiting")
+            return False
+        return True
+
+    def _handle_head_msg(self, msg) -> bool:
+        kind = msg[0]
+        if kind == "spawn_worker":
+            self._spawn_worker(WorkerID(msg[1]))
+        elif kind == "to_worker":
+            _, wid_bin, inner = msg
+            entry = self.workers.get(WorkerID(wid_bin))
+            if entry is not None:
+                try:
+                    entry[1].send(inner)
+                except (OSError, EOFError, BrokenPipeError):
+                    self._on_worker_pipe_death(WorkerID(wid_bin))
+        elif kind == "kill_worker":
+            entry = self.workers.get(WorkerID(msg[1]))
+            if entry is not None and entry[0] is not None:
+                try:
+                    entry[0].terminate()
+                except Exception:
+                    pass
+        elif kind == "fetch_object":
+            _, oid_bin, src_addr = msg
+            threading.Thread(
+                target=self._fetch_object,
+                args=(ObjectID(oid_bin), src_addr),
+                daemon=True,
+            ).start()
+        elif kind == "delete_object":
+            oid = ObjectID(msg[1])
+            try:
+                if self.store.contains(oid):
+                    self.store.delete(oid)
+            except Exception:
+                pass
+        elif kind == "exit":
+            return False
+        else:
+            logger.warning("unknown head message %r", kind)
+        return True
+
+    # -- workers -----------------------------------------------------------
+
+    def _spawn_worker(self, wid: WorkerID):
+        from ray_tpu._private import worker_process
+
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=worker_process.worker_main,
+            args=(
+                child_conn,
+                wid.binary(),
+                self.shm_dir,
+                self.fallback_dir,
+                self._config_blob,
+            ),
+            name=f"ray_tpu-worker-{wid.hex()[:8]}",
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        self.workers[wid] = (proc, parent_conn)
+        self._pipe_to_wid[parent_conn] = wid
+
+    def _drain_worker_pipe(self, pipe):
+        wid = self._pipe_to_wid.get(pipe)
+        if wid is None:
+            return
+        try:
+            while pipe.poll(0):
+                msg = pipe.recv()
+                self._send(("worker_msg", wid.binary(), msg))
+        except (EOFError, OSError):
+            self._on_worker_pipe_death(wid)
+
+    def _on_worker_pipe_death(self, wid: WorkerID):
+        entry = self.workers.pop(wid, None)
+        if entry is None:
+            return
+        proc, pipe = entry
+        self._pipe_to_wid.pop(pipe, None)
+        try:
+            pipe.close()
+        except OSError:
+            pass
+        try:
+            self._send(("worker_died", wid.binary()))
+        except (OSError, EOFError):
+            pass
+
+    # -- object plane ------------------------------------------------------
+
+    def _fetch_object(self, oid: ObjectID, src_addr):
+        from ray_tpu._private.object_transfer import fetch_object_bytes
+
+        ok = False
+        try:
+            if self.store.contains(oid):
+                ok = True
+            else:
+                blob = fetch_object_bytes(src_addr, oid, self.auth_key)
+                if blob is not None:
+                    self.store.put_bytes(oid, blob)
+                    ok = True
+        except Exception:
+            logger.exception("fetch %s failed", oid.hex()[:8])
+        try:
+            self._send(("object_fetched", oid.binary(), ok))
+        except (OSError, EOFError):
+            pass
+
+    # -- teardown ----------------------------------------------------------
+
+    def _shutdown(self):
+        self._stop = True
+        for wid, (proc, pipe) in list(self.workers.items()):
+            try:
+                pipe.send(("exit",))
+            except (OSError, EOFError):
+                pass
+        deadline = time.monotonic() + 2
+        for wid, (proc, pipe) in list(self.workers.items()):
+            if proc is not None:
+                proc.join(timeout=max(0, deadline - time.monotonic()))
+                if proc.is_alive():
+                    proc.terminate()
+        self.object_server.close()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        from ray_tpu._private.object_store import destroy_store
+
+        destroy_store(self.shm_dir)
+        import shutil
+
+        shutil.rmtree(self.fallback_dir, ignore_errors=True)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="ray_tpu node daemon")
+    parser.add_argument("--address", required=True, help="head HOST:PORT")
+    parser.add_argument(
+        "--auth-key-env",
+        default="RAY_TPU_AUTH",
+        help="env var holding the cluster auth key (hex)",
+    )
+    parser.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 1))
+    parser.add_argument("--num-tpus", type=float, default=0.0)
+    parser.add_argument("--resources", default="{}", help="JSON extra resources")
+    parser.add_argument("--labels", default="{}", help="JSON node labels")
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args(argv)
+
+    import json
+
+    host, port = args.address.rsplit(":", 1)
+    auth = os.environ.get(args.auth_key_env, "")
+    daemon = NodeDaemon(
+        (host, int(port)),
+        auth.encode(),
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        host=args.host,
+    )
+    daemon.run()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

@@ -124,14 +124,20 @@ class MemoryStore:
 
 @dataclass
 class NodeState:
-    """Virtual node: resource ledger. Parity: ``NodeResources`` in
-    ``src/ray/common/scheduling/cluster_resource_data.h``."""
+    """Node: resource ledger (+ daemon link for remote nodes). Parity:
+    ``NodeResources`` in ``src/ray/common/scheduling/cluster_resource_data.h``;
+    daemon-backed nodes correspond to registered raylets."""
 
     node_id: NodeID
     total: Dict[str, float]
     available: Dict[str, float]
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
+    # remote (daemon-backed) nodes: socket to the node daemon + the address
+    # of its object server for peer pulls; None for the head/virtual nodes
+    daemon_conn: Any = None
+    object_addr: Any = None
+    last_heartbeat: float = 0.0
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) >= v for k, v in demand.items())
@@ -156,11 +162,36 @@ class NodeState:
         return max(fracs) if fracs else 0.0
 
 
+class DaemonWorkerChannel:
+    """Head-side stand-in for a remote worker's pipe: sends are wrapped and
+    routed over the owning node daemon's socket (the daemon relays to the
+    worker's real pipe). Parity: the raylet forwarding plane between GCS and
+    workers."""
+
+    __slots__ = ("daemon_conn", "wid_bin", "_lock")
+
+    def __init__(self, daemon_conn, wid_bin: bytes, lock: threading.Lock):
+        self.daemon_conn = daemon_conn
+        self.wid_bin = wid_bin
+        self._lock = lock
+
+    def send(self, msg):
+        with self._lock:
+            self.daemon_conn.send(("to_worker", self.wid_bin, msg))
+
+    def kill(self):
+        with self._lock:
+            self.daemon_conn.send(("kill_worker", self.wid_bin))
+
+    def close(self):
+        pass
+
+
 @dataclass
 class WorkerState:
     worker_id: WorkerID
-    conn: Any  # mp Connection
-    proc: Any  # mp Process
+    conn: Any  # mp Connection | DaemonWorkerChannel
+    proc: Any  # mp Process | None for remote workers
     node_id: NodeID
     state: str = "starting"  # starting|idle|busy|blocked|dead
     current_task: Optional[TaskID] = None
@@ -292,6 +323,19 @@ class Scheduler:
         # name-claimed actors whose creation spec has not arrived yet:
         # actor_id -> deadline for the spec to land
         self._placeholder_deadlines: Dict[ActorID, float] = {}
+        # ---- multi-host plane (daemon-backed nodes) ----
+        # daemon socket -> node id (the socket is in the wait set)
+        self._daemon_conns: Dict[Any, NodeID] = {}
+        # per-daemon send lock (fetch threads + loop share the socket)
+        self._daemon_send_locks: Dict[Any, threading.Lock] = {}
+        # object location directory: oid -> set of node ids with a sealed
+        # copy (parity: OwnershipBasedObjectDirectory,
+        # ownership_based_object_directory.h:37)
+        self._object_locations: Dict[ObjectID, Set[NodeID]] = collections.defaultdict(set)
+        # in-flight transfers: (oid, dest node)
+        self._fetching: Set[Tuple[ObjectID, NodeID]] = set()
+        # head node's own object server address (set by HeadServer)
+        self.head_object_addr = None
 
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
@@ -321,7 +365,7 @@ class Scheduler:
         self._started.set()
         wake = self._wakeup_r
         while not self._stop.is_set():
-            conns = list(self._conn_to_worker.keys())
+            conns = list(self._conn_to_worker.keys()) + list(self._daemon_conns.keys())
             try:
                 ready = mpc.wait(conns + [wake], timeout=0.2)
             except OSError:
@@ -332,6 +376,8 @@ class Scheduler:
                         os.read(wake, 4096)
                     except OSError:
                         pass
+                elif r in self._daemon_conns:
+                    self._drain_daemon(r)
                 else:
                     self._drain_worker(r)
             while True:
@@ -356,6 +402,52 @@ class Scheduler:
                 self._handle_worker_msg(wid, msg)
         except (EOFError, OSError, pickle.UnpicklingError):
             self._on_worker_death(wid)
+
+    def _drain_daemon(self, conn):
+        try:
+            while conn.poll(0):
+                msg = conn.recv()
+                self._handle_daemon_msg(conn, msg)
+        except (EOFError, OSError, pickle.UnpicklingError):
+            self._on_daemon_death(conn)
+
+    def _handle_daemon_msg(self, conn, msg: Tuple):
+        kind = msg[0]
+        if kind == "worker_msg":
+            _, wid_bin, inner = msg
+            wid = WorkerID(wid_bin)
+            if wid in self.workers:
+                self._handle_worker_msg(wid, inner)
+        elif kind == "worker_died":
+            self._on_worker_death(WorkerID(msg[1]))
+        elif kind == "object_fetched":
+            _, oid_bin, ok = msg
+            oid = ObjectID(oid_bin)
+            nid = self._daemon_conns.get(conn)
+            if nid is not None:
+                self._fetching.discard((oid, nid))
+                if ok:
+                    self._object_locations[oid].add(nid)
+        elif kind == "heartbeat":
+            nid = self._daemon_conns.get(conn)
+            node = self.nodes.get(nid) if nid is not None else None
+            if node is not None:
+                node.last_heartbeat = time.monotonic()
+        else:
+            logger.warning("unknown daemon message: %r", kind)
+
+    def _on_daemon_death(self, conn):
+        nid = self._daemon_conns.pop(conn, None)
+        self._daemon_send_locks.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if nid is not None:
+            logger.warning("node daemon %s disconnected; removing node", nid.hex()[:8])
+            for locs in self._object_locations.values():
+                locs.discard(nid)
+            self._on_remove_node(nid)
 
     # ---- worker messages -------------------------------------------------
 
@@ -392,6 +484,7 @@ class Scheduler:
             # graceful actor termination (ray.kill / __ray_terminate__)
             self._on_worker_death(wid, graceful=True)
         elif kind == "submit_put":
+            self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
         elif kind == "cmd":
             self._handle_cmd(msg[1])
@@ -409,6 +502,8 @@ class Scheduler:
             _, task_id, index, entry = msg
             # streaming generator item: task_id's return stream index -> object
             oid = ObjectID.for_return(TaskID(task_id.binary()), index)
+            if entry[0] == "stored":
+                self._object_locations[oid].add(self._loc_node(w.node_id))
             self._commit_result(oid, entry)
         else:
             logger.warning("unknown worker message: %r", kind)
@@ -420,6 +515,8 @@ class Scheduler:
             entry = self.memory_store.get_entry(oid)
             if entry is not None:
                 reply[oid] = entry
+                if entry[0] == "stored":
+                    self._ensure_local(oid, w.node_id)
             else:
                 self._pull_waiters[oid].append((wid, req_id))
                 reply[oid] = ("pending",)
@@ -428,6 +525,70 @@ class Scheduler:
         except (OSError, EOFError):
             self._on_worker_death(wid)
 
+    # ---- inter-node object transfer (parity: PullManager/PushManager,
+    # object_manager.h:117; pull-based, daemon object servers) -------------
+
+    def _loc_node(self, node_id: NodeID) -> NodeID:
+        """Canonical store-owning node: virtual nodes share the head store."""
+        node = self.nodes.get(node_id)
+        if node is None or node.daemon_conn is None:
+            return self._node.head_node_id
+        return node_id
+
+    def _object_server_addr(self, node_id: NodeID):
+        if node_id == self._node.head_node_id:
+            return self.head_object_addr
+        node = self.nodes.get(node_id)
+        return node.object_addr if node is not None else None
+
+    def _ensure_local(self, oid: ObjectID, dest: NodeID) -> None:
+        """Start (at most one) transfer of oid to dest if it has no copy."""
+        dest = self._loc_node(dest)
+        locs = self._object_locations.get(oid)
+        if not locs or dest in locs:
+            return
+        dest_node = self.nodes.get(dest)
+        key = (oid, dest)
+        if key in self._fetching:
+            return
+        src_addr = None
+        for src in locs:
+            src_addr = self._object_server_addr(src)
+            if src_addr is not None:
+                break
+        if src_addr is None:
+            return
+        self._fetching.add(key)
+        if dest == self._node.head_node_id:
+            threading.Thread(
+                target=self._fetch_into_head,
+                args=(oid, src_addr),
+                daemon=True,
+                name="obj-fetch",
+            ).start()
+        else:
+            lock = self._daemon_send_locks.get(dest_node.daemon_conn)
+            try:
+                with lock:
+                    dest_node.daemon_conn.send(
+                        ("fetch_object", oid.binary(), src_addr)
+                    )
+            except (OSError, EOFError):
+                self._on_daemon_death(dest_node.daemon_conn)
+
+    def _fetch_into_head(self, oid: ObjectID, src_addr) -> None:
+        from ray_tpu._private.object_transfer import fetch_object_bytes
+
+        ok = False
+        try:
+            blob = fetch_object_bytes(src_addr, oid, self.config.cluster_auth_key)
+            if blob is not None:
+                self._node.store_client.put_bytes(oid, blob)
+                ok = True
+        except Exception:
+            logger.exception("fetch of %s into head failed", oid.hex()[:8])
+        self.post(("fetch_done", oid, self._node.head_node_id, ok))
+
     # ---- command handling ------------------------------------------------
 
     def _handle_cmd(self, cmd: Tuple):
@@ -435,6 +596,8 @@ class Scheduler:
         if kind == "submit":
             self._on_submit(cmd[1])
         elif kind == "put_done":
+            if cmd[2][0] == "stored":
+                self._object_locations[cmd[1]].add(self._node.head_node_id)
             self._commit_result(cmd[1], cmd[2])
         elif kind == "add_node":
             node: NodeState = cmd[1]
@@ -445,7 +608,22 @@ class Scheduler:
         elif kind == "worker_spawned":
             _, wstate = cmd
             self.workers[wstate.worker_id] = wstate
-            self._conn_to_worker[wstate.conn] = wstate.worker_id
+            # only real (waitable) pipes join the wait set; remote workers'
+            # channels are drained via their daemon's socket
+            if not isinstance(wstate.conn, DaemonWorkerChannel):
+                self._conn_to_worker[wstate.conn] = wstate.worker_id
+        elif kind == "register_daemon":
+            _, conn, ns = cmd
+            self.nodes[ns.node_id] = ns
+            self._daemon_conns[conn] = ns.node_id
+            self._daemon_send_locks[conn] = threading.Lock()
+            ns.last_heartbeat = time.monotonic()
+            self._retry_pending_pgs()
+        elif kind == "fetch_done":
+            _, oid, nid, ok = cmd
+            self._fetching.discard((oid, nid))
+            if ok:
+                self._object_locations[oid].add(nid)
         elif kind == "kill_actor":
             _, actor_id, no_restart = cmd
             self._kill_actor(actor_id, no_restart)
@@ -577,6 +755,20 @@ class Scheduler:
 
         Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
         (``cluster_task_manager.cc:136``)."""
+        # daemon health: a node that missed heartbeats for the timeout window
+        # is declared dead (parity: GcsHealthCheckManager,
+        # gcs_health_check_manager.h:39)
+        if self._daemon_conns:
+            now = time.monotonic()
+            for conn, nid in list(self._daemon_conns.items()):
+                node = self.nodes.get(nid)
+                if (
+                    node is not None
+                    and node.last_heartbeat
+                    and now - node.last_heartbeat > self.config.health_check_timeout_s
+                ):
+                    logger.warning("node %s missed heartbeats", nid.hex()[:8])
+                    self._on_daemon_death(conn)
         if self._placeholder_deadlines:
             now = time.monotonic()
             for aid in [
@@ -767,6 +959,8 @@ class Scheduler:
         if spec is not None:
             for i, entry in enumerate(results):
                 oid = ObjectID.for_return(spec.task_id, i)
+                if entry[0] == "stored":
+                    self._object_locations[oid].add(self._loc_node(w.node_id))
                 self._commit_result(oid, entry)
             # drop the submitted-task arg pins (actor-creation args stay pinned:
             # a restart re-resolves them)
@@ -884,6 +1078,8 @@ class Scheduler:
         for wid, req_id in self._pull_waiters.pop(oid, ()):  # type: ignore[arg-type]
             w = self.workers.get(wid)
             if w is not None and w.state != "dead":
+                if entry[0] == "stored":
+                    self._ensure_local(oid, w.node_id)
                 try:
                     w.conn.send(("pull_reply", req_id, {oid: entry}))
                 except (OSError, EOFError):
@@ -998,11 +1194,10 @@ class Scheduler:
             self.gcs.named_actors.pop((actor.namespace, actor.name), None)
         if actor.worker_id is not None:
             w = self.workers.get(actor.worker_id)
-            if w is not None and w.proc is not None:
-                try:
-                    w.proc.terminate()
-                except Exception:
-                    pass
+            if w is not None and (
+                w.proc is not None or isinstance(w.conn, DaemonWorkerChannel)
+            ):
+                self._terminate_worker(w)
                 self._on_worker_death(actor.worker_id, graceful=no_restart)
         if no_restart:
             actor.state = "DEAD"
@@ -1034,11 +1229,7 @@ class Scheduler:
         node.alive = False
         for wid, w in list(self.workers.items()):
             if w.node_id == node_id and w.state != "dead":
-                if w.proc is not None:
-                    try:
-                        w.proc.terminate()
-                    except Exception:
-                        pass
+                self._terminate_worker(w)
                 self._on_worker_death(wid)
 
     # ---- placement groups (parity: GcsPlacementGroupManager 2PC,
@@ -1275,6 +1466,18 @@ class Scheduler:
                 }
                 for n in self.nodes.values()
             ]
+        if op == "ensure_local":
+            # start a transfer of oid toward node (default: head) and return
+            # whether a local copy already exists there
+            oid = args[0]
+            dest = args[1] if len(args) > 1 else self._node.head_node_id
+            locs = self._object_locations.get(oid, set())
+            if dest in locs:
+                return True
+            self._ensure_local(oid, dest)
+            return False
+        if op == "object_locations":
+            return [n.hex() for n in self._object_locations.get(args[0], set())]
         raise ValueError(f"unknown rpc {op}")
 
     # ---- misc ------------------------------------------------------------
@@ -1284,6 +1487,18 @@ class Scheduler:
         store = self._node.store_client
         if store is not None and store.contains(oid):
             store.delete(oid)
+        # free remote copies too
+        locs = self._object_locations.pop(oid, None)
+        if locs:
+            for nid in locs:
+                node = self.nodes.get(nid)
+                if node is not None and node.daemon_conn is not None:
+                    lock = self._daemon_send_locks.get(node.daemon_conn)
+                    try:
+                        with lock:
+                            node.daemon_conn.send(("delete_object", oid.binary()))
+                    except (OSError, EOFError):
+                        pass
 
     def _record_event(self, spec: TaskSpec, state: str):
         self._task_events.append(
@@ -1300,6 +1515,19 @@ class Scheduler:
     def task_events(self) -> List[dict]:
         return list(self._task_events)
 
+    def _terminate_worker(self, w: WorkerState):
+        """Hard-kill a worker process, local or daemon-hosted."""
+        if w.proc is not None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        elif isinstance(w.conn, DaemonWorkerChannel):
+            try:
+                w.conn.kill()
+            except (OSError, EOFError):
+                pass
+
     def _shutdown_workers(self):
         for w in self.workers.values():
             if w.state != "dead":
@@ -1307,6 +1535,11 @@ class Scheduler:
                     w.conn.send(("exit",))
                 except (OSError, EOFError):
                     pass
+        for conn in list(self._daemon_conns):
+            try:
+                conn.send(("exit",))
+            except (OSError, EOFError):
+                pass
         deadline = time.monotonic() + 2
         for w in self.workers.values():
             if w.proc is not None:

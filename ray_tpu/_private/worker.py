@@ -11,6 +11,7 @@ and in-flight task args; objects are freed when the count drops to zero).
 
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
@@ -168,8 +169,7 @@ class DriverRuntime:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed")
         oid = ObjectID.for_put(self.task_id, self._put_counter.next())
-        blob = self.serde.serialize_to_bytes(value)
-        self.store.put_bytes(oid, blob)
+        self.store.put_serialized(oid, self.serde, value)
         self.scheduler.memory_store.put(oid, ("stored",))
         self.scheduler.post(("put_done", oid, ("stored",)))
         return oid
@@ -202,7 +202,15 @@ class DriverRuntime:
         if kind == "inline":
             return self.serde.deserialize_from(memoryview(entry[1])), False
         if kind == "stored":
-            mv = self.store.get(oid, timeout=10.0)
+            mv = self.store.get(oid, timeout=0.05)
+            if mv is None:
+                # the copy may live on a remote node: ask the scheduler to
+                # pull it into the head store, then wait for it to land
+                try:
+                    self.rpc("ensure_local", oid)
+                except Exception:
+                    pass
+                mv = self.store.get(oid, timeout=30.0)
             if mv is None:
                 return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
             return self.serde.deserialize_from(mv), False
@@ -307,6 +315,7 @@ def pack_args(rt, args, kwargs) -> Tuple[List[Arg], Dict[str, Arg]]:
 
 
 def init(
+    address: Optional[str] = None,
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
@@ -316,13 +325,25 @@ def init(
     log_to_driver: bool = True,
     namespace: Optional[str] = None,
     _system_config: Optional[dict] = None,
-) -> "DriverRuntime":
+):
     global _driver
     with _global_lock:
         if _driver is not None:
             if ignore_reinit_error:
                 return _driver
             raise RuntimeError("ray_tpu.init() called twice (pass ignore_reinit_error=True)")
+        if address:
+            # attach to an existing cluster over its head socket
+            from ray_tpu._private.client import connect
+
+            if address == "auto":
+                address = os.environ.get("RAY_TPU_ADDRESS", "")
+                if not address:
+                    raise ValueError(
+                        "address='auto' requires RAY_TPU_ADDRESS to be set"
+                    )
+            _driver = connect(address)
+            return _driver
         cfg = Config.from_env(
             object_store_memory=object_store_memory, **(_system_config or {})
         )

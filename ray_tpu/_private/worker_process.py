@@ -110,8 +110,7 @@ class WorkerRuntime:
     def put(self, value) -> ObjectID:
         tid = self.current_task_id or TaskID.nil()
         oid = ObjectID.for_put(tid, self._put_counter.next())
-        blob = self.serde.serialize_to_bytes(value)
-        self.store.put_bytes(oid, blob)
+        self.store.put_serialized(oid, self.serde, value)
         self._send(("submit_put", oid))
         return oid
 
@@ -314,17 +313,29 @@ class WorkerRuntime:
                 )
         out = []
         for i, v in enumerate(values):
-            blob = self.serde.serialize_to_bytes(v)
-            if len(blob) <= self.config.max_direct_call_object_size:
-                out.append(("inline", blob))
+            # serialize once; large values are written straight into the
+            # store buffer (single copy)
+            pickled, buffers = self.serde.serialize(v)
+            size = self.serde.serialized_size(pickled, buffers)
+            if size <= self.config.max_direct_call_object_size:
+                buf = bytearray(size)
+                self.serde.write_to(pickled, buffers, memoryview(buf))
+                out.append(("inline", bytes(buf)))
             else:
                 oid = ObjectID.for_return(spec.task_id, i)
                 try:
-                    self.store.put_bytes(oid, blob)
+                    if not self.store.contains(oid):
+                        try:
+                            dest = self.store.create(oid, size)
+                            self.serde.write_to(pickled, buffers, dest)
+                            self.store.seal(oid)
+                        except ValueError:
+                            if not self.store.contains(oid):
+                                raise
                     out.append(("stored",))
                 except StoreFullError:
                     out.append(
-                        ("error", pickle.dumps(exc.ObjectStoreFullError(f"{len(blob)} bytes")))
+                        ("error", pickle.dumps(exc.ObjectStoreFullError(f"{size} bytes")))
                     )
         return out
 

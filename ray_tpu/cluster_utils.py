@@ -1,14 +1,22 @@
-"""Virtual multi-node cluster for tests.
+"""Multi-node cluster fixture: real node-daemon processes on one machine.
 
 Parity: ``python/ray/cluster_utils.py:135`` (``Cluster``, ``add_node:201``) —
-the fixture that makes "multi-node" testable on one machine. Nodes here are
-virtual resource ledgers inside the single scheduler; workers are real
-processes tagged with their node, so scheduling policies, spillback, placement
-groups and node-failure handling are all exercised for real.
+the fixture the reference uses to test "multi-node" without a cluster: real
+raylet processes, real sockets, fake machines. ``add_node`` spawns a real
+``ray_tpu._private.raylet`` daemon process (own worker pool, own object
+store, object server for peer pulls) registered with the head over TCP.
+``add_node(virtual=True)`` keeps the cheaper in-scheduler resource-ledger
+node for tests that only exercise placement math.
 """
 
 from __future__ import annotations
 
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
 from typing import Dict, Optional
 
 import ray_tpu
@@ -16,14 +24,19 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.worker import get_driver
 
 
-class VirtualNode:
-    def __init__(self, node_id: NodeID, cluster: "Cluster"):
+class ClusterNode:
+    def __init__(self, node_id: Optional[NodeID], cluster: "Cluster", proc=None):
         self.node_id = node_id
+        self.proc = proc  # subprocess.Popen for real daemon nodes
         self._cluster = cluster
 
     @property
     def hex(self) -> str:
-        return self.node_id.hex()
+        return self.node_id.hex() if self.node_id else ""
+
+
+# backwards-compat alias (round-1 name)
+VirtualNode = ClusterNode
 
 
 class Cluster:
@@ -34,11 +47,15 @@ class Cluster:
         connect: bool = True,
     ):
         self._nodes = []
-        self.head_node: Optional[VirtualNode] = None
+        self._procs = []
+        self.head_node: Optional[ClusterNode] = None
+        self.address = None
         if initialize_head:
             rt = ray_tpu.init(**(head_node_args or {}))
-            self.head_node = VirtualNode(rt.node.head_node_id, self)
+            self.address = rt.node.start_head_server()
+            self.head_node = ClusterNode(rt.node.head_node_id, self)
             self._nodes.append(self.head_node)
+        atexit.register(self._atexit)
 
     def add_node(
         self,
@@ -46,24 +63,79 @@ class Cluster:
         num_tpus: float = 0.0,
         resources: Optional[Dict[str, float]] = None,
         labels: Optional[Dict[str, str]] = None,
+        virtual: bool = False,
+        wait: bool = True,
         **_ignored,
-    ) -> VirtualNode:
+    ) -> ClusterNode:
         driver = get_driver()
-        nid = driver.node.add_virtual_node(
-            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels
+        if virtual:
+            nid = driver.node.add_virtual_node(
+                num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels
+            )
+            node = ClusterNode(nid, self)
+            self._nodes.append(node)
+            return node
+
+        host, port = self.address
+        env = dict(os.environ)
+        env["RAY_TPU_AUTH"] = driver.config.cluster_auth_key
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        before = {n["node_id"] for n in ray_tpu.nodes()}
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.raylet",
+                "--address",
+                f"{host}:{port}",
+                "--num-cpus",
+                str(num_cpus),
+                "--num-tpus",
+                str(num_tpus),
+                "--resources",
+                json.dumps(resources or {}),
+                "--labels",
+                json.dumps(labels or {}),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
         )
-        node = VirtualNode(nid, self)
+        self._procs.append(proc)
+        node = ClusterNode(None, self, proc=proc)
         self._nodes.append(node)
+        if wait:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                fresh = [
+                    n
+                    for n in ray_tpu.nodes()
+                    if n["alive"] and n["node_id"] not in before
+                ]
+                if fresh:
+                    node.node_id = NodeID.from_hex(fresh[0]["node_id"])
+                    return node
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node daemon exited rc={proc.returncode} before registering"
+                    )
+                time.sleep(0.02)
+            raise TimeoutError("node daemon did not register within 30s")
         return node
 
-    def remove_node(self, node: VirtualNode, allow_graceful: bool = True) -> None:
-        driver = get_driver()
-        driver.node.remove_virtual_node(node.node_id)
+    def remove_node(self, node: ClusterNode, allow_graceful: bool = True) -> None:
+        if node.proc is not None:
+            # kill -9 the daemon: the head sees the socket drop and declares
+            # the node dead (the reference kills raylets the same way,
+            # python/ray/_private/test_utils.py:1549)
+            node.proc.kill()
+            node.proc.wait(timeout=10)
+        else:
+            get_driver().node.remove_virtual_node(node.node_id)
         self._nodes.remove(node)
 
     def wait_for_nodes(self, timeout: float = 10.0) -> None:
-        import time
-
         deadline = time.monotonic() + timeout
         want = len(self._nodes)
         while time.monotonic() < deadline:
@@ -75,3 +147,22 @@ class Cluster:
 
     def shutdown(self) -> None:
         ray_tpu.shutdown()
+        self._reap()
+
+    def _reap(self):
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 3
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+
+    def _atexit(self):
+        try:
+            self._reap()
+        except Exception:
+            pass

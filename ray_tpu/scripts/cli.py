@@ -27,9 +27,45 @@ def _init(args):
 def cmd_start(args):
     import ray_tpu
 
-    _init(args)
+    if args.address:
+        # worker node: run the node daemon attached to the head
+        # (parity: `ray start --address`; blocks like the raylet).
+        # --node-host is this machine's address as seen by its peers (the
+        # object server binds and advertises it).
+        from ray_tpu._private import raylet
+
+        raylet.main(
+            [
+                "--address",
+                args.address,
+                "--num-cpus",
+                str(args.num_cpus or 1),
+                "--num-tpus",
+                str(args.num_tpus or 0),
+                "--host",
+                args.node_host,
+            ]
+        )
+        return
+
+    rt = _init(args)
+    if args.head:
+        import socket
+
+        host, port = rt.node.start_head_server()
+        adv = host
+        if host == "0.0.0.0":
+            try:
+                adv = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                adv = socket.getfqdn()
+        print(f"head listening on {host}:{port}")
+        print(f"  auth key (export RAY_TPU_AUTH=...): {rt.config.cluster_auth_key}")
+        print(f"  join:    python -m ray_tpu start --address {adv}:{port} "
+              f"--node-host <this-machine-ip>")
+        print(f"  connect: ray_tpu.init(address='{adv}:{port}')  # head machine only")
     print(f"ray_tpu head started. resources: {ray_tpu.cluster_resources()}")
-    if args.block:
+    if args.block or args.head:
         print("blocking; Ctrl-C to stop")
         try:
             while True:
@@ -127,10 +163,17 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("start", help="start a head node in this process")
+    p = sub.add_parser("start", help="start a head or worker node")
     p.add_argument("--num-cpus", type=int, dest="num_cpus")
     p.add_argument("--num-tpus", type=int, dest="num_tpus")
     p.add_argument("--block", action="store_true")
+    p.add_argument("--head", action="store_true", help="open the cluster socket")
+    p.add_argument("--address", help="join an existing head as a worker node")
+    p.add_argument(
+        "--node-host",
+        default="127.0.0.1",
+        help="this node's address as reachable by peers (object server bind)",
+    )
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status", help="cluster resources and nodes")

@@ -115,8 +115,17 @@ def test_node_failure_task_retry(ray_start_cluster):
         return 1
 
     ref = stuck.remote()
-    # let it get scheduled onto the doomed node, then kill the node
-    time.sleep(1.0)
+    # wait until it is actually executing on the doomed node, then kill it
+    from ray_tpu.util import state as state_api
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rows = [t for t in state_api.list_tasks() if t["name"] == "stuck"]
+        if rows and rows[0]["state"] == "RUNNING":
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError("task never started on the doomed node")
     cluster.remove_node(node)
     with pytest.raises((exc.WorkerCrashedError, exc.TaskError)):
         ray_tpu.get(ref, timeout=60)
