@@ -156,6 +156,20 @@ class PlacementGroupID(BaseID):
     SIZE = PLACEMENT_GROUP_ID_SIZE
 
 
+# just below the put-index region: not a plausible return index (returns are
+# small) and below PUT_INDEX_OFFSET so is_put() stays False for sentinels
+_PG_SENTINEL_INDEX = ObjectID.PUT_INDEX_OFFSET - 1
+
+
+def pg_ready_sentinel(pg_id: PlacementGroupID) -> ObjectID:
+    """Deterministic object id committed when a placement group is placed.
+
+    Lets ``pg.ready()/wait()`` ride the ordinary object-readiness plane
+    (push notification) instead of probe-polling the control plane."""
+    padded = pg_id.binary().ljust(TASK_ID_SIZE, b"\x9d")
+    return ObjectID(padded + _PG_SENTINEL_INDEX.to_bytes(OBJECT_INDEX_SIZE, "little"))
+
+
 class _Counter:
     """Thread-safe monotonically increasing counter."""
 

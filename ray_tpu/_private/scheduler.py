@@ -486,10 +486,31 @@ class Scheduler:
         elif kind == "submit_put":
             self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
+        elif kind == "log":
+            # worker stdout/stderr forwarded to the driver (log_to_driver;
+            # parity: python/ray/_private/log_monitor.py)
+            if self.config.log_to_driver:
+                _, stream, pid, line = msg
+                name = ""
+                if w.current_task is not None:
+                    rec = self.tasks.get(w.current_task)
+                    if rec is not None:
+                        name = rec.spec.name or ""
+                try:
+                    import sys as _sys
+
+                    out = _sys.stderr if stream == "stderr" else _sys.stdout
+                    out.write(f"({name or 'worker'} pid={pid}) {line}\n")
+                    out.flush()
+                except Exception:
+                    pass
         elif kind == "cmd":
             self._handle_cmd(msg[1])
         elif kind == "rpc":
             _, req_id, op, args = msg
+            if op == "ensure_local" and len(args) == 1:
+                # destination defaults to the calling worker's node
+                args = (args[0], w.node_id)
             try:
                 result = self._serve_rpc(op, args)
             except Exception as e:  # noqa: BLE001
@@ -545,7 +566,11 @@ class Scheduler:
         """Start (at most one) transfer of oid to dest if it has no copy."""
         dest = self._loc_node(dest)
         locs = self._object_locations.get(oid)
-        if not locs or dest in locs:
+        if not locs:
+            # every copy is gone: owner-driven lineage reconstruction
+            self._recover_object(oid)
+            return
+        if dest in locs:
             return
         dest_node = self.nodes.get(dest)
         key = (oid, dest)
@@ -575,6 +600,81 @@ class Scheduler:
                     )
             except (OSError, EOFError):
                 self._on_daemon_death(dest_node.daemon_conn)
+
+    def _recover_object(self, oid: ObjectID, depth: int = 0) -> bool:
+        """Owner-driven lineage reconstruction: re-execute the creating task
+        when every copy of a stored object has been lost (node death).
+
+        Parity: ``ObjectRecoveryManager`` — algorithm documented at
+        ``src/ray/core_worker/object_recovery_manager.h:70-84`` — honoring
+        the task's ``max_retries`` budget. Put objects have no lineage and
+        stay lost (the reference behaves the same).
+        """
+        if depth > 20:
+            return False
+        entry = self.memory_store.get_entry(oid)
+        if entry is not None and entry[0] != "stored":
+            return True  # inline/error entries are never lost
+        if self._object_locations.get(oid):
+            return True  # a copy still exists
+        if self._node.store_client.contains(oid):
+            # head store holds it (put objects / head-task returns)
+            self._object_locations[oid].add(self._node.head_node_id)
+            return True
+        if oid.is_put():
+            return False
+        rec = self.tasks.get(oid.task_id())
+        if rec is None or rec.spec.task_type == TaskType.ACTOR_CREATION:
+            return False
+        if rec.state in ("PENDING", "WAITING_DEPS", "SCHEDULED"):
+            return True  # already being recomputed
+        if rec.state == "RUNNING":
+            return True  # will recommit on completion
+        if rec.retries_left <= 0:
+            return False
+        rec.retries_left -= 1
+        logger.info(
+            "reconstructing %s via re-execution of %s (retries left %d)",
+            oid.hex()[:8],
+            rec.spec.name or oid.task_id().hex()[:8],
+            rec.retries_left,
+        )
+        # evict lost returns so consumers wait for the recomputation
+        for ret in rec.spec.return_ids():
+            if not self._object_locations.get(ret) and not self._node.store_client.contains(ret):
+                self.memory_store.evict(ret)
+                self._object_locations.pop(ret, None)
+        # recursively recover lost args, then let dependency tracking gate
+        for arg_oid in rec.spec.arg_ref_ids():
+            e = self.memory_store.get_entry(arg_oid)
+            if (
+                e is not None
+                and e[0] == "stored"
+                and not self._object_locations.get(arg_oid)
+                and not self._node.store_client.contains(arg_oid)
+            ):
+                if self._recover_object(arg_oid, depth + 1):
+                    self.memory_store.evict(arg_oid)
+                else:
+                    self._fail_task(
+                        rec,
+                        exc.ObjectLostError(
+                            f"arg {arg_oid.hex()} of {rec.spec.name} is lost "
+                            "and cannot be reconstructed"
+                        ),
+                    )
+                    return False
+        self._record_event(rec.spec, "RECONSTRUCTING")
+        rec.worker_id = None
+        deps = self._unresolved_deps(rec.spec)
+        if deps:
+            rec.state = "WAITING_DEPS"
+            rec.unresolved_deps = deps
+            for d in deps:
+                self._dep_waiters[d].add(rec.spec.task_id)
+        else:
+            self._make_schedulable(rec)
+        return True
 
     def _fetch_into_head(self, oid: ObjectID, src_addr) -> None:
         from ray_tpu._private.object_transfer import fetch_object_bytes
@@ -1248,6 +1348,14 @@ class Scheduler:
         pg.bundle_nodes = [n.node_id for n in placement]
         pg.bundle_available = [dict(b) for b in pg.bundles]
         pg.state = "CREATED"
+        # push-notify waiters (pg.ready()/wait() ride the object plane)
+        from ray_tpu._private import serialization
+        from ray_tpu._private.ids import pg_ready_sentinel
+
+        self._commit_result(
+            pg_ready_sentinel(pg.pg_id),
+            ("inline", serialization.get_context().serialize_to_bytes(True)),
+        )
 
     def _place_bundles(
         self, bundles, strategy, nodes: List[NodeState]
@@ -1336,6 +1444,9 @@ class Scheduler:
                     # release what is not currently loaned to running tasks
                     node.release(pg.bundle_available[i])
         pg.state = "REMOVED"
+        from ray_tpu._private.ids import pg_ready_sentinel
+
+        self.memory_store.evict(pg_ready_sentinel(pg_id))
 
     # ---- rpc served to workers ------------------------------------------
 

@@ -128,17 +128,26 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        # push-based: block on the runtime's wait plane (pull registration in
+        # workers, memory-store condition vars in the driver) instead of
+        # spinning on object_ready (round-1 polled at 1 ms here)
         rt = get_runtime()
         next_oid = ObjectID.for_return(self._task_id, self._index + 1)
+        count_oid = self._count_ref.id()
         while True:
+            if self._total is None:
+                ready, _ = rt.wait([next_oid, count_oid], 1, timeout=30.0)
+                if count_oid in ready and not rt.object_ready(next_oid):
+                    self._total = rt.get_objects([count_oid])[0]
+            else:
+                if self._index >= self._total:
+                    raise StopIteration
+                rt.wait([next_oid], 1, timeout=30.0)
             if rt.object_ready(next_oid):
                 self._index += 1
                 return ObjectRef(next_oid)
-            if self._total is None and rt.object_ready(self._count_ref.id()):
-                self._total = rt.get_objects([self._count_ref.id()])[0]
             if self._total is not None and self._index >= self._total:
                 raise StopIteration
-            time.sleep(0.001)
 
 
 class DriverRuntime:
@@ -202,17 +211,19 @@ class DriverRuntime:
         if kind == "inline":
             return self.serde.deserialize_from(memoryview(entry[1])), False
         if kind == "stored":
+            # the copy may live on a remote node (or have been lost with it):
+            # poll while periodically asking the scheduler to transfer — or
+            # lineage-reconstruct — it into the head store
+            deadline = time.monotonic() + 60.0
             mv = self.store.get(oid, timeout=0.05)
-            if mv is None:
-                # the copy may live on a remote node: ask the scheduler to
-                # pull it into the head store, then wait for it to land
+            while mv is None:
+                if time.monotonic() >= deadline:
+                    return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
                 try:
                     self.rpc("ensure_local", oid)
                 except Exception:
                     pass
-                mv = self.store.get(oid, timeout=30.0)
-            if mv is None:
-                return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
+                mv = self.store.get(oid, timeout=2.0)
             return self.serde.deserialize_from(mv), False
         if kind == "error":
             err = pickle.loads(entry[1])
@@ -345,7 +356,9 @@ def init(
             _driver = connect(address)
             return _driver
         cfg = Config.from_env(
-            object_store_memory=object_store_memory, **(_system_config or {})
+            object_store_memory=object_store_memory,
+            log_to_driver=log_to_driver,
+            **(_system_config or {}),
         )
         node = Node(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels)
         _driver = DriverRuntime(node)

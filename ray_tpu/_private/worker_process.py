@@ -16,6 +16,7 @@ on a thread pool (parity: threaded actors /
 
 from __future__ import annotations
 
+import os
 import pickle
 import queue
 import sys
@@ -193,9 +194,19 @@ class WorkerRuntime:
                 return err.as_instanceof_cause(), True
             return err, True
         if kind == "stored":
-            mv = self.store.get(oid, timeout=timeout if timeout is not None else 60.0)
-            if mv is None:
-                return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
+            # the copy may live on another node (or have been lost with it):
+            # poll the local store while periodically asking the scheduler to
+            # transfer — or lineage-reconstruct — it (ensure_local)
+            deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
+            mv = self.store.get(oid, timeout=0.05)
+            while mv is None:
+                if time.monotonic() >= deadline or self._stopped.is_set():
+                    return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
+                try:
+                    self.rpc("ensure_local", oid)
+                except Exception:
+                    pass
+                mv = self.store.get(oid, timeout=2.0)
             return self.serde.deserialize_from(mv), False
         return exc.RayTpuError(f"bad entry {kind}"), True
 
@@ -429,6 +440,43 @@ class WorkerRuntime:
             self.current_task_id = None
 
 
+class _TeeStream:
+    """Line-buffered tee: worker prints go to the original stream AND to the
+    driver over the pipe (parity: the reference's log monitor publishing
+    worker stdout/stderr to drivers, python/ray/_private/log_monitor.py:1)."""
+
+    def __init__(self, original, rt, name: str):
+        self._original = original
+        self._rt = rt
+        self._name = name
+        self._buf = ""
+        self._pid = os.getpid()
+
+    def write(self, text):
+        try:
+            self._original.write(text)
+        except Exception:
+            pass
+        self._buf += text
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            if line:
+                try:
+                    self._rt._send(("log", self._name, self._pid, line))
+                except Exception:
+                    pass
+        return len(text)
+
+    def flush(self):
+        try:
+            self._original.flush()
+        except Exception:
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self._original, name)
+
+
 def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, config_blob: bytes):
     """Entry point for spawned worker processes."""
     import ray_tpu._private.worker as worker_mod
@@ -439,6 +487,10 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     store = create_store_client(shm_dir, fallback_dir, config.object_store_memory)
     rt = WorkerRuntime(conn, worker_id, store, config)
     worker_mod._set_worker_runtime(rt)
+
+    if config.log_to_driver:
+        sys.stdout = _TeeStream(sys.stdout, rt, "stdout")
+        sys.stderr = _TeeStream(sys.stderr, rt, "stderr")
 
     reader = threading.Thread(target=rt.reader_loop, name="reader", daemon=True)
     reader.start()

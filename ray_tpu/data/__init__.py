@@ -5,6 +5,9 @@ blocks, task-parallel execution with bounded in-flight windows,
 ``streaming_split`` feeding trainer workers, file datasources.
 """
 
+from ray_tpu.data import aggregate
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.context import ActorPoolStrategy, DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
@@ -20,6 +23,15 @@ from ray_tpu.data.read_api import (
 __all__ = [
     "Dataset",
     "DataIterator",
+    "DataContext",
+    "ActorPoolStrategy",
+    "aggregate",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
     "range",
     "from_items",
     "from_numpy",

@@ -1,0 +1,40 @@
+"""DataContext: execution options for Dataset pipelines.
+
+Parity: ``python/ray/data/context.py`` (``DataContext.get_current``) — the
+knobs that matter for the streaming executor's backpressure: the bounded
+in-flight window (blocks) that caps memory while a consumer iterates.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    # max result-pending block tasks in flight per consuming iterator
+    # (the role of the reference's StreamingExecutor backpressure policies,
+    # streaming_executor.py:48 + backpressure_policy/)
+    max_inflight_blocks: int = 4
+    # rows per block targeted by repartition-by-size paths
+    target_block_rows: int = 65536
+
+    _local = threading.local()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        ctx = getattr(cls._local, "ctx", None)
+        if ctx is None:
+            ctx = cls._local.ctx = cls()
+        return ctx
+
+
+class ActorPoolStrategy:
+    """Compute strategy for ``map_batches``: run the transform in a pool of
+    long-lived actors instead of stateless tasks (parity:
+    ``ActorPoolMapOperator``, execution/operators/actor_pool_map_operator.py).
+    Useful when the fn has expensive setup (model weights)."""
+
+    def __init__(self, size: int = 2):
+        self.size = max(1, int(size))

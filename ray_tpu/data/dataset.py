@@ -74,12 +74,53 @@ class Dataset:
     def map(self, fn: Callable) -> "Dataset":
         return self._with_op("map", fn)
 
-    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None) -> "Dataset":
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        compute=None,
+    ) -> "Dataset":
         # batch_size=None applies fn per block (the common, fastest path)
-        if batch_size is None:
-            return self._with_op("map_batches", fn)
-        ds = self.repartition_by_rows(batch_size)
+        ds = self if batch_size is None else self.repartition_by_rows(batch_size)
+        from ray_tpu.data.context import ActorPoolStrategy
+
+        if isinstance(compute, ActorPoolStrategy):
+            return ds._map_batches_actor_pool(fn, compute)
         return ds._with_op("map_batches", fn)
+
+    def _map_batches_actor_pool(self, fn: Callable, strategy) -> "Dataset":
+        """Run fn in a pool of long-lived actors (parity:
+        ActorPoolMapOperator): callable classes are constructed once per
+        actor; plain fns just avoid re-pickling per block."""
+        import cloudpickle
+
+        fn_blob = cloudpickle.dumps(fn)
+
+        @ray_tpu.remote
+        class _BlockWorker:
+            def __init__(self, blob):
+                import cloudpickle as cp
+
+                obj = cp.loads(blob)
+                # callable class -> instantiate once (expensive setup amortized)
+                self._fn = obj() if isinstance(obj, type) else obj
+
+            def apply(self, block):
+                return normalize_block(self._fn(block))
+
+        workers = [_BlockWorker.remote(fn_blob) for _ in range(strategy.size)]
+        # round-robin over the pool, keeping object refs (blocks never pass
+        # through the driver); per-actor queues serialize each actor's work
+        refs = [
+            workers[i % len(workers)].apply.remote(ref)
+            for i, ref in enumerate(self._iter_exec_block_refs())
+        ]
+        out = Dataset(refs)
+        # pin the pool until its (lazy) outputs are consumed: dropping the
+        # handles would reap the actors before the block tasks run
+        out._owned_actors = workers
+        return out
 
     def filter(self, fn: Callable) -> "Dataset":
         return self._with_op("filter", fn)
@@ -198,6 +239,101 @@ class Dataset:
         ]
         return Dataset(out)
 
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed range-partition sort (parity: the sort exchange,
+        ``python/ray/data/_internal/planner/exchange/sort_task_spec.py:1``):
+        sample boundaries -> range-partition map stage -> per-range sorted
+        merge, all as tasks over blocks."""
+        from ray_tpu.data.aggregate import (
+            _range_partition,
+            _sample_keys,
+            _sort_merge,
+        )
+
+        mat = self.materialize()
+        if not mat._block_refs:
+            return mat  # empty dataset is trivially sorted
+        k = len(mat._block_refs)
+        if k == 1:
+            out = [_sort_merge.remote(key, descending, mat._block_refs[0])]
+            return Dataset(out)
+        sample_arrays = [
+            np.asarray(s)
+            for s in ray_tpu.get(
+                [_sample_keys.remote(r, key, 32) for r in mat._block_refs],
+                timeout=600,
+            )
+            if len(s)
+        ]
+        if not sample_arrays:
+            return mat  # all blocks empty
+        samples = np.concatenate(sample_arrays)
+        samples.sort()
+        # k-1 boundaries at even quantiles
+        bounds = [samples[int(i * len(samples) / k)] for i in range(1, k)]
+        parts = [
+            _range_partition.options(num_returns=k).remote(ref, key, bounds)
+            for ref in mat._block_refs
+        ]
+        out = [
+            _sort_merge.remote(key, descending, *[row[j] for row in parts])
+            for j in range(k)
+        ]
+        if descending:
+            out = out[::-1]
+        return Dataset(out)
+
+    def groupby(self, key: str):
+        """Parity: ``Dataset.groupby`` -> GroupedData (hash exchange)."""
+        from ray_tpu.data.aggregate import GroupedData
+
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Global aggregation: per-block partials + driver-side merge."""
+        from ray_tpu.data.aggregate import _partial_agg
+
+        import cloudpickle
+
+        mat = self.materialize()
+        blobs = [cloudpickle.dumps(a) for a in aggs]
+        partials = ray_tpu.get(
+            [_partial_agg.remote(ref, blobs) for ref in mat._block_refs],
+            timeout=600,
+        )
+        out = {}
+        for i, a in enumerate(aggs):
+            acc = a.init()
+            for row in partials:
+                acc = a.merge(acc, row[i])
+            out[a.name] = a.finalize(acc)
+        return out
+
+    def sum(self, on: str) -> float:
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on))[f"sum({on})"]
+
+    def min(self, on: str) -> float:
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on))[f"min({on})"]
+
+    def max(self, on: str) -> float:
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on))[f"max({on})"]
+
+    def mean(self, on: str) -> float:
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1) -> float:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on, ddof))[f"std({on})"]
+
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         ds = self.materialize()
         if equal:
@@ -223,14 +359,21 @@ class Dataset:
     # -- execution ---------------------------------------------------------
 
     def _iter_exec_block_refs(self) -> Iterator:
-        """Launch per-block tasks with a bounded in-flight window."""
+        """Launch per-block tasks with a bounded in-flight window.
+
+        The window (DataContext.max_inflight_blocks) is the backpressure
+        mechanism: at most W block-tasks' results are pending at once, so a
+        dataset arbitrarily larger than memory streams through a consumer."""
         if not self._ops:
             yield from self._block_refs
             return
+        from ray_tpu.data.context import DataContext
+
+        window = max(1, DataContext.get_current().max_inflight_blocks)
         pending = []
         idx = 0
         while idx < len(self._block_refs) or pending:
-            while idx < len(self._block_refs) and len(pending) < _PREFETCH:
+            while idx < len(self._block_refs) and len(pending) < window:
                 pending.append(
                     _exec_block.remote(self._block_refs[idx], self._ops)
                 )

@@ -42,7 +42,15 @@ def from_items(items: List[Any], *, num_blocks: int = 4) -> Dataset:
     return Dataset(refs)
 
 
-def from_numpy(arr: np.ndarray, *, column: str = "data", num_blocks: int = 4) -> Dataset:
+def from_numpy(arr, *, column: str = "data", num_blocks: int = 4) -> Dataset:
+    """Accepts a single ndarray (named ``column``) or a dict of columns."""
+    if isinstance(arr, dict):
+        n = len(next(iter(arr.values())))
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = []
+        for i in builtins.range(0, n, per):
+            refs.append(ray_tpu.put({k: np.asarray(v)[i : i + per] for k, v in arr.items()}))
+        return Dataset(refs)
     per = max(1, (len(arr) + num_blocks - 1) // num_blocks)
     refs = []
     for i in builtins.range(0, len(arr), per):

@@ -9,16 +9,22 @@ requests); HTTP proxy actor; dynamic batching (``batching.py``); model
 composition via ``.bind()``.
 """
 
+from ray_tpu.serve._replica import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.api import (
     delete,
     deployment,
     get_app_handle,
+    get_deployment_handle,
     run,
     shutdown,
     status,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 
 __all__ = [
     "deployment",
@@ -27,7 +33,11 @@ __all__ = [
     "delete",
     "status",
     "get_app_handle",
+    "get_deployment_handle",
     "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
 ]

@@ -1,22 +1,90 @@
 """Replica actor: wraps the user's deployment callable.
 
 Parity: ``python/ray/serve/_private/replica.py`` — executes requests against
-the user class/function; threaded (``max_concurrency = max_ongoing_requests``)
-so concurrent requests overlap; exposes a health-check probe.
+the user class/function; threaded so concurrent requests overlap, with an
+internal gate at ``max_ongoing_requests`` so the entered-thread count is a
+true queued+running depth (the autoscaling metric,
+``_private/autoscaling_state.py``); streaming responses via generator
+methods (``_private/proxy_response_generator.py``); model multiplexing via a
+per-replica LRU (``python/ray/serve/multiplex.py:1``).
 """
 
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Any, Dict, List
 
 import cloudpickle
 
 import ray_tpu
 
+_request_ctx = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """Parity: ``serve.get_multiplexed_model_id`` — valid inside a request."""
+    return getattr(_request_ctx, "multiplexed_model_id", "")
+
+
+class _MultiplexCache:
+    """Per-replica LRU of loaded models (parity: _ModelMultiplexWrapper)."""
+
+    def __init__(self, loader, max_models: int):
+        self._loader = loader
+        self._max = max_models
+        self._models: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, model_id: str):
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        model = self._loader(model_id)
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self._max:
+                self._models.popitem(last=False)
+        return model
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
+    """Decorator wrapping a model-loader method with a per-replica LRU
+    (parity: ``serve.multiplexed``): ``self.get_model(model_id)`` loads at
+    most once per cached model and evicts beyond the limit."""
+
+    def wrap(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(owner, model_id):
+            caches = getattr(owner, "__serve_mux_caches__", None)
+            if caches is None:
+                caches = {}
+                object.__setattr__(owner, "__serve_mux_caches__", caches)
+            cache = caches.get(f.__name__)
+            if cache is None:
+                cache = caches[f.__name__] = _MultiplexCache(
+                    lambda mid: f(owner, mid), max_num_models_per_replica
+                )
+            return cache.get(model_id)
+
+        wrapper.__serve_multiplexed__ = True
+        wrapper.__serve_multiplex_max__ = max_num_models_per_replica
+        return wrapper
+
+    return wrap(func) if func is not None else wrap
+
 
 @ray_tpu.remote
 class Replica:
-    def __init__(self, callable_blob: bytes, init_args, init_kwargs):
+    def __init__(self, callable_blob: bytes, init_args, init_kwargs, max_ongoing: int = 8):
         # nested DeploymentHandles (model composition) arrive pre-resolved
         # inside init_args/kwargs
         target = cloudpickle.loads(callable_blob)
@@ -28,14 +96,68 @@ class Replica:
             self._callable = functools.partial(target, *init_args, **init_kwargs)
         else:
             self._callable = target
+        self._gate = threading.Semaphore(max_ongoing)
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
 
-    def handle_request(self, method: str, args: List, kwargs: Dict):
-        if method == "__call__":
-            return self._callable(*args, **kwargs)
-        return getattr(self._callable, method)(*args, **kwargs)
+    def _enter(self, model_id: str):
+        with self._ongoing_lock:
+            self._ongoing += 1
+        self._gate.acquire()
+        _request_ctx.multiplexed_model_id = model_id
+
+    def _exit(self):
+        self._gate.release()
+        _request_ctx.multiplexed_model_id = ""
+        with self._ongoing_lock:
+            self._ongoing -= 1
+
+    def handle_request(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
+        self._enter(model_id)
+        try:
+            if method == "__call__":
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method)(*args, **kwargs)
+        finally:
+            self._exit()
+
+    def handle_request_streaming(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
+        """Generator execution: items stream back as they are yielded
+        (parity: streaming responses, _private/proxy_response_generator.py)."""
+        self._enter(model_id)
+        try:
+            fn = (
+                self._callable
+                if method == "__call__"
+                else getattr(self._callable, method)
+            )
+            for item in fn(*args, **kwargs):
+                yield item
+        finally:
+            self._exit()
+
+    def num_ongoing(self) -> int:
+        """Queued + running requests (autoscaling metric)."""
+        with self._ongoing_lock:
+            return self._ongoing
+
+    def multiplexed_model_ids(self) -> List[str]:
+        out: List[str] = []
+        caches = getattr(self._callable, "__serve_mux_caches__", None) or {}
+        for cache in caches.values():
+            out.extend(cache.model_ids())
+        return out
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)
         if callable(user_check):
             user_check()
         return True
+
+
+# Expose the raw class under an importable name so cloudpickle serializes it
+# by reference (the module attribute ``Replica`` is the ActorClass wrapper;
+# without this the class pickles by value and drags module globals — e.g.
+# the request-context threading.local — into the pickle).
+_ReplicaImpl = Replica._cls
+_ReplicaImpl.__qualname__ = "_ReplicaImpl"

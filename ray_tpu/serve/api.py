@@ -33,13 +33,17 @@ class Application:
 
 class Deployment:
     def __init__(self, target, *, name=None, num_replicas=1, max_ongoing_requests=8,
-                 ray_actor_options=None, health_check_period_s=5.0):
+                 ray_actor_options=None, health_check_period_s=5.0,
+                 autoscaling_config=None):
         self._target = target
         self.name = name or getattr(target, "__name__", "deployment")
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
         self.ray_actor_options = ray_actor_options or {}
         self.health_check_period_s = health_check_period_s
+        # {"min_replicas", "max_replicas", "target_ongoing_requests"}
+        # (parity: serve autoscaling_policy.py / autoscaling_state.py)
+        self.autoscaling_config = dict(autoscaling_config or {}) or None
 
     def options(self, **updates) -> "Deployment":
         new = Deployment(
@@ -48,6 +52,10 @@ class Deployment:
             num_replicas=updates.get("num_replicas", self.num_replicas),
             max_ongoing_requests=updates.get("max_ongoing_requests", self.max_ongoing_requests),
             ray_actor_options=updates.get("ray_actor_options", self.ray_actor_options),
+            health_check_period_s=updates.get(
+                "health_check_period_s", self.health_check_period_s
+            ),
+            autoscaling_config=updates.get("autoscaling_config", self.autoscaling_config),
         )
         return new
 
@@ -55,12 +63,16 @@ class Deployment:
         return Application(self, args, kwargs)
 
     def spec(self) -> dict:
+        num = self.num_replicas
+        if self.autoscaling_config:
+            num = int(self.autoscaling_config.get("min_replicas", 1)) or 1
         return {
             "name": self.name,
             "callable_blob": cloudpickle.dumps(self._target),
-            "num_replicas": self.num_replicas,
+            "num_replicas": num,
             "max_ongoing_requests": self.max_ongoing_requests,
             "ray_actor_options": self.ray_actor_options,
+            "autoscaling_config": self.autoscaling_config,
         }
 
 
@@ -125,14 +137,18 @@ class ServeController:
 
     def _start_replicas(self, spec: dict, init_args, init_kwargs):
         opts = dict(spec["ray_actor_options"])
+        max_ongoing = spec["max_ongoing_requests"]
         replicas = []
         for _ in range(spec["num_replicas"]):
+            # thread pool larger than the request gate so queued requests
+            # are counted (autoscaling metric) and health probes aren't
+            # starved by busy request threads
             r = Replica.options(
-                max_concurrency=spec["max_ongoing_requests"],
+                max_concurrency=min(64, max_ongoing * 4 + 4),
                 num_cpus=opts.get("num_cpus", 0.0),
                 num_tpus=opts.get("num_tpus", 0.0),
                 resources=opts.get("resources"),
-            ).remote(spec["callable_blob"], init_args, init_kwargs)
+            ).remote(spec["callable_blob"], init_args, init_kwargs, max_ongoing)
             replicas.append(r)
         # wait until they respond (surface init errors early)
         ray_tpu.get([r.check_health.remote() for r in replicas], timeout=120)
@@ -184,6 +200,42 @@ class ServeController:
             self.delete_application(app)
         return True
 
+    def _autoscale(self, d: dict, alive):
+        """Queue-depth autoscaling (parity: serve autoscaling_policy.py):
+        desired = clamp(ceil(total_ongoing / target), min, max), where
+        total_ongoing is the replicas' queued+running depth."""
+        cfg = d["spec"].get("autoscaling_config")
+        if not cfg or not alive:
+            return alive
+        try:
+            depths = ray_tpu.get(
+                [r.num_ongoing.remote() for r in alive], timeout=10
+            )
+        except Exception:
+            return alive
+        total = sum(depths)
+        target = float(cfg.get("target_ongoing_requests", 2.0))
+        lo = int(cfg.get("min_replicas", 1))
+        hi = int(cfg.get("max_replicas", max(lo, 1)))
+        import math
+
+        desired = max(lo, min(hi, math.ceil(total / max(target, 1e-9)) or lo))
+        current = d["spec"]["num_replicas"]
+        if desired > current:
+            d["spec"]["num_replicas"] = desired  # reconcile starts the rest
+        elif desired < current:
+            d["spec"]["num_replicas"] = desired
+            # drop the idlest replicas
+            order = sorted(range(len(alive)), key=lambda i: depths[i])
+            drop = set(order[: len(alive) - desired])
+            for i in drop:
+                try:
+                    ray_tpu.kill(alive[i])
+                except Exception:
+                    pass
+            alive = [r for i, r in enumerate(alive) if i not in drop]
+        return alive
+
     # -- reconciliation (parity: DeploymentState reconcile loop) ----------
 
     def _reconcile_loop(self):
@@ -206,6 +258,7 @@ class ServeController:
                         alive.append(r)
                     except Exception:
                         pass
+                alive = self._autoscale(d, alive)
                 want = d["spec"]["num_replicas"]
                 fresh = []
                 if len(alive) < want:

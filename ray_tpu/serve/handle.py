@@ -2,18 +2,24 @@
 
 Parity: ``python/ray/serve/handle.py`` + the power-of-two-choices replica
 scheduler (``replica_scheduler/pow_2_scheduler.py:49``): pick two random
-replicas, send to the one with fewer requests outstanding *from this handle*
-(queue-length probes are local bookkeeping here — replicas are threaded actors
-so accepted requests run concurrently).
+replicas, send to the one with fewer requests outstanding *from this handle*.
+Extensions matching the reference: streaming responses
+(``handle.options(stream=True)``), model-multiplex-aware routing
+(``options(multiplexed_model_id=...)`` prefers replicas that already hold
+the model), and periodic replica-list refresh so autoscaling is visible to
+live handles.
 """
 
 from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+
+_REFRESH_PERIOD_S = 2.0
 
 
 class DeploymentResponse:
@@ -49,42 +55,109 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate per-item results (parity:
+    ``DeploymentResponseGenerator``)."""
+
+    def __init__(self, gen, on_done=None):
+        self._gen = gen
+        self._on_done = on_done
+        self._settled = False
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref, timeout=300)
+        finally:
+            if not self._settled:
+                self._settled = True
+                if self._on_done:
+                    self._on_done()
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._handle._call(self._method, args, kwargs)
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, app_name: str, replicas: List[Any]):
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str,
+        replicas: List[Any],
+        stream: bool = False,
+        multiplexed_model_id: str = "",
+    ):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._replicas = list(replicas)
         self._outstanding: Dict[int, int] = {i: 0 for i in range(len(replicas))}
         self._lock = threading.Lock()
+        self._stream = stream
+        self._model_id = multiplexed_model_id
+        # model id -> replica index this handle last routed it to
+        self._model_affinity: Dict[str, int] = {}
+        self._last_refresh = time.monotonic()
 
     def _update_replicas(self, replicas: List[Any]):
         with self._lock:
             self._replicas = list(replicas)
             self._outstanding = {i: 0 for i in range(len(replicas))}
+            self._model_affinity.clear()
 
-    def _pick(self) -> int:
+    def _maybe_refresh(self):
+        """Pick up autoscaling changes: re-fetch the replica list from the
+        controller every couple of seconds."""
+        now = time.monotonic()
+        if now - self._last_refresh < _REFRESH_PERIOD_S:
+            return
+        self._last_refresh = now
+        try:
+            from ray_tpu.serve.api import _CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+            info = ray_tpu.get(
+                controller.get_handle_info.remote(self.app_name, self.deployment_name),
+                timeout=10,
+            )
+            if info is not None:
+                new_ids = [r._actor_id for r in info[1]]
+                cur_ids = [r._actor_id for r in self._replicas]
+                if new_ids != cur_ids:
+                    self._update_replicas(info[1])
+        except Exception:
+            pass
+
+    def _pick(self, model_id: str) -> int:
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name} has no replicas"
                 )
+            # multiplex-aware: stick with the replica that already loaded
+            # this model unless it is heavily loaded (pow-2 fallback)
+            if model_id:
+                idx = self._model_affinity.get(model_id)
+                if idx is not None and idx < n and self._outstanding.get(idx, 0) < 8:
+                    return idx
             if n == 1:
-                return 0
-            i, j = random.sample(range(n), 2)
-            return i if self._outstanding[i] <= self._outstanding[j] else j
+                idx = 0
+            else:
+                i, j = random.sample(range(n), 2)
+                idx = i if self._outstanding[i] <= self._outstanding[j] else j
+            if model_id:
+                self._model_affinity[model_id] = idx
+            return idx
 
-    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
-        idx = self._pick()
+    def _call(self, method: str, args, kwargs):
+        self._maybe_refresh()
+        idx = self._pick(self._model_id)
         with self._lock:
             self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
             replica = self._replicas[idx]
@@ -94,14 +167,35 @@ class DeploymentHandle:
                 if idx in self._outstanding:
                     self._outstanding[idx] -= 1
 
-        ref = replica.handle_request.remote(method, list(args), dict(kwargs))
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, list(args), dict(kwargs), self._model_id)
+            return DeploymentResponseGenerator(gen, on_done=done)
+        ref = replica.handle_request.remote(
+            method, list(args), dict(kwargs), self._model_id
+        )
         return DeploymentResponse(ref, on_done=done)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._call("__call__", args, kwargs)
 
-    def options(self, **_ignored) -> "DeploymentHandle":
-        return self
+    def options(
+        self,
+        *,
+        stream: Optional[bool] = None,
+        multiplexed_model_id: Optional[str] = None,
+        **_ignored,
+    ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name,
+            self.app_name,
+            self._replicas,
+            stream=self._stream if stream is None else stream,
+            multiplexed_model_id=(
+                self._model_id if multiplexed_model_id is None else multiplexed_model_id
+            ),
+        )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -109,4 +203,13 @@ class DeploymentHandle:
         return _MethodCaller(self, name)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name, self._replicas))
+        return (
+            DeploymentHandle,
+            (
+                self.deployment_name,
+                self.app_name,
+                self._replicas,
+                self._stream,
+                self._model_id,
+            ),
+        )

@@ -17,7 +17,7 @@ from ray_tpu.tune.search import (
     uniform,
 )
 from ray_tpu.tune.result_grid import ResultGrid
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.schedulers import PopulationBasedTraining, ASHAScheduler, FIFOScheduler, MedianStoppingRule
 from ray_tpu.tune.tune_config import TuneConfig
 from ray_tpu.tune.tuner import Tuner
 
@@ -35,4 +35,5 @@ __all__ = [
     "FIFOScheduler",
     "ASHAScheduler",
     "MedianStoppingRule",
+    "PopulationBasedTraining",
 ]

@@ -106,3 +106,88 @@ class MedianStoppingRule:
         if self.mode == "min":
             return CONTINUE if mine <= median else STOP
         return CONTINUE if mine >= median else STOP
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class PopulationBasedTraining:
+    """PBT (parity: ``python/ray/tune/schedulers/pbt.py:1``): every
+    ``perturbation_interval`` iterations a trial in the bottom quantile stops,
+    clones a top-quantile trial's config + checkpoint, perturbs the mutated
+    hyperparameters, and resumes. The Tuner performs the clone/relaunch when
+    this scheduler returns EXPLOIT."""
+
+    def __init__(
+        self,
+        *,
+        metric: str,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict] = None,
+        quantile_fraction: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        import random as _random
+
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.interval = int(perturbation_interval)
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._rng = _random.Random(seed)
+        # trial_id -> (iteration, score) at the last completed interval
+        self._scores: Dict[str, tuple] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_result(self, trial_id: str, iteration: int, metrics: Dict) -> str:
+        if self.metric not in metrics:
+            return CONTINUE
+        self._scores[trial_id] = (iteration, self._norm(float(metrics[self.metric])))
+        if iteration - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = iteration
+        scores = [s for _, s in self._scores.values()]
+        if len(scores) < 2:
+            return CONTINUE
+        scores_sorted = sorted(scores)
+        k = max(1, int(len(scores_sorted) * self.quantile))
+        bottom_cut = scores_sorted[k - 1]
+        my = self._scores[trial_id][1]
+        if my <= bottom_cut and my < scores_sorted[-1]:
+            return EXPLOIT
+        return CONTINUE
+
+    def choose_exploit_source(self, trial_id: str, trials: Dict[str, dict]):
+        """Pick a top-quantile trial to clone (not the exploiting one)."""
+        ranked = sorted(
+            (
+                (self._scores[t][1], t)
+                for t in trials
+                if t in self._scores and t != trial_id
+            ),
+            reverse=True,
+        )
+        if not ranked:
+            return None
+        k = max(1, int(len(ranked) * self.quantile))
+        return self._rng.choice([t for _, t in ranked[:k]])
+
+    def mutate_config(self, config: Dict) -> Dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                # reference semantics: resample with prob 0.25, else keep the
+                # exploited trial's (winning) value
+                if self._rng.random() < 0.25 or key not in out:
+                    out[key] = self._rng.choice(spec)
+            elif key in out and isinstance(out[key], (int, float)):
+                # numeric perturbation: *1.2 or *0.8 like the reference
+                out[key] = out[key] * self._rng.choice([0.8, 1.2])
+        return out

@@ -49,10 +49,11 @@ class _TrialActor:
         self.trial_id = trial_id
         self.trial_dir = trial_dir
 
-    def run(self, fn_blob: bytes, config: dict, collector):
+    def run(self, fn_blob: bytes, config: dict, collector, ckpt_path=None):
         fn = cloudpickle.loads(fn_blob)
         ctx = TrainContext(world_rank=0, world_size=1, trial_dir=self.trial_dir)
-        session = _Session(ctx, collector, None)
+        initial = Checkpoint(ckpt_path) if ckpt_path else None
+        session = _Session(ctx, collector, initial)
         # reports carry the trial id instead of a worker rank
         session.collector = _CollectorProxy(self.trial_id, collector)
         _set_session(session)
@@ -125,13 +126,60 @@ class Tuner:
             return run_trainer
         raise TypeError(f"unsupported trainable {type(t)}")
 
+    # trials loaded by Tuner.restore (None = fresh experiment)
+    _restored: Optional[dict] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any = None) -> "Tuner":
+        """Resume an interrupted experiment from its state snapshot.
+
+        Parity: ``Tuner.restore`` + the periodic experiment snapshot
+        (``python/ray/tune/execution/experiment_state.py:1``). Unfinished
+        trials are re-queued (from their last checkpoint when one exists);
+        finished trials keep their results.
+        """
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as fh:
+            snap = cloudpickle.loads(fh.read())
+        tuner = cls(
+            trainable if trainable is not None else cloudpickle.loads(snap["fn_blob"]),
+            param_space=snap["param_space"],
+            tune_config=snap["tune_config"],
+            run_config=snap["run_config"],
+        )
+        tuner._restored = snap
+        return tuner
+
+    @staticmethod
+    def _snapshot(exp_dir, trials, fn_blob, param_space, tune_config, run_config):
+        snap = {
+            "fn_blob": fn_blob,
+            "param_space": param_space,
+            "tune_config": tune_config,
+            "run_config": run_config,
+            "trials": {
+                tid: {
+                    "config": t["config"],
+                    "state": t["state"],
+                    "iteration": t["iteration"],
+                    "last_metrics": t["last_metrics"],
+                    "checkpoint_path": t["checkpoint"].path if t["checkpoint"] else None,
+                    "dir": t["dir"],
+                }
+                for tid, t in trials.items()
+            },
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as fh:
+            fh.write(cloudpickle.dumps(snap))
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+
     def fit(self) -> ResultGrid:
         cfg = self.tune_config
         exp_name = self.run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), exp_name)
         os.makedirs(exp_dir, exist_ok=True)
 
-        variants = generate_variants(self.param_space, cfg.num_samples, cfg.seed)
         scheduler = cfg.scheduler or FIFOScheduler()
         fn_blob = cloudpickle.dumps(self._as_function())
         collector = _TuneCollector.remote()
@@ -142,31 +190,69 @@ class Tuner:
 
         trials: Dict[str, dict] = {}
         queue = []
-        for i, variant in enumerate(variants):
-            tid = f"trial_{i:05d}_{uuid.uuid4().hex[:4]}"
-            trials[tid] = {
-                "config": variant,
-                "state": "PENDING",
-                "actor": None,
-                "ref": None,
-                "last_metrics": {},
-                "iteration": 0,
-                "checkpoint": None,
-                "error": None,
-                "dir": os.path.join(exp_dir, tid),
-            }
-            queue.append(tid)
+        if self._restored is not None:
+            for tid, st in self._restored["trials"].items():
+                ckpt = Checkpoint(st["checkpoint_path"]) if st["checkpoint_path"] else None
+                trials[tid] = {
+                    "config": st["config"],
+                    "state": st["state"],
+                    "actor": None,
+                    "ref": None,
+                    "last_metrics": st["last_metrics"],
+                    "iteration": st["iteration"],
+                    "checkpoint": ckpt,
+                    "error": None,
+                    "dir": st["dir"],
+                    "resume_from": st["checkpoint_path"],
+                }
+                if st["state"] in ("PENDING", "RUNNING"):
+                    trials[tid]["state"] = "PENDING"
+                    queue.append(tid)
+        else:
+            variants = generate_variants(self.param_space, cfg.num_samples, cfg.seed)
+            for i, variant in enumerate(variants):
+                tid = f"trial_{i:05d}_{uuid.uuid4().hex[:4]}"
+                trials[tid] = {
+                    "config": variant,
+                    "state": "PENDING",
+                    "actor": None,
+                    "ref": None,
+                    "last_metrics": {},
+                    "iteration": 0,
+                    "checkpoint": None,
+                    "error": None,
+                    "dir": os.path.join(exp_dir, tid),
+                    "resume_from": None,
+                }
+                queue.append(tid)
 
         running: Dict[Any, str] = {}  # ref -> trial_id
         seen = 0
+        last_snap = 0.0
 
         def launch(tid):
             t = trials[tid]
             os.makedirs(t["dir"], exist_ok=True)
             actor = _TrialActor.remote(tid, t["dir"])
-            ref = actor.run.remote(fn_blob, t["config"], collector)
+            ref = actor.run.remote(fn_blob, t["config"], collector, t.get("resume_from"))
             t.update(state="RUNNING", actor=actor, ref=ref)
             running[ref] = tid
+
+        def exploit(tid):
+            """PBT: clone a top trial's config+checkpoint, mutate, relaunch."""
+            t = trials[tid]
+            src_tid = scheduler.choose_exploit_source(tid, trials)
+            if src_tid is None:
+                return
+            src = trials[src_tid]
+            if t["actor"] is not None:
+                ray_tpu.kill(t["actor"])
+            running.pop(t["ref"], None)
+            t["config"] = scheduler.mutate_config(src["config"])
+            t["resume_from"] = src["checkpoint"].path if src["checkpoint"] else None
+            t["state"] = "PENDING"
+            t["actor"] = t["ref"] = None
+            queue.append(tid)
 
         while queue or running:
             while queue and len(running) < max_conc:
@@ -183,16 +269,21 @@ class Tuner:
                 t["iteration"] = iteration
                 if ckpt_path:
                     t["checkpoint"] = Checkpoint(ckpt_path)
-                if scheduler.on_result(tid, iteration, metrics) == STOP:
+                verdict = scheduler.on_result(tid, iteration, metrics)
+                if verdict == STOP:
                     t["state"] = "STOPPED"
                     if t["actor"] is not None:
                         ray_tpu.kill(t["actor"])
                     running.pop(t["ref"], None)
+                elif verdict == "EXPLOIT":
+                    exploit(tid)
             for ref in ready:
                 tid = running.pop(ref, None)
                 if tid is None:
                     continue
                 t = trials[tid]
+                if t["state"] == "PENDING":
+                    continue  # relaunched via exploit
                 try:
                     ray_tpu.get(ref)
                     t["state"] = "TERMINATED"
@@ -205,6 +296,17 @@ class Tuner:
                     t["error"] = e
                 if t["actor"] is not None and t["state"] != "STOPPED":
                     ray_tpu.kill(t["actor"])
+            now = time.monotonic()
+            if now - last_snap > 2.0:
+                last_snap = now
+                self._snapshot(
+                    exp_dir, trials, fn_blob, self.param_space,
+                    self.tune_config, self.run_config,
+                )
+        self._snapshot(
+            exp_dir, trials, fn_blob, self.param_space,
+            self.tune_config, self.run_config,
+        )
 
         results = []
         for tid, t in trials.items():

@@ -23,20 +23,52 @@ import ray_tpu
 _GROUP_PREFIX = "COLLECTIVE_GROUP:"
 
 
-@ray_tpu.remote(num_cpus=0, max_concurrency=16)
+@ray_tpu.remote(num_cpus=0, max_concurrency=64)
 class _GroupActor:
     def __init__(self, world_size: int):
+        import threading
+
         self.world_size = world_size
+        self._lock = threading.Lock()
         # (round, op) -> {rank: array}
         self.contribs: Dict[tuple, Dict[int, Any]] = {}
         self.results: Dict[tuple, Any] = {}
+        self._events: Dict[tuple, Any] = {}
+
+    def _event(self, key):
+        import threading
+
+        with self._lock:
+            ev = self._events.get(key)
+            if ev is None:
+                ev = self._events[key] = threading.Event()
+            return ev
+
+    def contribute_and_wait(self, key: tuple, rank: int, value, timeout: float):
+        """Deposit a contribution and block until the collective completes
+        (event-notified; replaces the round-1 fetch-poll loop)."""
+        with self._lock:
+            entry = self.contribs.setdefault(key, {})
+            entry[rank] = value
+            done = len(entry) == self.world_size
+            if done:
+                self.results[key] = self._finish(key, entry)
+                del self.contribs[key]
+        ev = self._event(key)
+        if done:
+            ev.set()
+        elif not ev.wait(timeout):
+            raise TimeoutError(f"collective {key} timed out")
+        return self.results[key]
 
     def contribute(self, key: tuple, rank: int, value):
-        entry = self.contribs.setdefault(key, {})
-        entry[rank] = value
-        if len(entry) == self.world_size:
-            self.results[key] = self._finish(key, entry)
-            del self.contribs[key]
+        with self._lock:
+            entry = self.contribs.setdefault(key, {})
+            entry[rank] = value
+            if len(entry) == self.world_size:
+                self.results[key] = self._finish(key, entry)
+                del self.contribs[key]
+                self._event(key).set()
         return True
 
     def _finish(self, key, entry):
@@ -64,8 +96,10 @@ class _GroupActor:
         return self.results.get(key)
 
     def gc(self, before_round: int):
-        for k in [k for k in self.results if k[0] < before_round]:
-            del self.results[k]
+        with self._lock:
+            for k in [k for k in self.results if k[0] < before_round]:
+                del self.results[k]
+                self._events.pop(k, None)
         return True
 
 
@@ -80,23 +114,24 @@ class CollectiveGroup:
             self._actor = ray_tpu.get_actor(name)
         except ValueError:
             try:
-                self._actor = _GroupActor.options(name=name).remote(world_size)
+                # every rank blocks one actor thread in contribute_and_wait:
+                # size the thread pool to the world so no world size deadlocks
+                self._actor = _GroupActor.options(
+                    name=name, max_concurrency=max(64, 2 * world_size + 4)
+                ).remote(world_size)
             except ValueError:
                 self._actor = ray_tpu.get_actor(name)
 
     def _run(self, op: str, value, timeout: float = 300.0):
         self._round += 1
         key = (self._round, op)
-        ray_tpu.get(self._actor.contribute.remote(key, self.rank, value), timeout=timeout)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            result = ray_tpu.get(self._actor.fetch.remote(key), timeout=timeout)
-            if result is not None:
-                if self._round % 100 == 0:
-                    self._actor.gc.remote(self._round - 10)
-                return result
-            time.sleep(0.002)
-        raise TimeoutError(f"collective {op} timed out (round {self._round})")
+        result = ray_tpu.get(
+            self._actor.contribute_and_wait.remote(key, self.rank, value, timeout),
+            timeout=timeout + 10,
+        )
+        if self._round % 100 == 0:
+            self._actor.gc.remote(self._round - 10)
+        return result
 
     def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
         return self._run(f"allreduce_{op}", np.asarray(tensor))

@@ -13,7 +13,7 @@ import time
 from typing import Dict, List, Optional
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.ids import PlacementGroupID, pg_ready_sentinel
 from ray_tpu._private.scheduler import PlacementGroupState
 from ray_tpu._private.worker import ObjectRef, get_runtime
 
@@ -24,29 +24,16 @@ class PlacementGroup:
         self.bundle_specs = bundles
 
     def ready(self) -> ObjectRef:
-        """An ObjectRef resolving when the PG is placed (parity: ``pg.ready()``)."""
-        from ray_tpu.remote_function import RemoteFunction
-        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+        """An ObjectRef resolving when the PG is placed (parity: ``pg.ready()``).
 
-        def _probe():
-            return True
-
-        return RemoteFunction(
-            _probe,
-            {
-                "num_cpus": 0.0,
-                "scheduling_strategy": PlacementGroupSchedulingStrategy(placement_group=self),
-            },
-        ).remote()
+        The scheduler commits a sentinel object the moment the 2PC placement
+        commits, so this is push-notified, not probe-polled."""
+        return ObjectRef(pg_ready_sentinel(self.id))
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         rt = get_runtime()
-        deadline = time.monotonic() + timeout_seconds
-        while time.monotonic() < deadline:
-            if rt.rpc("pg_state", self.id) == "CREATED":
-                return True
-            time.sleep(0.01)
-        return False
+        ready, _ = rt.wait([pg_ready_sentinel(self.id)], 1, timeout_seconds)
+        return bool(ready)
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundle_specs))

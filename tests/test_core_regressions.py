@@ -201,3 +201,21 @@ def test_custom_serializer_scoped_and_deregisterable(ray_start_regular):
     blob = ctx.serialize_to_bytes(Odd(7))
     out = ctx.deserialize_from(memoryview(blob))
     assert out.x == 7  # default path after deregistration
+
+
+def test_log_to_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def chatty():
+        print("marker-from-worker-xyz")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        out, err = capfd.readouterr()
+        seen += out + err
+        if "marker-from-worker-xyz" in seen:
+            break
+        time.sleep(0.1)
+    assert "marker-from-worker-xyz" in seen

@@ -161,3 +161,80 @@ def test_status_and_delete(serve_cluster):
     serve.delete("stat_app")
     with pytest.raises(ValueError):
         serve.get_app_handle("stat_app")
+
+
+def test_streaming_response(serve_cluster):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 3
+
+    handle = serve.run(Streamer.bind(), name="stream_app")
+    out = list(handle.options(stream=True).remote(4))
+    assert out == [0, 3, 6, 9]
+
+
+def test_multiplexed_models(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return f"model:{model_id}"
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return f"{model}+{x}"
+
+    handle = serve.run(MultiModel.bind(), name="mux_app")
+    r1 = handle.options(multiplexed_model_id="a").remote(1).result(timeout_s=60)
+    r2 = handle.options(multiplexed_model_id="b").remote(2).result(timeout_s=60)
+    assert r1 == "model:a+1"
+    assert r2 == "model:b+2"
+
+
+def test_autoscaling_up_and_down(serve_cluster):
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1,
+        },
+        max_ongoing_requests=1,
+    )
+    class Slow:
+        def __call__(self):
+            time.sleep(1.2)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="auto_app")
+
+    def replica_count():
+        st = serve.status()
+        return st["auto_app"]["Slow"]["num_replicas"]
+
+    assert replica_count() == 1
+    # sustained burst: keep >= 6 requests in flight so the controller's
+    # metric poll sees depth > target and scales out
+    responses = [handle.remote() for _ in range(12)]
+    deadline = time.monotonic() + 40
+    grew = False
+    while time.monotonic() < deadline:
+        if replica_count() >= 2:
+            grew = True
+            break
+        responses = [r for r in responses if True]  # keep refs alive
+        time.sleep(0.5)
+    for r in responses:
+        r.result(timeout_s=120)
+    assert grew, "deployment never scaled out"
+    # idle: scales back down to min
+    deadline = time.monotonic() + 60
+    shrank = False
+    while time.monotonic() < deadline:
+        if replica_count() == 1:
+            shrank = True
+            break
+        time.sleep(0.5)
+    assert shrank, "deployment never scaled back in"

@@ -116,3 +116,102 @@ def test_median_stopping_rule():
     assert rule.on_result("a", 1, {"loss": 1.0}) == "CONTINUE"
     assert rule.on_result("b", 1, {"loss": 1.2}) == "CONTINUE"
     assert rule.on_result("c", 1, {"loss": 50.0}) == "STOP"
+
+
+def test_pbt_exploits_better_config(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train import RunConfig
+    from ray_tpu.tune import PopulationBasedTraining, TuneConfig
+
+    def trainable(config):
+        from ray_tpu.train import report
+
+        import time as _t
+
+        # score is simply the lr: PBT must migrate lr=0 trials to lr=1
+        # (slow iterations so the controller can interject exploits)
+        for _ in range(14):
+            report({"score": config["lr"]})
+            _t.sleep(0.25)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.0, 0.0, 1.0])},
+        tune_config=TuneConfig(
+            num_samples=1,
+            scheduler=PopulationBasedTraining(
+                metric="score",
+                mode="max",
+                perturbation_interval=2,
+                hyperparam_mutations={"lr": [0.0, 1.0]},
+                quantile_fraction=0.4,
+                seed=0,
+            ),
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="pbt"),
+    )
+    results = tuner.fit()
+    finals = [r.metrics["score"] for r in results]
+    # every surviving trial converges onto the winning config
+    assert max(finals) == 1.0
+    assert sum(1 for s in finals if s == 1.0) >= 2, finals
+
+
+def test_tuner_restore_resumes_experiment(ray_start_regular, tmp_path):
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time as _time
+
+    import ray_tpu as rt
+    from ray_tpu import tune
+
+    exp_dir = str(tmp_path / "exp")
+    script = textwrap.dedent(f"""
+        import ray_tpu, time
+        from ray_tpu import tune
+        from ray_tpu.train import RunConfig, report
+        ray_tpu.init(num_cpus=2)
+
+        def slow_trial(config):
+            for i in range(40):
+                report({{"step": i, "tag": config["tag"]}})
+                time.sleep(0.5)
+
+        tune.Tuner(
+            slow_trial,
+            param_space={{"tag": tune.grid_search([1, 2])}},
+            tune_config=tune.TuneConfig(num_samples=1, max_concurrent_trials=2),
+            run_config=RunConfig(storage_path={str(tmp_path)!r}, name="exp"),
+        ).fit()
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(rt.__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env)
+    # wait for the snapshot to appear, then kill the driver mid-sweep
+    deadline = _time.monotonic() + 60
+    state_file = os.path.join(exp_dir, "experiment_state.pkl")
+    while _time.monotonic() < deadline:
+        if os.path.exists(state_file):
+            break
+        _time.sleep(0.2)
+    else:
+        proc.kill()
+        raise TimeoutError("snapshot never appeared")
+    _time.sleep(1.0)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=15)
+
+    def fast_trial(config):
+        from ray_tpu.train import report
+
+        for i in range(3):
+            report({"step": i, "tag": config["tag"]})
+
+    tuner = tune.Tuner.restore(exp_dir, trainable=fast_trial)
+    results = tuner.fit()
+    tags = sorted(r.metrics["tag"] for r in results)
+    assert tags == [1, 2]  # both trials resumed and completed
+    assert all(r.error is None for r in results)
