@@ -198,13 +198,13 @@ class DriverRuntime:
         out = []
         for oid in oids:
             entry = ms.get_entry(oid)
-            val, is_err = self._entry_value(oid, entry)
+            val, is_err = self._entry_value(oid, entry, timeout)
             if is_err:
                 raise val
             out.append(val)
         return out
 
-    def _entry_value(self, oid: ObjectID, entry: Tuple) -> Tuple[Any, bool]:
+    def _entry_value(self, oid: ObjectID, entry: Tuple, timeout=None) -> Tuple[Any, bool]:
         """Returns (value, is_error). Error-ness comes from the entry kind so
         exception *values* stored by users round-trip as plain objects."""
         kind = entry[0]
@@ -213,8 +213,10 @@ class DriverRuntime:
         if kind == "stored":
             # the copy may live on a remote node (or have been lost with it):
             # poll while periodically asking the scheduler to transfer — or
-            # lineage-reconstruct — it into the head store
-            deadline = time.monotonic() + 60.0
+            # lineage-reconstruct — it into the head store. The wait honors
+            # the caller's get() timeout (capped at 60s).
+            budget = 60.0 if timeout is None else min(float(timeout), 60.0)
+            deadline = time.monotonic() + budget
             mv = self.store.get(oid, timeout=0.05)
             while mv is None:
                 if time.monotonic() >= deadline:

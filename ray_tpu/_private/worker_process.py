@@ -451,20 +451,25 @@ class _TeeStream:
         self._name = name
         self._buf = ""
         self._pid = os.getpid()
+        self._lock = threading.Lock()  # threaded actors print concurrently
 
     def write(self, text):
         try:
             self._original.write(text)
         except Exception:
             pass
-        self._buf += text
-        while "\n" in self._buf:
-            line, self._buf = self._buf.split("\n", 1)
-            if line:
-                try:
-                    self._rt._send(("log", self._name, self._pid, line))
-                except Exception:
-                    pass
+        lines = []
+        with self._lock:
+            self._buf += text
+            while "\n" in self._buf:
+                line, self._buf = self._buf.split("\n", 1)
+                if line:
+                    lines.append(line)
+        for line in lines:
+            try:
+                self._rt._send(("log", self._name, self._pid, line))
+            except Exception:
+                pass
         return len(text)
 
     def flush(self):

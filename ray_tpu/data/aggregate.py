@@ -172,6 +172,20 @@ def _sample_keys(block: Batch, key: str, m: int):
     return np.sort(np.asarray(block[key]))[::step][:m]
 
 
+def _iter_groups(merged: Batch, key: str):
+    """Yield (key_value, group_block) over a merged partition, grouped by
+    a stable sort on the key column."""
+    col = merged[key]
+    order = np.argsort(col, kind="stable")
+    sorted_block = {c: v[order] for c, v in merged.items()}
+    keys_sorted = sorted_block[key]
+    uniq, starts = np.unique(keys_sorted, return_index=True)
+    bounds = list(starts) + [len(keys_sorted)]
+    for gi in range(len(uniq)):
+        s, e = bounds[gi], bounds[gi + 1]
+        yield uniq[gi], {c: v[s:e] for c, v in sorted_block.items()}
+
+
 @ray_tpu.remote
 def _group_reduce(key: str, agg_blobs, *slices: Batch):
     """Reduce stage of the hash exchange: group rows, apply aggregations."""
@@ -181,19 +195,11 @@ def _group_reduce(key: str, agg_blobs, *slices: Batch):
     merged = concat_blocks(list(slices))
     if not merged:
         return {}
-    col = merged[key]
-    order = np.argsort(col, kind="stable")
-    sorted_block = {c: v[order] for c, v in merged.items()}
-    keys_sorted = sorted_block[key]
-    uniq, starts = np.unique(keys_sorted, return_index=True)
-    bounds = list(starts) + [len(keys_sorted)]
     out: Dict[str, list] = {key: []}
     for a in aggs:
         out[a.name] = []
-    for gi in range(len(uniq)):
-        s, e = bounds[gi], bounds[gi + 1]
-        group = {c: v[s:e] for c, v in sorted_block.items()}
-        out[key].append(uniq[gi])
+    for key_value, group in _iter_groups(merged, key):
+        out[key].append(key_value)
         for a in aggs:
             acc = a.accumulate_block(a.init(), group)
             out[a.name].append(a.finalize(acc))
@@ -210,16 +216,8 @@ def _map_groups_reduce(key: str, fn_blob, *slices: Batch):
     merged = concat_blocks(list(slices))
     if not merged:
         return {}
-    col = merged[key]
-    order = np.argsort(col, kind="stable")
-    sorted_block = {c: v[order] for c, v in merged.items()}
-    keys_sorted = sorted_block[key]
-    uniq, starts = np.unique(keys_sorted, return_index=True)
-    bounds = list(starts) + [len(keys_sorted)]
     outs = []
-    for gi in range(len(uniq)):
-        s, e = bounds[gi], bounds[gi + 1]
-        group = {c: v[s:e] for c, v in sorted_block.items()}
+    for _, group in _iter_groups(merged, key):
         outs.append(normalize_block(fn(group)))
     return concat_blocks(outs)
 
@@ -239,9 +237,9 @@ class GroupedData:
         self._ds = dataset
         self._key = key
 
-    def aggregate(self, *aggs: AggregateFn):
-        import cloudpickle
-
+    def _exchange(self, reduce_task, payload):
+        """Hash exchange: partition every block by key, then reduce each
+        partition with ``reduce_task(key, payload, *slices)``."""
         from ray_tpu.data.dataset import Dataset
 
         mat = self._ds.materialize()
@@ -252,32 +250,21 @@ class GroupedData:
         ]
         if k == 1:
             parts = [[p] for p in parts]
-        agg_blobs = [cloudpickle.dumps(a) for a in aggs]
         out = [
-            _group_reduce.remote(self._key, agg_blobs, *[row[j] for row in parts])
+            reduce_task.remote(self._key, payload, *[row[j] for row in parts])
             for j in range(k)
         ]
         return Dataset(out)
+
+    def aggregate(self, *aggs: AggregateFn):
+        import cloudpickle
+
+        return self._exchange(_group_reduce, [cloudpickle.dumps(a) for a in aggs])
 
     def map_groups(self, fn: Callable):
         import cloudpickle
 
-        from ray_tpu.data.dataset import Dataset
-
-        mat = self._ds.materialize()
-        k = max(1, len(mat._block_refs))
-        parts = [
-            _hash_partition.options(num_returns=k).remote(ref, self._key, k)
-            for ref in mat._block_refs
-        ]
-        if k == 1:
-            parts = [[p] for p in parts]
-        blob = cloudpickle.dumps(fn)
-        out = [
-            _map_groups_reduce.remote(self._key, blob, *[row[j] for row in parts])
-            for j in range(k)
-        ]
-        return Dataset(out)
+        return self._exchange(_map_groups_reduce, cloudpickle.dumps(fn))
 
     def count(self):
         return self.aggregate(Count())

@@ -60,16 +60,23 @@ def _exec_block(block_or_ref, ops):
 class Dataset:
     """A lazy plan: source block refs + a chain of per-block operators."""
 
-    def __init__(self, block_refs: List, ops: Optional[List] = None):
+    def __init__(self, block_refs: List, ops: Optional[List] = None, owned_actors=None):
         self._block_refs = list(block_refs)
         self._ops = list(ops or [])
+        # actor pools whose pending tasks produce our blocks: pinned here so
+        # handle-count reaping can't kill them before the blocks materialize
+        self._owned_actors = list(owned_actors or [])
 
     # -- transformations (lazy) -------------------------------------------
 
     def _with_op(self, kind: str, fn: Callable) -> "Dataset":
         import cloudpickle
 
-        return Dataset(self._block_refs, self._ops + [(kind, cloudpickle.dumps(fn))])
+        return Dataset(
+            self._block_refs,
+            self._ops + [(kind, cloudpickle.dumps(fn))],
+            owned_actors=self._owned_actors,
+        )
 
     def map(self, fn: Callable) -> "Dataset":
         return self._with_op("map", fn)
@@ -109,18 +116,24 @@ class Dataset:
             def apply(self, block):
                 return normalize_block(self._fn(block))
 
+        from ray_tpu.data.context import DataContext
+
         workers = [_BlockWorker.remote(fn_blob) for _ in range(strategy.size)]
         # round-robin over the pool, keeping object refs (blocks never pass
-        # through the driver); per-actor queues serialize each actor's work
-        refs = [
-            workers[i % len(workers)].apply.remote(ref)
-            for i, ref in enumerate(self._iter_exec_block_refs())
-        ]
-        out = Dataset(refs)
-        # pin the pool until its (lazy) outputs are consumed: dropping the
-        # handles would reap the actors before the block tasks run
-        out._owned_actors = workers
-        return out
+        # through the driver); submission is windowed so in-flight work stays
+        # bounded (the backpressure contract) even for huge datasets
+        window = max(1, DataContext.get_current().max_inflight_blocks) * len(workers)
+        refs = []
+        inflight = []
+        for i, ref in enumerate(self._iter_exec_block_refs()):
+            out_ref = workers[i % len(workers)].apply.remote(ref)
+            refs.append(out_ref)
+            inflight.append(out_ref)
+            if len(inflight) >= window:
+                ray_tpu.wait(inflight, num_returns=len(inflight) - window + 1)
+                inflight = inflight[-(window - 1) :]
+        # the pool rides on the Dataset so reaping waits for consumption
+        return Dataset(refs, owned_actors=workers)
 
     def filter(self, fn: Callable) -> "Dataset":
         return self._with_op("filter", fn)
@@ -389,7 +402,9 @@ class Dataset:
         """Execute the plan; returns a Dataset of plain block refs."""
         if not self._ops:
             return self
-        return Dataset(list(self._iter_exec_block_refs()))
+        return Dataset(
+            list(self._iter_exec_block_refs()), owned_actors=self._owned_actors
+        )
 
     def to_block(self) -> Batch:
         return concat_blocks(list(self._iter_exec_blocks()))

@@ -68,17 +68,45 @@ def attention(
 def _can_use_flash(q, k) -> bool:
     if jax.default_backend() != "tpu":
         return False
-    # measured on v5e: the stock pallas flash kernel loses to the XLA einsum
-    # path at head_dim 64 / seq 1k; gate to shapes where it wins until the
-    # tuned in-tree kernel lands
     head_dim = q.shape[-1]
-    return head_dim % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+    if head_dim % 128 == 0:
+        return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+    # head_dim 64 (e.g. d_model 1024 / 16 heads): the stock block sizes lose
+    # to the XLA einsum path, but 512-blocks win (measured ~1.4x on v5e at
+    # seq 1k; see _tuned_block_sizes) — require 512-divisible sequences
+    if head_dim == 64:
+        return q.shape[1] % 512 == 0 and k.shape[1] % 512 == 0
+    return False
+
+
+def _tuned_block_sizes(head_dim: int, q_seq: int, kv_seq: int):
+    """Measured on v5e: for head_dim 64 the defaults underfill the MXU; 512
+    blocks throughout (fwd + dkv/dq passes) beat both the defaults and the
+    einsum path. None = library defaults."""
+    if head_dim != 64:
+        return None
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bq = min(512, q_seq)
+    bk = min(512, kv_seq)
+    return BlockSizes(
+        block_q=bq,
+        block_k_major=bk,
+        block_k=bk,
+        block_b=1,
+        block_q_major_dkv=bq,
+        block_k_major_dkv=bk,
+        block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk,
+        block_k_dq=bk,
+        block_q_dq=bq,
+    )
 
 
 def _flash(q, k, v, *, causal):
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
-            BlockSizes,
             flash_attention,
         )
     except ImportError:
@@ -86,8 +114,14 @@ def _flash(q, k, v, *, causal):
     # pallas kernel wants BHSD
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_sizes = _tuned_block_sizes(q.shape[-1], q.shape[1], k.shape[1])
     try:
-        out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+        if block_sizes is not None:
+            out = flash_attention(
+                qt, kt, vt, causal=causal, sm_scale=sm_scale, block_sizes=block_sizes
+            )
+        else:
+            out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
     except Exception:
         return None
     return jnp.swapaxes(out, 1, 2)

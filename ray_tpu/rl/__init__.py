@@ -10,6 +10,16 @@ actors. In-tree algorithms: PPO (CartPole learning target: return >= 150,
 """
 
 from ray_tpu.rl.env import CartPoleEnv, EnvSpec, make_env, register_env
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "CartPoleEnv", "make_env", "register_env", "EnvSpec"]
+__all__ = [
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "CartPoleEnv",
+    "make_env",
+    "register_env",
+    "EnvSpec",
+]

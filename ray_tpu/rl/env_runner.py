@@ -19,6 +19,9 @@ class EnvRunner:
     """Plain class; wrapped as a remote actor by EnvRunnerGroup."""
 
     def __init__(self, env_creator, num_envs: int, rollout_len: int, seed: int):
+        from ray_tpu.train.jax_utils import ensure_platform
+
+        ensure_platform()  # runners must not grab the accelerator
         import jax
 
         from ray_tpu.rl.env import VectorEnv
@@ -78,21 +81,54 @@ RemoteEnvRunner = ray_tpu.remote(EnvRunner)
 
 
 class EnvRunnerGroup:
-    """num_env_runners remote runners, or one local (in-driver) runner."""
+    """num_env_runners remote runners, or one local (in-driver) runner.
+
+    Elastic fault tolerance (parity: ``FaultTolerantActorManager``,
+    ``rllib/utils/actor_manager.py:1``): dead runners are dropped on sample
+    and ``restore()`` replaces them up to the configured count, so sampling
+    survives runner loss and heals."""
 
     def __init__(self, env_creator, num_env_runners: int, num_envs_per_runner: int,
                  rollout_len: int, seed: int = 0):
         self.local: Optional[EnvRunner] = None
         self.remote: List = []
+        self._env_creator = env_creator
+        self._num_envs = num_envs_per_runner
+        self._rollout_len = rollout_len
+        self._seed = seed
+        self._target = num_env_runners
+        self._spawned = 0
         if num_env_runners == 0:
             self.local = EnvRunner(env_creator, num_envs_per_runner, rollout_len, seed)
         else:
-            self.remote = [
-                RemoteEnvRunner.remote(
-                    env_creator, num_envs_per_runner, rollout_len, seed + 1000 * i
-                )
-                for i in range(num_env_runners)
-            ]
+            for _ in range(num_env_runners):
+                self._spawn()
+
+    def _spawn(self):
+        self._spawned += 1
+        self.remote.append(
+            RemoteEnvRunner.remote(
+                self._env_creator,
+                self._num_envs,
+                self._rollout_len,
+                self._seed + 1000 * self._spawned,
+            )
+        )
+
+    def num_healthy(self) -> int:
+        return 1 if self.local is not None else len(self.remote)
+
+    def restore(self, min_runners: Optional[int] = None) -> int:
+        """Replace dead runners up to the original target; returns how many
+        fresh runners were started."""
+        if self.local is not None:
+            return 0
+        want = self._target if min_runners is None else min_runners
+        started = 0
+        while len(self.remote) < want:
+            self._spawn()
+            started += 1
+        return started
 
     def sample(self, params) -> List[Dict[str, np.ndarray]]:
         if self.local is not None:

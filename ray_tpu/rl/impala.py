@@ -1,0 +1,246 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace off-policy correction.
+
+Parity: ``rllib/algorithms/impala/impala.py:1`` (actor-learner decoupling,
+V-trace from Espeholt et al. 2018) + the multi-learner group
+(``rllib/core/learner/learner_group.py:83``). TPU-first translation: instead
+of N torch-DDP learner processes exchanging NCCL allreduces, the learner
+update is ONE jitted SPMD program over a ``jax.sharding.Mesh`` — the batch is
+sharded across the ``data`` axis and XLA inserts the gradient reductions over
+ICI (SURVEY.md §2.3 "RLlib learner DP").
+
+Env-runner fault tolerance mirrors ``rllib/utils/actor_manager.py:1``: dead
+runners are detected on sample, dropped, and replaced, so sampling is elastic
+under runner loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 6e-4
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+        self.grad_clip = 40.0
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        # learner SPMD width: devices the one-program learner group spans
+        self.num_learner_devices = 1
+
+    def learners(self, num_learner_devices: int = 1) -> "IMPALAConfig":
+        self.num_learner_devices = num_learner_devices
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+def vtrace_targets(
+    values, last_values, rewards, dones, rhos, gamma, clip_rho=1.0, clip_c=1.0
+):
+    """V-trace targets vs_t and policy-gradient advantages (jax, scan-based).
+
+    values/rewards/dones/rhos: (T, N); last_values: (N,).
+    Returns (vs (T,N), pg_adv (T,N)).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rho_bar = jnp.minimum(rhos, clip_rho)
+    c_bar = jnp.minimum(rhos, clip_c)
+    discounts = gamma * (1.0 - dones.astype(jnp.float32))
+    values_next = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = rho_bar * (rewards + discounts * values_next - values)
+
+    def scan_fn(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn,
+        jnp.zeros_like(last_values),
+        (deltas[::-1], discounts[::-1], c_bar[::-1]),
+    )
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], last_values[None]], axis=0)
+    pg_adv = rho_bar * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config: IMPALAConfig):
+        super().__init__(config)
+        import jax
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self._jax = jax
+        probe = make_env(config.env)
+        spec = probe.spec
+        self.params = init_mlp_policy(
+            jax.random.PRNGKey(config.seed), spec.obs_dim, spec.num_actions, config.hidden
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip), optax.adam(config.lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self.runners = EnvRunnerGroup(
+            config.env,
+            config.num_env_runners,
+            config.num_envs_per_runner,
+            config.rollout_len,
+            seed=config.seed,
+        )
+
+        # --- SPMD learner group: one program over a data-axis mesh ---
+        n_dev = max(1, int(config.num_learner_devices))
+        devices = jax.devices()[:n_dev]
+        if len(devices) < n_dev:
+            raise ValueError(f"need {n_dev} devices, have {len(devices)}")
+        self._mesh = Mesh(np.array(devices), ("data",))
+        replicated = NamedSharding(self._mesh, P())
+        batch_sharded = NamedSharding(self._mesh, P(None, "data"))  # (T, N, ...)
+        n_sharded = NamedSharding(self._mesh, P("data"))  # (N,)
+        batch_shardings = {
+            "obs": batch_sharded,
+            "actions": batch_sharded,
+            "logp": batch_sharded,
+            "rewards": batch_sharded,
+            "dones": batch_sharded,
+            "last_values": n_sharded,
+            "mask": n_sharded,
+        }
+        self._update = jax.jit(
+            self._make_update(),
+            in_shardings=(replicated, replicated, batch_shardings),
+            out_shardings=(replicated, replicated, replicated),
+        )
+        self._recent_returns: List[float] = []
+        self._timesteps = 0
+        self._device_batch = None
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            T, N = batch["actions"].shape
+            obs = batch["obs"].reshape(T * N, -1)
+            logits, values = apply_mlp_policy(params, obs)
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1
+            )[..., 0]
+            rhos = jnp.exp(logp - batch["logp"])  # pi / mu
+            vs, pg_adv = vtrace_targets(
+                values,
+                batch["last_values"],
+                batch["rewards"],
+                batch["dones"],
+                rhos,
+                cfg.gamma,
+                cfg.vtrace_clip_rho,
+                cfg.vtrace_clip_c,
+            )
+            # mask out env lanes padded up to the mesh multiple — their
+            # zero-filled transitions must not bias the gradient
+            w = batch["mask"][None, :]  # (1, N) broadcast over T
+            denom = jnp.maximum(jnp.sum(w) * T, 1.0)
+            pg_loss = -jnp.sum(logp * pg_adv * w) / denom
+            vf_loss = 0.5 * jnp.sum(((values - vs) ** 2) * w) / denom
+            entropy = (
+                -jnp.sum(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1) * w) / denom
+            )
+            loss = pg_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return loss, {
+                "pg_loss": pg_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+            }
+
+        def update(params, opt_state, batch):
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        return update
+
+    # -- training ----------------------------------------------------------
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        rollouts = self.runners.sample(self.params)
+        self.runners.restore(min_runners=None)  # replace any dead runners
+        # concatenate runner rollouts along the env axis
+        batch = {
+            k: np.concatenate([r[k] for r in rollouts], axis=-1 if k == "last_values" else 1)
+            for k in ("obs", "actions", "logp", "rewards", "dones")
+        }
+        batch["last_values"] = np.concatenate([r["last_values"] for r in rollouts])
+        for r in rollouts:
+            self._recent_returns.extend(r["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+        T, N = batch["actions"].shape
+        # pad N to a multiple of the mesh so shards are equal; a mask keeps
+        # the padded lanes out of the loss
+        n_dev = self._mesh.devices.size
+        pad = (-N) % n_dev
+        batch["mask"] = np.ones(N, np.float32)
+        if pad:
+            for k, v in batch.items():
+                env_axis = 0 if k in ("last_values", "mask") else 1
+                widths = [(0, 0)] * v.ndim
+                widths[env_axis] = (0, pad)
+                batch[k] = np.pad(v, widths)
+        batch = {
+            k: v.astype(np.float32) if v.dtype == bool else v for k, v in batch.items()
+        }
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch
+        )
+        self._timesteps += T * N
+        mean_ret = (
+            float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
+        )
+        return {
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "num_healthy_workers": self.runners.num_healthy(),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    # -- checkpointing (Tune-Trainable shape) ------------------------------
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": jax.tree.map(lambda x: np.asarray(x), self.params),
+            "timesteps": self._timesteps,
+        }
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self._timesteps = state.get("timesteps", 0)
+
+    def stop(self):
+        self.runners.stop()

@@ -225,16 +225,35 @@ class ServeController:
             d["spec"]["num_replicas"] = desired  # reconcile starts the rest
         elif desired < current:
             d["spec"]["num_replicas"] = desired
-            # drop the idlest replicas
+            # drain the idlest replicas: remove them from the serving table
+            # now (handles stop routing on refresh), kill once idle or after
+            # a grace period — an immediate kill loses in-flight requests
             order = sorted(range(len(alive)), key=lambda i: depths[i])
             drop = set(order[: len(alive) - desired])
+            draining = d.setdefault("draining", [])
             for i in drop:
+                draining.append((alive[i], time.monotonic() + 15.0))
+            alive = [r for i, r in enumerate(alive) if i not in drop]
+        self._reap_draining(d)
+        return alive
+
+    def _reap_draining(self, d: dict):
+        still = []
+        for r, deadline in d.get("draining", []):
+            idle = False
+            try:
+                idle = ray_tpu.get(r.num_ongoing.remote(), timeout=5) == 0
+            except Exception:
+                idle = True  # already dead
+            if idle or time.monotonic() > deadline:
                 try:
-                    ray_tpu.kill(alive[i])
+                    ray_tpu.kill(r)
                 except Exception:
                     pass
-            alive = [r for i, r in enumerate(alive) if i not in drop]
-        return alive
+            else:
+                still.append((r, deadline))
+        if "draining" in d:
+            d["draining"] = still
 
     # -- reconciliation (parity: DeploymentState reconcile loop) ----------
 

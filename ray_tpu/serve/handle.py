@@ -159,13 +159,17 @@ class DeploymentHandle:
         self._maybe_refresh()
         idx = self._pick(self._model_id)
         with self._lock:
-            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+            # bind the generation's counter dict: a replica-list refresh swaps
+            # it out, and late done() callbacks must decrement the dict they
+            # incremented (not drive the fresh one negative)
+            out_map = self._outstanding
+            out_map[idx] = out_map.get(idx, 0) + 1
             replica = self._replicas[idx]
 
         def done():
             with self._lock:
-                if idx in self._outstanding:
-                    self._outstanding[idx] -= 1
+                if idx in out_map:
+                    out_map[idx] -= 1
 
         if self._stream:
             gen = replica.handle_request_streaming.options(

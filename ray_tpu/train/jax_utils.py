@@ -13,6 +13,23 @@ from typing import Any, Optional
 import jax
 
 
+def ensure_platform() -> None:
+    """Honor JAX_PLATFORMS inside worker processes.
+
+    Hardware plugins can pin the default backend regardless of the env var
+    (the env alone is ignored by plugin builds); only ``jax.config`` wins.
+    Call before first backend use in any worker-side jax entry point — a
+    worker silently grabbing the (single, possibly tunneled) accelerator
+    instead of CPU turns microsecond steps into network round-trips.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
 def save_pytree(state: Any, path: str) -> None:
     """Save a pytree of arrays to ``path`` (orbax if available, else msgpack
     via flax, else numpy .npz of flattened leaves)."""

@@ -129,8 +129,13 @@ def test_node_death_task_retry(real_cluster):
     refs = [slow.remote() for _ in range(4)]
     time.sleep(0.8)
     real_cluster.remove_node(doomed)  # SIGKILL: socket drops, node declared dead
-    assert ray_tpu.get(refs, timeout=120) == ["done"] * 4
-    alive = [n for n in ray_tpu.nodes() if n["alive"]]
+    assert ray_tpu.get(refs, timeout=180) == ["done"] * 4
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 2:
+            break
+        time.sleep(0.2)
     assert len(alive) == 2
 
 

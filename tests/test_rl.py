@@ -4,6 +4,8 @@ cartpole-ppo.yaml`` asserts return >= 150 within 100k steps (SURVEY.md §4)."""
 import numpy as np
 import pytest
 
+import ray_tpu
+
 from ray_tpu.rl import CartPoleEnv, PPOConfig, make_env, register_env
 
 
@@ -91,3 +93,38 @@ def test_ppo_save_restore(tmp_path):
 def test_config_rejects_unknown_option():
     with pytest.raises(ValueError):
         PPOConfig().training(nonexistent_option=1)
+
+
+def test_impala_cartpole_learns_spmd(ray_start_regular):
+    """IMPALA with an 8-device SPMD learner (CPU mesh) + remote env runners
+    learns CartPole; a runner killed mid-train is replaced (elastic)."""
+    import jax
+
+    from ray_tpu.rl import IMPALAConfig
+
+    assert len(jax.devices()) >= 8
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=16,
+                     rollout_fragment_length=64)
+        .training(lr=1e-3, entropy_coeff=0.005)
+        .learners(num_learner_devices=8)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = 0.0
+    killed = False
+    for i in range(400):
+        result = algo.training_step()
+        best = max(best, result["episode_return_mean"])
+        if i == 10 and not killed:
+            # kill one env runner mid-train: sampling must stay elastic
+            ray_tpu.kill(algo.runners.remote[0])
+            killed = True
+        if i > 12 and killed:
+            assert result["num_healthy_workers"] == 2  # replaced
+        if best >= 150.0:
+            break
+    algo.stop()
+    assert best >= 150.0, f"IMPALA did not learn (best {best})"
