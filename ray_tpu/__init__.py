@@ -183,3 +183,13 @@ def timeline() -> List[dict]:
             }
         )
     return out
+
+
+def __getattr__(name):
+    # lazy subpackage access: `import ray_tpu; ray_tpu.data.range(...)` works
+    # without eagerly importing the libraries (parity: `ray.data` et al)
+    if name in ("data", "train", "tune", "serve", "rl", "workflow", "util", "autoscaler"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -70,16 +70,56 @@ class NativeStoreClient(StorePutMixin):
     def create(self, oid: ObjectID, size: int) -> memoryview:
         err = ctypes.c_int(0)
         off = self._lib.rt_store_create(self._h, oid.binary(), size, ctypes.byref(err))
+        if not off and err.value == 2:
+            # arena full: spill LRU sealed objects to the file store, then
+            # evict them, until the allocation fits (parity: plasma eviction
+            # + LocalObjectManager spilling, local_object_manager.h:41).
+            # Objects too large to ever fit skip straight to the fallback.
+            if size + (1 << 20) < self._capacity:
+                while self._spill_one_lru():
+                    off = self._lib.rt_store_create(
+                        self._h, oid.binary(), size, ctypes.byref(err)
+                    )
+                    if off or err.value != 2:
+                        break
         if off:
             with self._lock:
                 self._creating[oid] = True
             return self._view(off, size)
         if err.value == 1:
             raise ValueError(f"object {oid.hex()} already exists")
-        # arena full: fall back to the file store
+        # arena (still) full: fall back to the file store
         with self._lock:
             self._creating[oid] = False
         return self._fallback.create(oid, size)
+
+    def _spill_one_lru(self) -> bool:
+        """Copy the LRU sealed+unpinned arena object into the file store,
+        then delete it from the arena. Returns False when nothing is
+        evictable."""
+        vid_buf = (ctypes.c_uint8 * ObjectID.SIZE)()
+        if not self._lib.rt_store_lru_victim(self._h, vid_buf):
+            return False
+        vid_bytes = bytes(vid_buf)
+        vid = ObjectID(vid_bytes)
+        size = ctypes.c_uint64(0)
+        off = self._lib.rt_store_get(self._h, vid_bytes, ctypes.byref(size))
+        if off:
+            try:
+                if not self._fallback.contains(vid):
+                    src = self._view(off, size.value)
+                    try:
+                        dest = self._fallback.create(vid, size.value)
+                        dest[:] = src
+                        self._fallback.seal(vid)
+                    except ValueError:
+                        pass  # concurrent spiller won the race
+                    except StoreFullError:
+                        return False  # disk full too: stop evicting
+            finally:
+                self._lib.rt_store_release(self._h, vid_bytes)
+        self._lib.rt_store_delete(self._h, vid_bytes)
+        return True
 
     def seal(self, oid: ObjectID) -> None:
         with self._lock:

@@ -287,6 +287,21 @@ class GcsTables:
             self.named_actors[(ns, name)] = actor_id
             return True
 
+    def snapshot(self) -> dict:
+        with self._lock:
+            # runtime_env package blobs (up to 100MB each) are excluded: the
+            # snapshot runs on the scheduler loop every few seconds, and
+            # drivers re-upload packages on demand after a restart
+            kv = {
+                k: v for k, v in self.kv.items() if k[0] != "runtime_env_packages"
+            }
+            return {"kv": kv, "named_actors": dict(self.named_actors)}
+
+    def load(self, snap: dict) -> None:
+        with self._lock:
+            self.kv.update(snap.get("kv", {}))
+            self.named_actors.update(snap.get("named_actors", {}))
+
 
 # --------------------------------------------------------------------------
 # the scheduler event loop
@@ -336,6 +351,7 @@ class Scheduler:
         self._fetching: Set[Tuple[ObjectID, NodeID]] = set()
         # head node's own object server address (set by HeadServer)
         self.head_object_addr = None
+        self._last_gcs_snapshot = 0.0
 
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
@@ -855,6 +871,17 @@ class Scheduler:
 
         Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
         (``cluster_task_manager.cc:136``)."""
+        # control-plane persistence: periodically snapshot the GCS tables +
+        # detached-actor specs so a restarted head rebuilds them (parity:
+        # GcsTableStorage + Redis persistence, redis_store_client.h:33,
+        # rebuilt via gcs_init_data.h)
+        now0 = time.monotonic()
+        if now0 - self._last_gcs_snapshot > 5.0:
+            self._last_gcs_snapshot = now0
+            try:
+                self._write_gcs_snapshot()
+            except Exception:
+                logger.exception("gcs snapshot failed")
         # daemon health: a node that missed heartbeats for the timeout window
         # is declared dead (parity: GcsHealthCheckManager,
         # gcs_health_check_manager.h:39)
@@ -1610,6 +1637,46 @@ class Scheduler:
                             node.daemon_conn.send(("delete_object", oid.binary()))
                     except (OSError, EOFError):
                         pass
+
+    def _write_gcs_snapshot(self):
+        """Durable control-plane state: KV, name registry, and the creation
+        specs of detached actors (so a restarted head can restart them).
+        Written atomically into the session dir."""
+        snap = self.gcs.snapshot()
+        detached = []
+        for st in self.actors.values():
+            if (
+                st.detached
+                and st.state not in ("DEAD",)
+                and st.creation_spec is not None
+            ):
+                detached.append(pickle.dumps(st.creation_spec))
+        snap["detached_actor_specs"] = detached
+        path = os.path.join(self._node.session_dir, "gcs_snapshot.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(pickle.dumps(snap))
+        os.replace(tmp, path)
+
+    def restore_gcs_snapshot(self, path: str) -> int:
+        """Load tables from a snapshot and resubmit detached actors.
+
+        The reference's GCS restart keeps live actor processes (workers
+        outlive the GCS); here head-owned workers die with the head, so
+        detached actors are *recreated* (fresh __init__) under their names.
+        Returns the number of actors restarted.
+        """
+        with open(path, "rb") as fh:
+            snap = pickle.loads(fh.read())
+        specs = [pickle.loads(b) for b in snap.pop("detached_actor_specs", [])]
+        # name claims only survive for the detached actors being recreated
+        # (their resubmitted specs re-claim them); names of actors that died
+        # with the previous head must not poison the registry forever
+        snap["named_actors"] = {}
+        self.gcs.load(snap)
+        for spec in specs:
+            self.submit(spec)
+        return len(specs)
 
     def _record_event(self, spec: TaskSpec, state: str):
         self._task_events.append(

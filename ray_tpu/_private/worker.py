@@ -338,6 +338,7 @@ def init(
     log_to_driver: bool = True,
     namespace: Optional[str] = None,
     _system_config: Optional[dict] = None,
+    _restore_from: Optional[str] = None,
 ):
     global _driver
     with _global_lock:
@@ -363,6 +364,13 @@ def init(
             **(_system_config or {}),
         )
         node = Node(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels)
+        if _restore_from:
+            # control-plane restart: rebuild GCS tables + detached actors
+            # from the previous session's snapshot (parity: gcs_init_data.h)
+            snap_path = _restore_from
+            if os.path.isdir(snap_path):
+                snap_path = os.path.join(snap_path, "gcs_snapshot.pkl")
+            node.scheduler.restore_gcs_snapshot(snap_path)
         _driver = DriverRuntime(node)
         return _driver
 

@@ -351,32 +351,27 @@ class WorkerRuntime:
         return out
 
     def _apply_runtime_env(self, spec: TaskSpec):
-        """Minimal runtime_env: env_vars applied around execution (parity:
-        python/ray/_private/runtime_env — the full conda/pip/working_dir
-        machinery is a per-node agent in the reference; env_vars is the
-        process-level slice that applies to pre-spawned workers)."""
-        import os
+        """Apply env_vars + working_dir + py_modules around execution
+        (parity: python/ray/_private/runtime_env; packages are
+        content-addressed zips in the cluster KV, working_dir.py:1)."""
+        from ray_tpu._private import runtime_env as renv
 
-        env = (spec.runtime_env or {}).get("env_vars") or {}
-        saved = {}
-        for k, v in env.items():
-            saved[k] = os.environ.get(k)
-            os.environ[k] = str(v)
-        return saved
+        return renv.apply(self, spec.runtime_env or {})
 
     def _restore_env(self, saved):
-        import os
+        from ray_tpu._private import runtime_env as renv
 
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+        renv.restore(saved)
 
     def execute(self, spec: TaskSpec) -> List[Tuple]:
         self.current_task_id = spec.task_id
-        saved_env = self._apply_runtime_env(spec) if spec.runtime_env else {}
+        saved_env = {}
         try:
+            # inside the try: a runtime_env setup failure (missing package,
+            # bad zip, rpc timeout) must surface as a TaskError, not kill the
+            # worker loop (parity: RuntimeEnvSetupError)
+            if spec.runtime_env:
+                saved_env = self._apply_runtime_env(spec)
             if spec.task_type == TaskType.ACTOR_CREATION:
                 cls = cloudpickle.loads(spec.function)
                 args, kwargs = self._resolve_args(spec)

@@ -15,6 +15,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private.runtime_env import upload_runtime_env as _upload_runtime_env
 from ray_tpu._private.task_spec import SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu._private.worker import ObjectRef, ObjectRefGenerator, get_runtime, pack_args
 from ray_tpu.remote_function import resolve_resources, resolve_strategy
@@ -186,7 +187,7 @@ class ActorClass:
             actor_name=name,
             namespace=namespace,
             scheduling_strategy=resolve_strategy(opts),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_upload_runtime_env(rt, opts.get("runtime_env")),
         )
         rt.submit(spec)
         return ActorHandle(actor_id, self._method_meta(), owned=True)

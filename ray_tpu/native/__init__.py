@@ -83,5 +83,7 @@ def load_native():
     lib.rt_store_base.argtypes = [ctypes.c_void_p]
     lib.rt_store_capacity.restype = ctypes.c_uint64
     lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
+    lib.rt_store_lru_victim.restype = ctypes.c_int
+    lib.rt_store_lru_victim.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)]
     _LIB = lib
     return _LIB

@@ -46,6 +46,7 @@ struct Entry {
   uint32_t pending_delete;
   int32_t owner_pid;  // creator while kCreating (orphan reclaim)
   uint32_t pad_;
+  uint64_t last_access;  // LRU clock value at last seal/get
 };
 
 // free/used block header threaded through the data region
@@ -64,6 +65,7 @@ struct Header {
   uint64_t free_head;      // offset of first free block (0 = none)
   uint64_t used_bytes;     // payload bytes in sealed/creating objects
   uint64_t num_objects;
+  uint64_t access_clock;   // monotonically increasing LRU clock
   pthread_mutex_t mutex;
 };
 
@@ -340,6 +342,7 @@ int rt_store_seal(void* handle, const uint8_t* id) {
   Entry* e = find_slot(s, id, false);
   if (!e || e->state != kCreating) return -1;
   e->state = kSealed;
+  e->last_access = ++s->hdr->access_clock;
   return 0;
 }
 
@@ -350,6 +353,7 @@ uint64_t rt_store_get(void* handle, const uint8_t* id, uint64_t* size) {
   Entry* e = find_slot(s, id, false);
   if (!e || e->state != kSealed) return 0;
   e->pins += 1;
+  e->last_access = ++s->hdr->access_clock;
   *size = e->size;
   return e->offset;
 }
@@ -404,6 +408,25 @@ void* rt_store_base(void* handle) {
 
 uint64_t rt_store_capacity(void* handle) {
   return static_cast<Store*>(handle)->hdr->capacity;
+}
+
+// LRU eviction candidate (parity: plasma EvictionPolicy choosing sealed,
+// unpinned objects; eviction_policy.h): fills out_id and returns 1, or
+// returns 0 when nothing is evictable. The caller spills the object's bytes
+// to secondary storage and then deletes it.
+int rt_store_lru_victim(void* handle, uint8_t* out_id) {
+  Store* s = static_cast<Store*>(handle);
+  LockGuard g(&s->hdr->mutex);
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < s->hdr->table_size; i++) {
+    Entry* c = &s->table[i];
+    if (c->state == kSealed && c->pins == 0 && !c->pending_delete) {
+      if (!victim || c->last_access < victim->last_access) victim = c;
+    }
+  }
+  if (!victim) return 0;
+  memcpy(out_id, victim->id, kIdSize);
+  return 1;
 }
 
 }  // extern "C"

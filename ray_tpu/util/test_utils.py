@@ -1,0 +1,69 @@
+"""Fault-injection utilities for chaos testing.
+
+Parity: ``python/ray/_private/test_utils.py:1500`` — ``ResourceKillerActor``
+(raylet SIGKILL at ``:1549``) and ``WorkerKillerActor`` (``:1597``): actors
+that repeatedly kill cluster components while workloads run, proving the
+retry/restart machinery under concurrent load rather than one-shot tests.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0)
+class WorkerKillerActor:
+    """Periodically SIGKILLs a random busy task worker."""
+
+    def __init__(self, kill_interval_s: float = 0.5, seed: int = 0):
+        self.interval = kill_interval_s
+        self.rng = random.Random(seed)
+        self.killed = 0
+        self._stop = False
+
+    def run(self, duration_s: float = 10.0) -> int:
+        from ray_tpu.util import state as state_api
+
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline and not self._stop:
+            time.sleep(self.interval)
+            try:
+                workers = [
+                    w
+                    for w in state_api.list_workers()
+                    if w["state"] == "busy" and w.get("pid") and w["pid"] != os.getpid()
+                ]
+            except Exception:
+                continue
+            if not workers:
+                continue
+            victim = self.rng.choice(workers)
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                self.killed += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+        return self.killed
+
+    def stop(self):
+        self._stop = True
+        return self.killed
+
+
+@ray_tpu.remote(num_cpus=0)
+class NodeKillerActor:
+    """SIGKILLs node-daemon processes by pid (cluster fixture provides pids).
+
+    Parity: ``NodeKillerBase`` / raylet SIGKILL (test_utils.py:1549)."""
+
+    def kill_pid(self, pid: int) -> bool:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False

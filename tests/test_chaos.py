@@ -1,0 +1,111 @@
+"""Chaos tests: retry/restart machinery under concurrent fault injection.
+
+Parity: the reference's chaos fixtures (``_ray_start_chaos_cluster``,
+``python/ray/tests/conftest.py:900``; killer actors
+``python/ray/_private/test_utils.py:1500``) — components die *while* a
+workload runs, repeatedly, not once.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture
+def chaos_runtime():
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_tasks_survive_continuous_worker_kills(chaos_runtime):
+    """100 retriable tasks all complete while a killer SIGKILLs busy workers
+    every 300ms for the duration."""
+    from ray_tpu.util.test_utils import WorkerKillerActor
+
+    killer = WorkerKillerActor.options(max_concurrency=2).remote(
+        kill_interval_s=0.3, seed=1
+    )
+    kill_run = killer.run.remote(duration_s=12.0)
+
+    @ray_tpu.remote(max_retries=20)
+    def work(i):
+        time.sleep(0.15)
+        return i * i
+
+    refs = [work.remote(i) for i in range(100)]
+    results = ray_tpu.get(refs, timeout=240)
+    assert results == [i * i for i in range(100)]
+    killed = ray_tpu.get(killer.stop.remote(), timeout=60)
+    ray_tpu.get(kill_run, timeout=60)
+    assert killed >= 1, "the killer never actually killed a worker"
+
+
+def test_actor_restart_under_fire(chaos_runtime):
+    """A restartable actor keeps serving (with task retries) while its worker
+    is killed several times mid-stream."""
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+    class Survivor:
+        def __init__(self):
+            self.calls = 0
+
+        def work(self):
+            self.calls += 1
+            time.sleep(0.05)
+            return "ok"
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    s = Survivor.remote()
+    assert ray_tpu.get(s.work.remote(), timeout=60) == "ok"
+    import os
+    import signal
+
+    kills = 0
+    deadline = time.monotonic() + 30
+    while kills < 3 and time.monotonic() < deadline:
+        pid = ray_tpu.get(s.pid.remote(), timeout=60)
+        # fire a batch of calls, kill mid-flight
+        refs = [s.work.remote() for _ in range(10)]
+        try:
+            os.kill(pid, signal.SIGKILL)
+            kills += 1
+        except ProcessLookupError:
+            pass
+        assert ray_tpu.get(refs, timeout=120) == ["ok"] * 10
+    assert kills == 3
+    assert ray_tpu.get(s.work.remote(), timeout=60) == "ok"
+
+
+def test_many_processes_hammer_native_store(chaos_runtime):
+    """The shared-memory arena's robust mutex + orphan reclaim hold up under
+    concurrent multi-process puts/gets with worker kills mixed in."""
+    import numpy as np
+
+    from ray_tpu.util.test_utils import WorkerKillerActor
+
+    killer = WorkerKillerActor.options(max_concurrency=2).remote(
+        kill_interval_s=0.5, seed=2
+    )
+    kill_run = killer.run.remote(duration_s=8.0)
+
+    @ray_tpu.remote(max_retries=20)
+    def churn(i):
+        arr = np.full(120_000, float(i))  # large: goes through the shm arena
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref, timeout=60)
+        return float(out.sum())
+
+    refs = [churn.remote(i) for i in range(60)]
+    results = ray_tpu.get(refs, timeout=240)
+    assert results == [120_000.0 * i for i in range(60)]
+    ray_tpu.get(killer.stop.remote(), timeout=60)
+    ray_tpu.get(kill_run, timeout=60)

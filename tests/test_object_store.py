@@ -125,3 +125,37 @@ def test_fragmentation_coalescing(store):
     big = ObjectID.from_random()
     store.put_bytes(big, b"L" * 2_000_000)  # needs coalesced space in arena
     assert store.contains(big)
+
+
+def test_native_store_lru_eviction(tmp_path):
+    """When the arena fills, sealed+unpinned objects evict LRU-first instead
+    of failing the create (parity: plasma EvictionPolicy)."""
+    from ray_tpu._private.ids import JobID, ObjectID, TaskID
+    from ray_tpu._private.native_store import NativeStoreClient, create_store_client
+
+    store = create_store_client(
+        str(tmp_path / "shm"), str(tmp_path / "spill"), 8 * 1024 * 1024
+    )
+    if not isinstance(store, NativeStoreClient):
+        import pytest
+
+        pytest.skip("native store unavailable")
+    tid = TaskID.for_driver(JobID.from_int(7))
+    oids = [ObjectID.for_put(tid, i) for i in range(10)]
+    blob = bytes(1024 * 1024)  # 1 MiB each into an ~8 MiB arena
+    for i, oid in enumerate(oids):
+        store.put_bytes(oid, blob)  # later puts evict-to-disk the oldest
+    # every object remains readable: evicted ones were spilled to the file
+    # store first (plasma eviction + LocalObjectManager spilling)
+    for oid in oids:
+        mv = store.get(oid, timeout=5)
+        assert mv is not None and mv.nbytes == len(blob)
+        store.release(oid)
+    # pinned objects are not evictable: pin one, then fill again
+    mv = store.get(oids[-1], timeout=1)
+    assert mv is not None
+    for i in range(10, 18):
+        store.put_bytes(ObjectID.for_put(tid, i), blob)
+    assert store.contains(oids[-1])  # survived: it was pinned
+    store.release(oids[-1])
+    store.close()
