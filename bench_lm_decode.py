@@ -1,0 +1,92 @@
+"""LM decode throughput on the attached chip: the Serve north star's shape.
+
+BASELINE.json's serving target is Llama-2-7B batched replicas on v5e.
+This measures the in-tree KV-cache decode path (``models/generation.py``)
+at the Llama-2-7B geometry (d_model 4096, 32 layers, 32 heads, d_ff 11008,
+bf16) with a batch of concurrent sequences per replica.
+
+Prints one JSON line: decode tokens/sec (batch-aggregate) + per-sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.generation import init_kv_cache, make_decode_fns
+    from ray_tpu.models.transformer import TransformerConfig, init_params
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        # Llama-2-7B geometry; weights bf16 (~13.5 GB) + cache fit 16G HBM
+        cfg = TransformerConfig(
+            vocab_size=32000,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            d_ff=11008,
+            max_seq_len=1024,
+            remat=False,
+        )
+        batch, prompt_len, max_len, steps = 4, 128, 512, 64
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            d_ff=256, max_seq_len=128, remat=False,
+        )
+        batch, prompt_len, max_len, steps = 2, 8, 64, 8
+
+    # jit the init: XLA frees the fp32 sampling intermediates instead of
+    # holding a transient fp32 copy of every bf16 tensor (OOM at 7B)
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+    prefill, decode_step = make_decode_fns(cfg, max_len)
+    cache = init_kv_cache(cfg, batch, max_len)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size - 1, (batch, prompt_len), dtype=np.int32)
+
+    t0 = time.perf_counter()
+    logits, cache = prefill(params, jnp.asarray(prompt), cache)
+    tok = jnp.argmax(logits, axis=-1)
+    float(jax.device_get(logits[0, 0]))  # sync
+    prefill_s = time.perf_counter() - t0
+
+    # warm decode compile
+    logits, cache = decode_step(params, tok[:, None], cache)
+    tok = jnp.argmax(logits, axis=-1)
+    float(jax.device_get(logits[0, 0]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        logits, cache = decode_step(params, tok[:, None], cache)
+        tok = jnp.argmax(logits, axis=-1)
+    float(jax.device_get(logits[0, 0]))  # force real completion (tunnel)
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "llama2_7b_shape_decode_tokens_per_sec",
+                "value": round(tok_s, 1),
+                "unit": "tokens/s",
+                "detail": {
+                    "backend": backend,
+                    "batch": batch,
+                    "per_seq_tokens_per_sec": round(steps / dt, 2),
+                    "decode_step_ms": round(1000 * dt / steps, 2),
+                    "prefill_s_128tok": round(prefill_s, 2),
+                    "n_params": cfg.num_params(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

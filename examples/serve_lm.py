@@ -34,6 +34,28 @@ class LMServer:
         next_tokens = jnp.argmax(logits[:, -1], axis=-1)
         return [{"next_token": int(t)} for t in np.asarray(next_tokens)]
 
+    def generate(self, tokens, max_new_tokens: int = 16):
+        """Autoregressive completion on the KV-cache decode path
+        (``models/generation.py``; bench: ``bench_lm_decode.py``)."""
+        from ray_tpu.models.generation import generate, make_decode_fns
+
+        # cache the jitted (prefill, decode_step) pair per shape — without
+        # this every request would recompile the decode graphs
+        key = (1, len(tokens) + max_new_tokens)
+        fns_cache = getattr(self, "_fns", None)
+        if fns_cache is None:
+            fns_cache = self._fns = {}
+        if key not in fns_cache:
+            fns_cache[key] = make_decode_fns(self.cfg, key[1])
+        out = generate(
+            self.params,
+            np.asarray([tokens], np.int32),
+            self.cfg,
+            max_new_tokens=max_new_tokens,
+            fns=fns_cache[key],
+        )
+        return {"tokens": np.asarray(out)[0].tolist()}
+
 
 def main():
     ray_tpu.init(ignore_reinit_error=True)

@@ -1,0 +1,182 @@
+"""Autoregressive decoding with a KV cache.
+
+The inference half of the model family: prefill + single-token decode steps
+over a static-shape cache, jit-compiled once (cache donated between steps so
+decode is in-place on device). The reference serves LLMs by delegating to
+external engines on top of Serve; here the decode path is in-tree and
+TPU-native: static shapes for XLA, masked attention over the cache instead
+of data-dependent slicing, bf16 weights with fp32 logits.
+
+Layout: cache k/v are (L, B, max_len, kv_heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.ops.layers import apply_rope, gelu, rms_norm, rope_frequencies, swiglu
+
+_NEG_INF = -1e30
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _stacked(params):
+    return {
+        k: v
+        for k, v in params.items()
+        if k not in ("embed", "unembed", "final_norm")
+    }
+
+
+def _mlp(cfg, layer, m):
+    if cfg.use_swiglu:
+        ff = swiglu(
+            jnp.einsum("bsd,df->bsf", m, layer["w_gate"]),
+            jnp.einsum("bsd,df->bsf", m, layer["w_up"]),
+        )
+    else:
+        ff = gelu(jnp.einsum("bsd,df->bsf", m, layer["w_up"]))
+    return jnp.einsum("bsf,fd->bsd", ff, layer["w_down"])
+
+
+def _cached_attention(q, ck, cv, cache_positions, q_positions):
+    """q (B,S,H,Hd) against the full cache (B,M,KV,Hd), masked to entries at
+    cache_positions <= q_positions (causal over absolute positions) and
+    cache_positions < written length."""
+    n_rep = q.shape[2] // ck.shape[2]
+    if n_rep > 1:
+        b, m, kv, d = ck.shape
+        ck = jnp.broadcast_to(ck[:, :, :, None, :], (b, m, kv, n_rep, d)).reshape(
+            b, m, kv * n_rep, d
+        )
+        cv = jnp.broadcast_to(cv[:, :, :, None, :], (b, m, kv, n_rep, d)).reshape(
+            b, m, kv * n_rep, d
+        )
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    mask = cache_positions[None, :] <= q_positions[:, None]  # (S, M)
+    scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def _forward_cached(params, tokens, positions, cache, cfg: TransformerConfig):
+    """Run the model over ``tokens`` (B,S) at absolute ``positions`` (S,),
+    reading+writing the KV cache. Returns (logits (B,S,V), cache)."""
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    max_len = cache["k"].shape[2]
+    cache_positions = jnp.arange(max_len)
+    start = cache["pos"]
+
+    def body(carry, layer_inputs):
+        x = carry
+        layer, ck, cv = layer_inputs
+        h = rms_norm(x, layer["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        # write this step's k/v into the cache at [start, start+S)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+        att = _cached_attention(q, ck, cv, cache_positions, positions)
+        att_out = jnp.einsum("bshk,hkd->bsd", att, layer["wo"])
+        if cfg.parallel_block:
+            m = h
+            x_out = x + att_out + _mlp(cfg, layer, m)
+        else:
+            x1 = x + att_out
+            m = rms_norm(x1, layer["mlp_norm"])
+            x_out = x1 + _mlp(cfg, layer, m)
+        return x_out, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (_stacked(params), cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    unembed = params.get("unembed")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, unembed).astype(jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "pos": start + tokens.shape[1]}
+    return logits, new_cache
+
+
+def make_decode_fns(cfg: TransformerConfig, max_len: int):
+    """Returns (prefill, decode_step), both jitted with donated caches.
+
+    prefill(params, tokens, cache) -> (last_logits (B,V), cache)
+    decode_step(params, token (B,1), cache) -> (logits (B,V), cache)
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, tokens, cache):
+        positions = jnp.arange(tokens.shape[1])
+        logits, cache = _forward_cached(params, tokens, positions, cache, cfg)
+        return logits[:, -1, :], cache
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def decode_step(params, token, cache):
+        positions = cache["pos"][None]
+        logits, cache = _forward_cached(params, token, positions, cache, cfg)
+        return logits[:, -1, :], cache
+
+    return prefill, decode_step
+
+
+def generate(
+    params,
+    prompt_tokens,
+    cfg: TransformerConfig,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    fns: Optional[Tuple] = None,
+) -> jnp.ndarray:
+    """Greedy (temperature 0) or sampled decoding; returns (B, new) tokens."""
+    import numpy as np
+
+    prompt_tokens = jnp.asarray(prompt_tokens)
+    if prompt_tokens.ndim == 1:
+        prompt_tokens = prompt_tokens[None, :]
+    b, s = prompt_tokens.shape
+    max_len = s + max_new_tokens
+    if max_len > cfg.max_seq_len:
+        # the rope tables are sized to max_seq_len; jit's clamped gathers
+        # would silently reuse the last position's rotary embedding
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({cfg.max_seq_len})"
+        )
+    prefill, decode_step = fns or make_decode_fns(cfg, max_len)
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt_tokens, cache)
+    out = []
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    for _ in range(max_new_tokens):
+        if temperature and temperature > 0:
+            key, sub = jax.random.split(key)
+            tok = jax.random.categorical(sub, logits / temperature)
+        else:
+            tok = jnp.argmax(logits, axis=-1)
+        out.append(tok)
+        logits, cache = decode_step(params, tok[:, None], cache)
+    return jnp.stack(out, axis=1)

@@ -1,0 +1,183 @@
+"""TorchTrainer: data-parallel PyTorch training on the worker group.
+
+Parity: ``TorchTrainer`` (``python/ray/train/torch/torch_trainer.py``) and
+its backend (``python/ray/train/torch/config.py:65`` —
+``_setup_torch_process_group``: worker 0 publishes addr/port, every worker
+joins the process group; ``:150`` ``_TorchBackend``). The rendezvous rides
+this framework's cluster KV instead of a raw TCP store bootstrap; the
+process group uses gloo (CPU) — CUDA/NCCL has no seat on a TPU cluster, and
+torch models on TPU hosts run CPU-side feeding JAX, or pure-CPU workloads.
+
+``prepare_model`` / ``prepare_data_loader`` mirror
+``python/ray/train/torch/train_loop_utils.py``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import RunConfig, ScalingConfig
+from ray_tpu.train.jax_trainer import JaxTrainer
+
+
+def _node_ip() -> str:
+    """This node's address as reachable by peers (loopback only as a last
+    resort — workers may be on different node daemons)."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks a route
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
+def _setup_torch_process_group(rendezvous_key: str):
+    """Join the gloo process group; rank 0 publishes the store address."""
+    import socket
+
+    import torch.distributed as dist
+
+    from ray_tpu._private.worker import get_runtime
+    from ray_tpu.train._session import get_context
+
+    ctx = get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    if world <= 1:
+        return False
+    rt = get_runtime()
+    if rank == 0:
+        host = _node_ip()
+        s = socket.socket()
+        s.bind(("0.0.0.0", 0))
+        port = s.getsockname()[1]
+        s.close()
+        addr = f"tcp://{host}:{port}"
+        rt.rpc("kv_put", "torch_rendezvous", rendezvous_key.encode(), addr.encode(), True)
+        dist.init_process_group(
+            backend="gloo", init_method=addr, rank=rank, world_size=world
+        )
+        return True
+    # non-zero ranks: the key may briefly hold a previous (failed) attempt's
+    # address — retry with a fresh read if joining fails
+    last_err = None
+    for _ in range(3):
+        deadline = time.monotonic() + 60
+        addr = None
+        while time.monotonic() < deadline:
+            raw = rt.rpc("kv_get", "torch_rendezvous", rendezvous_key.encode())
+            if raw:
+                addr = raw.decode()
+                break
+            time.sleep(0.05)
+        if addr is None:
+            raise RuntimeError("torch rendezvous timed out")
+        try:
+            dist.init_process_group(
+                backend="gloo",
+                init_method=addr,
+                rank=rank,
+                world_size=world,
+                timeout=__import__("datetime").timedelta(seconds=60),
+            )
+            return True
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(1.0)
+    raise RuntimeError(f"could not join torch process group: {last_err}")
+
+
+def prepare_model(model):
+    """Wrap in DDP when the group is initialized (parity:
+    ``train.torch.prepare_model``, ``train_loop_utils.py``)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across the group with a DistributedSampler,
+    preserving the source loader's ordering and settings."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    shuffle = isinstance(getattr(data_loader, "sampler", None), RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        pin_memory=data_loader.pin_memory,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )
+
+
+class TorchTrainer(JaxTrainer):
+    """Same fit machinery (worker group in a PG, report/checkpoint plumbing);
+    the train loop is wrapped with the gloo process-group lifecycle."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        key = f"tt_{uuid.uuid4().hex[:12]}"
+        user_fn = train_loop_per_worker
+
+        def wrapped(config=None):
+            import inspect
+
+            joined = _setup_torch_process_group(key)
+            try:
+                if config is not None and len(inspect.signature(user_fn).parameters):
+                    return user_fn(config)
+                return user_fn()
+            finally:
+                if joined:
+                    import torch.distributed as dist
+
+                    dist.destroy_process_group()
+                    from ray_tpu.train._session import get_context
+
+                    if get_context().get_world_rank() == 0:
+                        # drop the published address so a failure-retry never
+                        # reads a dead store's endpoint
+                        try:
+                            from ray_tpu._private.worker import get_runtime
+
+                            get_runtime().rpc(
+                                "kv_del", "torch_rendezvous", key.encode()
+                            )
+                        except Exception:
+                            pass
+
+        super().__init__(
+            wrapped,
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )

@@ -114,3 +114,26 @@ def test_mnist_cnn_shapes():
     x = jnp.ones((2, 28, 28, 1))
     logits = apply_cnn(params, x)
     assert logits.shape == (2, 10)
+
+
+def test_kv_cache_generation_matches_full_forward(cpu_mesh_devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.generation import generate
+    from ray_tpu.models.transformer import TransformerConfig, forward, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=64, remat=False, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    prompt = np.array([[5, 9, 3, 7, 2], [1, 2, 3, 4, 6]], dtype=np.int32)
+    toks = np.asarray(generate(params, prompt, cfg, max_new_tokens=5))
+    cur = prompt
+    for step in range(5):
+        logits = forward(params, jnp.asarray(cur), cfg)
+        nxt = np.argmax(np.asarray(logits[:, -1, :], dtype=np.float32), axis=-1)
+        assert (toks[:, step] == nxt).all(), f"divergence at step {step}"
+        cur = np.concatenate([cur, nxt[:, None].astype(np.int32)], axis=1)

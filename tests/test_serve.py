@@ -238,3 +238,21 @@ def test_autoscaling_up_and_down(serve_cluster):
             break
         time.sleep(0.5)
     assert shrank, "deployment never scaled back in"
+
+
+def test_lm_generation_deployment(serve_cluster):
+    """KV-cache generation behind a Serve deployment (examples/serve_lm.py)."""
+    import os
+    import sys
+
+    examples_dir = os.path.join(os.path.dirname(__file__), "..", "examples")
+    sys.path.insert(0, examples_dir)
+    try:
+        from serve_lm import LMServer
+    finally:
+        sys.path.pop(0)
+
+    handle = serve.run(LMServer.bind(), name="lm_gen")
+    out = handle.generate.remote([1, 2, 3, 4], max_new_tokens=4).result(timeout_s=120)
+    assert len(out["tokens"]) == 4
+    assert all(isinstance(t, int) for t in out["tokens"])

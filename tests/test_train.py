@@ -137,3 +137,57 @@ def test_gang_schedule_too_big_fails_fast(ray_start_regular, tmp_path):
     )
     result = trainer.fit()
     assert result.error is not None
+
+
+def test_torch_trainer_ddp_gloo(ray_start_regular, tmp_path):
+    """2-worker TorchTrainer: gloo process group via the KV rendezvous, DDP
+    grad sync proven by rank-identical weights after divergent data."""
+    from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+    def train_fn():
+        import numpy as np
+        import torch
+        import torch.nn as nn
+
+        from ray_tpu.train import get_context, prepare_model, report
+
+        ctx = get_context()
+        rank = ctx.get_world_rank()
+        torch.manual_seed(0)  # same init on both ranks
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        rng = np.random.default_rng(rank)  # DIFFERENT data per rank
+        w_true = np.array([1.0, -2.0, 3.0, 0.5], dtype=np.float32)
+        losses = []
+        for step in range(30):
+            x = torch.tensor(rng.normal(size=(16, 4)).astype(np.float32))
+            y = x @ torch.tensor(w_true)[:, None]
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()  # DDP averages grads across ranks here
+            opt.step()
+            losses.append(float(loss))
+        params = torch.nn.utils.parameters_to_vector(model.parameters()).detach()
+        # prove DDP actually synchronized: every rank must hold identical
+        # weights despite training on different data
+        import torch.distributed as dist
+
+        assert dist.is_initialized() and dist.get_world_size() == 2
+        pmax = params.clone(); dist.all_reduce(pmax, op=dist.ReduceOp.MAX)
+        pmin = params.clone(); dist.all_reduce(pmin, op=dist.ReduceOp.MIN)
+        assert torch.allclose(pmax, pmin, atol=1e-6), "ranks diverged: DDP broken"
+        report(
+            {
+                "final_loss": losses[-1],
+                "first_loss": losses[0],
+                "param_sum": float(params.sum()),
+            }
+        )
+
+    result = TorchTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="tt"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["final_loss"] < result.metrics["first_loss"] * 0.2
