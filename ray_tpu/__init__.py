@@ -101,9 +101,16 @@ def get(
     rt = get_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get_objects([refs.id()], timeout=timeout)[0]
+    from ray_tpu.dag import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        # parity: ray.get accepts compiled-DAG result refs
+        return refs.get(timeout)
     if isinstance(refs, (list, tuple)):
         if not refs:
             return []
+        if all(isinstance(r, CompiledDAGRef) for r in refs):
+            return [r.get(timeout) for r in refs]
         if not all(isinstance(r, ObjectRef) for r in refs):
             raise TypeError("get() accepts an ObjectRef or a list of ObjectRefs")
         return rt.get_objects([r.id() for r in refs], timeout=timeout)

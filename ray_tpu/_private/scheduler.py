@@ -338,6 +338,12 @@ class Scheduler:
         # name-claimed actors whose creation spec has not arrived yet:
         # actor_id -> deadline for the spec to land
         self._placeholder_deadlines: Dict[ActorID, float] = {}
+        # handler instrumentation (parity: event_stats.h /
+        # instrumented_io_context): per-handler count + cumulative seconds
+        self._event_stats: Dict[str, List[float]] = collections.defaultdict(
+            lambda: [0, 0.0]
+        )
+        self._event_stats_last_print = time.monotonic()
         # ---- multi-host plane (daemon-backed nodes) ----
         # daemon socket -> node id (the socket is in the wait set)
         self._daemon_conns: Dict[Any, NodeID] = {}
@@ -402,11 +408,36 @@ class Scheduler:
                 except queue.Empty:
                     break
                 try:
+                    t0 = time.perf_counter()
                     self._handle_cmd(cmd)
+                    stat = self._event_stats[f"cmd.{cmd[0]}"]
+                    stat[0] += 1
+                    stat[1] += time.perf_counter() - t0
                 except Exception:
                     logger.exception("scheduler command failed: %r", cmd[0])
             self._schedule()
+            self._maybe_print_event_stats()
         self._shutdown_workers()
+
+    def _maybe_print_event_stats(self):
+        interval = self.config.event_stats_print_interval_ms
+        if not interval:
+            return
+        now = time.monotonic()
+        if (now - self._event_stats_last_print) * 1000 < interval:
+            return
+        self._event_stats_last_print = now
+        rows = sorted(
+            self._event_stats.items(), key=lambda kv: kv[1][1], reverse=True
+        )[:15]
+        logger.info(
+            "event stats (count, total_ms, mean_us): %s",
+            {
+                k: (int(c), round(t * 1e3, 1), round(t / c * 1e6, 1))
+                for k, (c, t) in rows
+                if c
+            },
+        )
 
     def _drain_worker(self, conn):
         wid = self._conn_to_worker.get(conn)
@@ -415,7 +446,11 @@ class Scheduler:
         try:
             while conn.poll(0):
                 msg = conn.recv()
+                t0 = time.perf_counter()
                 self._handle_worker_msg(wid, msg)
+                stat = self._event_stats[f"worker.{msg[0]}"]
+                stat[0] += 1
+                stat[1] += time.perf_counter() - t0
         except (EOFError, OSError, pickle.UnpicklingError):
             self._on_worker_death(wid)
 
@@ -1616,6 +1651,12 @@ class Scheduler:
             return False
         if op == "object_locations":
             return [n.hex() for n in self._object_locations.get(args[0], set())]
+        if op == "event_stats":
+            # parity: event_stats.h handler instrumentation
+            return {
+                k: {"count": int(c), "total_s": t, "mean_us": (t / c * 1e6 if c else 0.0)}
+                for k, (c, t) in self._event_stats.items()
+            }
         raise ValueError(f"unknown rpc {op}")
 
     # ---- misc ------------------------------------------------------------

@@ -24,7 +24,16 @@ class DAGNode:
     def execute(self, *input_args, **input_kwargs):
         return _execute(self, input_args, input_kwargs, {})
 
-    def experimental_compile(self) -> "CompiledDAG":
+    def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024):
+        """Compile for repeated execution. Linear actor pipelines lower to
+        mutable shared-memory channels — each stage runs a resident loop
+        reading its input channel and writing the next, with no per-hop RPC
+        or store allocation (the aDAG fast path,
+        ``compiled_dag_node.py:391`` + ``shared_memory_channel.py:88``).
+        Non-linear graphs keep the pre-planned actor-call path."""
+        chain = _linear_actor_chain(self)
+        if chain is not None:
+            return ChannelCompiledDAG(chain, buffer_size_bytes)
         return CompiledDAG(self)
 
 
@@ -165,6 +174,186 @@ class CompiledDAG:
             try:
                 ray_tpu.kill(handle)
             except Exception:
+                pass
+
+
+def _linear_actor_chain(output: DAGNode):
+    """Detect InputNode -> m1(actor1) -> m2(actor2) -> ... chains.
+
+    Returns [(class_node, method_name), ...] outermost-last, or None."""
+    stages = []
+    node = output
+    while isinstance(node, BoundClassMethodNode):
+        dag_args = [a for a in node.args if isinstance(a, DAGNode)]
+        if len(node.args) != 1 or len(dag_args) != 1 or node.kwargs:
+            return None
+        stages.append((node.class_node, node.method))
+        node = node.args[0]
+    if not isinstance(node, InputNode) or not stages:
+        return None
+    # a ClassNode appearing in several stages must share ONE instance
+    # (interpreted-execute semantics); the channel lowering spawns one
+    # resident actor per stage, so bail to the actor-call path instead
+    if len({id(cn) for cn, _ in stages}) != len(stages):
+        return None
+    return list(reversed(stages))
+
+
+@ray_tpu.remote
+class _PipelineStage:
+    """Resident compiled-DAG stage: constructs the user class once, then
+    loops channel-read -> method -> channel-write until the input closes."""
+
+    def __init__(self, cls_blob: bytes, args, kwargs):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self._inst = cls(*args, **kwargs)
+
+    def run_loop(self, in_path, out_path, method, capacity):
+        from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+        in_ch = Channel(in_path, capacity)
+        out_ch = Channel(out_path, capacity)
+        fn = getattr(self._inst, method)
+        while True:
+            try:
+                x = in_ch.read(timeout=None)
+            except ChannelClosedError:
+                out_ch.close()
+                return
+            if isinstance(x, _DagError):
+                payload = x  # upstream failure: forward it downstream
+            else:
+                try:
+                    payload = fn(x)
+                except Exception as e:  # noqa: BLE001
+                    import traceback
+
+                    payload = _DagError(f"{e!r}\n{traceback.format_exc()}")
+            try:
+                # block until the reader consumes — a slow consumer must
+                # backpressure the pipeline, not kill the resident loop
+                out_ch.write(payload, timeout=None)
+            except ChannelClosedError:
+                return
+
+
+class _DagError:
+    """Stage failure riding the channel to the caller (parity: compiled DAGs
+    propagate exceptions through the channel)."""
+
+    def __init__(self, message: str):
+        self.message = message
+
+
+class CompiledDAGRef:
+    """Result handle of one compiled execution (parity: ``CompiledDAGRef``).
+
+    Results are delivered in execution order on one channel; the owning DAG
+    buffers out-of-order consumption so each ref gets ITS execution's value."""
+
+    def __init__(self, dag: "ChannelCompiledDAG", seq: int, timeout: float):
+        self._dag = dag
+        self._seq = seq
+        self._timeout = timeout
+
+    def get(self, timeout: Optional[float] = None):
+        value = self._dag._result_for(
+            self._seq, self._timeout if timeout is None else timeout
+        )
+        if isinstance(value, _DagError):
+            raise RuntimeError(f"compiled DAG stage failed: {value.message}")
+        return value
+
+
+class ChannelCompiledDAG:
+    """Linear actor pipeline lowered onto mutable shm channels."""
+
+    def __init__(self, stages, capacity: int):
+        import os
+        import uuid
+
+        import cloudpickle
+
+        from ray_tpu._private.worker import get_driver
+        from ray_tpu.experimental.channel import Channel
+
+        drv = get_driver()
+        base = (
+            os.path.join(drv.node.shm_dir, "channels")
+            if drv is not None and hasattr(drv, "node")
+            else "/tmp/ray_tpu_channels"
+        )
+        tag = uuid.uuid4().hex[:8]
+        n = len(stages)
+        self._paths = [os.path.join(base, f"{tag}_{i}") for i in range(n + 1)]
+        self._channels = [Channel(p, capacity, create=True) for p in self._paths]
+        self._actors = []
+        self._loops = []
+        for i, (class_node, method) in enumerate(stages):
+            args = [a for a in class_node.args if not isinstance(a, DAGNode)]
+            kwargs = {
+                k: v for k, v in class_node.kwargs.items() if not isinstance(v, DAGNode)
+            }
+            blob = cloudpickle.dumps(class_node.actor_cls._cls)
+            actor = _PipelineStage.remote(blob, args, kwargs)
+            self._actors.append(actor)
+            self._loops.append(
+                actor.run_loop.remote(
+                    self._paths[i], self._paths[i + 1], method, capacity
+                )
+            )
+        self._closed = False
+        self._next_seq = 0
+        self._next_read = 0
+        self._buffered: Dict[int, Any] = {}
+
+    def execute(self, value, timeout: float = 60.0) -> CompiledDAGRef:
+        if self._closed:
+            raise RuntimeError("compiled DAG is torn down")
+        self._channels[0].write(value)
+        ref = CompiledDAGRef(self, self._next_seq, timeout)
+        self._next_seq += 1
+        return ref
+
+    def _result_for(self, seq: int, timeout: float):
+        """Read results in FIFO channel order, buffering others, until this
+        execution's value arrives."""
+        if seq in self._buffered:
+            return self._buffered.pop(seq)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while self._next_read <= seq:
+            remaining = max(0.0, deadline - _time.monotonic())
+            value = self._channels[-1].read(timeout=remaining)
+            got = self._next_read
+            self._next_read += 1
+            if got == seq:
+                return value
+            self._buffered[got] = value
+        return self._buffered.pop(seq)
+
+    def teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for ch in self._channels:
+            ch.close()
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        for ch in self._channels:
+            ch.release()
+        import os
+
+        for p in self._paths:
+            try:
+                os.unlink(p)
+            except OSError:
                 pass
 
 

@@ -48,3 +48,25 @@ class DataIterator:
                     arr = arr.astype(dtypes[k])
                 out[k] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
             yield out
+
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (parity: ``iter_torch_batches``)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(np.asarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out

@@ -1,0 +1,5 @@
+"""Experimental subsystems (parity: ``python/ray/experimental``)."""
+
+from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+__all__ = ["Channel", "ChannelClosedError"]

@@ -1,0 +1,129 @@
+"""Mutable shared-memory channels: the compiled-DAG data plane.
+
+Parity: the reference's mutable plasma objects + shm channels
+(``src/ray/core_worker/experimental_mutable_object_manager.h``,
+``python/ray/experimental/channel/shared_memory_channel.py:88``): a
+fixed-capacity buffer written in place per execution instead of allocating a
+new immutable object per call — the lock-free fast path that lets a compiled
+actor pipeline run without per-hop RPC or store allocation.
+
+Implementation: one mmap'd file per channel in the session's shm dir with a
+seqlock header — writer bumps ``version`` to odd, copies the payload, bumps
+to even; readers wait for a fresh even version and then validate it was
+stable across their copy. Readers track the last version consumed so each
+``read`` returns a *new* write (reference semantics: one read per write per
+reader). Channels are intra-node (the reference forwards cross-node via
+gRPC; here cross-node DAG edges fall back to the object store path).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from typing import Any, Optional
+
+from ray_tpu._private import serialization
+
+_HDR = struct.Struct("<QQQQ")  # version, payload_len, closed, consumed_version
+_CLOSED = 1
+
+
+class ChannelClosedError(Exception):
+    pass
+
+
+class Channel:
+    """Single-writer multi-reader mutable channel."""
+
+    def __init__(self, path: str, capacity: int = 4 * 1024 * 1024, create: bool = False):
+        self.path = path
+        self.capacity = capacity
+        total = _HDR.size + capacity
+        if create:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, total)
+            finally:
+                pass
+        else:
+            fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._mm)
+        self._serde = serialization.get_context()
+        self._last_read_version = 0
+
+    # -- writer ------------------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = 60.0) -> None:
+        """Acquire-release, one slot: blocks until the single reader has
+        consumed the previous write (reference mutable-object semantics —
+        the writer never overruns the reader)."""
+        blob = self._serde.serialize_to_bytes(value)
+        if len(blob) > self.capacity:
+            raise ValueError(
+                f"value ({len(blob)} bytes) exceeds channel capacity "
+                f"({self.capacity}); recreate the channel larger"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.000_05
+        while True:
+            version, _, closed, consumed = _HDR.unpack_from(self._mv, 0)
+            if closed:
+                raise ChannelClosedError(self.path)
+            if consumed >= version:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(f"channel write timed out ({self.path})")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.002)
+        # seqlock: odd = write in progress
+        _HDR.pack_into(self._mv, 0, version + 1, len(blob), 0, consumed)
+        self._mv[_HDR.size : _HDR.size + len(blob)] = blob
+        _HDR.pack_into(self._mv, 0, version + 2, len(blob), 0, consumed)
+
+    # -- reader ------------------------------------------------------------
+
+    def read(self, timeout: Optional[float] = 10.0) -> Any:
+        """Block until a write newer than the last one read; returns value
+        and releases the slot back to the writer."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.000_05
+        while True:
+            version, length, closed, consumed = _HDR.unpack_from(self._mv, 0)
+            if closed:
+                raise ChannelClosedError(self.path)
+            if version % 2 == 0 and version > self._last_read_version:
+                payload = bytes(self._mv[_HDR.size : _HDR.size + length])
+                v2, _, _, _ = _HDR.unpack_from(self._mv, 0)
+                if v2 == version:  # stable across the copy
+                    self._last_read_version = version
+                    # release the slot (single-reader ack)
+                    _HDR.pack_into(self._mv, 0, version, length, 0, version)
+                    return self._serde.deserialize_from(memoryview(payload))
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(f"channel read timed out ({self.path})")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.002)
+
+    def close(self) -> None:
+        try:
+            version, length, _, consumed = _HDR.unpack_from(self._mv, 0)
+            _HDR.pack_into(self._mv, 0, version, length, _CLOSED, consumed)
+        except (ValueError, OSError):
+            pass
+
+    def release(self) -> None:
+        try:
+            self._mv.release()
+            self._mm.close()
+        except (BufferError, OSError):
+            pass
+
+    def __reduce__(self):
+        return (Channel, (self.path, self.capacity, False))

@@ -171,12 +171,13 @@ def generate(
     out = []
     if key is None:
         key = jax.random.PRNGKey(0)
-    for _ in range(max_new_tokens):
+    for i in range(max_new_tokens):
         if temperature and temperature > 0:
             key, sub = jax.random.split(key)
             tok = jax.random.categorical(sub, logits / temperature)
         else:
             tok = jnp.argmax(logits, axis=-1)
         out.append(tok)
-        logits, cache = decode_step(params, tok[:, None], cache)
+        if i + 1 < max_new_tokens:  # the last token needs no further logits
+            logits, cache = decode_step(params, tok[:, None], cache)
     return jnp.stack(out, axis=1)

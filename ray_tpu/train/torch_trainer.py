@@ -64,7 +64,13 @@ def _setup_torch_process_group(rendezvous_key: str):
         addr = f"tcp://{host}:{port}"
         rt.rpc("kv_put", "torch_rendezvous", rendezvous_key.encode(), addr.encode(), True)
         dist.init_process_group(
-            backend="gloo", init_method=addr, rank=rank, world_size=world
+            backend="gloo",
+            init_method=addr,
+            rank=rank,
+            world_size=world,
+            # bounded: a peer dying pre-join must not stall rank 0 for
+            # gloo's 30-minute default
+            timeout=__import__("datetime").timedelta(seconds=120),
         )
         return True
     # non-zero ranks: the key may briefly hold a previous (failed) attempt's
@@ -118,7 +124,7 @@ def prepare_data_loader(data_loader):
         return data_loader
     shuffle = isinstance(getattr(data_loader, "sampler", None), RandomSampler)
     sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
-    return DataLoader(
+    loader = DataLoader(
         data_loader.dataset,
         batch_size=data_loader.batch_size,
         sampler=sampler,
@@ -127,6 +133,29 @@ def prepare_data_loader(data_loader):
         collate_fn=data_loader.collate_fn,
         drop_last=data_loader.drop_last,
     )
+    return _EpochAdvancingLoader(loader, sampler)
+
+
+class _EpochAdvancingLoader:
+    """Advances the DistributedSampler epoch per iteration so shuffled
+    loaders reshuffle each epoch (the reference's prepare_data_loader does
+    this inside its iterator wrapper)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
 
 
 class TorchTrainer(JaxTrainer):

@@ -285,3 +285,28 @@ def test_streaming_window_bounds_inflight(ray_start_regular):
         assert max_running <= 4, max_running
     finally:
         DataContext.get_current().max_inflight_blocks = 4
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import numpy as np
+    import torch
+
+    ds = ray_tpu.data.from_numpy({"x": np.arange(20, dtype=np.float32)})
+    it = ds.streaming_split(1)[0]
+    batches = list(it.iter_torch_batches(batch_size=8))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    total = torch.cat([b["x"] for b in batches])
+    assert float(total.sum()) == float(np.arange(20).sum())
+
+
+def test_event_stats_rpc(ray_start_regular):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(5)], timeout=60)
+    from ray_tpu._private.worker import get_driver
+
+    stats = get_driver().rpc("event_stats")
+    assert stats.get("cmd.submit", {}).get("count", 0) >= 5
+    assert any(k.startswith("worker.") for k in stats)
