@@ -14,10 +14,11 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 from multiprocessing.connection import Client
 from typing import Optional
 
-from ray_tpu._private.ids import JobID, TaskID, WorkerID
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.worker_process import WorkerRuntime
 
 
@@ -35,9 +36,11 @@ class RemoteDriverRuntime(WorkerRuntime):
         assert kind == "driver_registered", kind
         config = pickle.loads(info["config_blob"])
 
-        # remote drivers must share the head's shm in this version: verify
-        # the head's session marker instead of silently creating an empty
-        # store at the same path on a different machine
+        # same-machine drivers map the head's shm directly (zero-copy);
+        # cross-machine drivers (marker not visible) fall back to a private
+        # local cache store with puts uploaded over the control socket and
+        # gets pulled from the head's object server (Ray-Client parity,
+        # python/ray/util/client/ARCHITECTURE.md).
         marker = os.path.join(info["shm_dir"], ".cluster_session")
         session = info.get("session_name", "")
         try:
@@ -45,19 +48,29 @@ class RemoteDriverRuntime(WorkerRuntime):
                 found = fh.read().strip()
         except OSError:
             found = None
-        if found != session:
-            conn.close()
-            raise RuntimeError(
-                "ray_tpu.init(address=...) requires the driver to run on the "
-                "head machine (head shm not visible at "
-                f"{info['shm_dir']!r}); run the driver there or submit a job"
-            )
+        self._cross_machine = (
+            found != session or bool(os.environ.get("RAY_TPU_FORCE_REMOTE_CLIENT"))
+        )
+        self._head_object_addr = info.get("object_addr")
+        self._auth_key = key
 
         from ray_tpu._private.native_store import create_store_client
 
-        store = create_store_client(
-            info["shm_dir"], info["fallback_dir"], config.object_store_memory
-        )
+        self._private_store_dir = None
+        if self._cross_machine:
+            import tempfile
+
+            base = tempfile.mkdtemp(prefix="ray_tpu_client_")
+            self._private_store_dir = base
+            store = create_store_client(
+                os.path.join(base, "shm"),
+                os.path.join(base, "spill"),
+                config.object_store_memory,
+            )
+        else:
+            store = create_store_client(
+                info["shm_dir"], info["fallback_dir"], config.object_store_memory
+            )
         super().__init__(conn, WorkerID(info["worker_id"]), store, config)
         # unique put-id namespace per driver (workers get theirs per-task)
         self.job_id = JobID.from_int(int.from_bytes(os.urandom(3), "little"))
@@ -67,6 +80,49 @@ class RemoteDriverRuntime(WorkerRuntime):
             target=self.reader_loop, name="client-reader", daemon=True
         )
         self._reader.start()
+
+    # -- cross-machine object plane ---------------------------------------
+
+    def put(self, value):
+        if not self._cross_machine:
+            return super().put(value)
+        oid = ObjectID.for_put(
+            self.current_task_id or TaskID.nil(), self._put_counter.next()
+        )
+        blob = self.serde.serialize_to_bytes(value)
+        # upload over the control socket; the head stores + commits it
+        self._send(("put_object", oid, blob))
+        self.store.put_bytes(oid, blob)  # local cache for re-reads
+        return oid
+
+    def _entry_value(self, oid, entry, timeout):
+        if (
+            self._cross_machine
+            and entry[0] == "stored"
+            and not self.store.contains(oid)
+        ):
+            # pull: ensure a head copy exists (transfer/reconstruction),
+            # then fetch it from the head's object server into the cache
+            from ray_tpu._private.object_transfer import fetch_object_bytes
+
+            deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
+            while not self.store.contains(oid):
+                try:
+                    self.rpc("ensure_local", oid)
+                    blob = fetch_object_bytes(
+                        self._head_object_addr, oid, self._auth_key
+                    )
+                    if blob is not None:
+                        self.store.put_bytes(oid, blob)
+                        break
+                except Exception:
+                    pass
+                if time.monotonic() >= deadline:
+                    # the fetch budget is spent; don't let the base class
+                    # poll the private cache for the same timeout again
+                    return super()._entry_value(oid, entry, 0.05)
+                time.sleep(0.5)
+        return super()._entry_value(oid, entry, timeout)
 
     def shutdown(self):
         """Disconnect from the cluster (the cluster keeps running)."""
@@ -79,6 +135,10 @@ class RemoteDriverRuntime(WorkerRuntime):
             self.store.close()
         except Exception:
             pass
+        if self._private_store_dir:
+            import shutil
+
+            shutil.rmtree(self._private_store_dir, ignore_errors=True)
 
 
 def connect(address, auth_key: Optional[str] = None) -> RemoteDriverRuntime:

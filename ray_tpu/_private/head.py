@@ -115,6 +115,7 @@ class HeadServer:
                         "config_blob": pickle.dumps(self._config),
                         "node_id": self._node.head_node_id.binary(),
                         "session_name": self._node.session_name,
+                        "object_addr": self._object_server.address,
                     },
                 )
             )

@@ -194,6 +194,7 @@ class WorkerState:
     proc: Any  # mp Process | None for remote workers
     node_id: NodeID
     state: str = "starting"  # starting|idle|busy|blocked|dead
+    idle_since: float = 0.0
     current_task: Optional[TaskID] = None
     acquired: Dict[str, float] = field(default_factory=dict)
     acquired_node: Optional[NodeID] = None
@@ -509,6 +510,7 @@ class Scheduler:
             return
         if kind == "ready":
             w.state = "idle"
+            w.idle_since = time.monotonic()
             self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
             if w.actor_id is None:
                 self._idle_by_node[w.node_id].append(wid)
@@ -537,6 +539,29 @@ class Scheduler:
         elif kind == "submit_put":
             self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
+        elif kind == "put_object":
+            # cross-machine driver upload: the bytes ride the control socket
+            # into the head store (parity: Ray Client puts proxied through
+            # the server, util/client/server)
+            _, oid, blob = msg
+            try:
+                self._node.store_client.put_bytes(oid, blob)
+                self._object_locations[oid].add(self._node.head_node_id)
+                self._commit_result(oid, ("stored",))
+            except Exception as e:  # noqa: BLE001
+                logger.exception("client put of %s failed", oid.hex()[:8])
+                # surface the failure to consumers instead of hanging them
+                self._commit_result(
+                    oid,
+                    (
+                        "error",
+                        pickle.dumps(
+                            exc.ObjectStoreFullError(
+                                f"client upload of {oid.hex()} failed: {e!r}"
+                            )
+                        ),
+                    ),
+                )
         elif kind == "log":
             # worker stdout/stderr forwarded to the driver (log_to_driver;
             # parity: python/ray/_private/log_monitor.py)
@@ -906,6 +931,27 @@ class Scheduler:
 
         Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
         (``cluster_task_manager.cc:136``)."""
+        # idle-worker reaping (parity: WorkerPool idle killing,
+        # worker_pool.h:83): idle beyond the timeout and above the keep-warm
+        # floor -> exit. Actor workers are dedicated and never reaped here.
+        timeout_s = self.config.worker_idle_timeout_s
+        if timeout_s > 0:
+            now_r = time.monotonic()
+            idle_workers = [
+                w
+                for w in self.workers.values()
+                if w.state == "idle" and w.actor_id is None and w.idle_since
+            ]
+            keep = 2
+            if len(idle_workers) > keep:
+                idle_workers.sort(key=lambda w: w.idle_since)
+                for w in idle_workers[: len(idle_workers) - keep]:
+                    if now_r - w.idle_since > timeout_s:
+                        try:
+                            w.conn.send(("exit",))
+                        except (OSError, EOFError):
+                            pass
+                        self._on_worker_death(w.worker_id, graceful=True)
         # control-plane persistence: periodically snapshot the GCS tables +
         # detached-actor specs so a restarted head rebuilds them (parity:
         # GcsTableStorage + Redis persistence, redis_store_client.h:33,
@@ -1110,6 +1156,7 @@ class Scheduler:
                 self._release_resources(w)
                 w.current_task = None
                 w.state = "idle"
+                w.idle_since = time.monotonic()
                 self._idle_by_node[w.node_id].append(wid)
             self._make_schedulable(rec)
             return
@@ -1163,6 +1210,7 @@ class Scheduler:
                 self._release_resources(w)
                 w.current_task = None
                 w.state = "idle"
+                w.idle_since = time.monotonic()
                 self._idle_by_node[w.node_id].append(wid)
         elif spec is not None and spec.task_type == TaskType.ACTOR_TASK:
             w.current_task = None

@@ -10,7 +10,11 @@ TPU node types scale in whole slices.
 """
 
 from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeType
-from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    LocalDaemonNodeProvider,
+    NodeProvider,
+)
 
 __all__ = [
     "Autoscaler",
@@ -18,4 +22,5 @@ __all__ = [
     "NodeType",
     "NodeProvider",
     "FakeNodeProvider",
+    "LocalDaemonNodeProvider",
 ]

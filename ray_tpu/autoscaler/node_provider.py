@@ -68,6 +68,61 @@ class FakeNodeProvider(NodeProvider):
         ]
 
 
+class LocalDaemonNodeProvider(NodeProvider):
+    """Launches REAL node-daemon processes on this machine.
+
+    Parity: the reference tests its autoscaler against
+    ``fake_multi_node/node_provider.py`` — which starts *real raylet
+    processes*; this is the same idea on this framework's raylet
+    (``_private/raylet.py``): scale-up spawns a daemon that registers with
+    the head over the socket plane, scale-down SIGTERMs it (the head sees
+    the socket drop and removes the node)."""
+
+    def __init__(self):
+        self._procs: Dict[str, object] = {}
+        self._nodes: Dict[str, dict] = {}
+
+    def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
+        from ray_tpu._private.worker import get_driver
+        from ray_tpu.cluster_utils import spawn_daemon_process
+
+        res = dict(resources)
+        num_cpus = res.pop("CPU", 1.0)
+        num_tpus = res.pop("TPU", 0.0)
+        proc, node_id = spawn_daemon_process(
+            get_driver(),
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=res,
+            labels={"autoscaler-node-type": node_type},
+        )
+        self._procs[node_id] = proc
+        self._nodes[node_id] = {
+            "node_id": node_id,
+            "node_type": node_type,
+            "resources": dict(resources),
+            "launched_at": time.time(),
+        }
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        proc = self._procs.pop(node_id, None)
+        self._nodes.pop(node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[dict]:
+        return [
+            n
+            for nid, n in self._nodes.items()
+            if self._procs.get(nid) is not None and self._procs[nid].poll() is None
+        ]
+
+
 class TPUVMNodeProvider(NodeProvider):
     """TPU-VM (GCE) provider skeleton.
 

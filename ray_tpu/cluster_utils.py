@@ -39,6 +39,65 @@ class ClusterNode:
 VirtualNode = ClusterNode
 
 
+def spawn_daemon_process(
+    driver,
+    *,
+    num_cpus: float = 1.0,
+    num_tpus: float = 0.0,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    wait: bool = True,
+    timeout: float = 30.0,
+):
+    """Spawn one real node-daemon process attached to the driver's head.
+
+    The single spawn protocol shared by the test Cluster fixture and the
+    autoscaler's LocalDaemonNodeProvider. Returns (Popen, node_id_hex|None).
+    """
+    host, port = driver.node.start_head_server()
+    env = dict(os.environ)
+    env["RAY_TPU_AUTH"] = driver.config.cluster_auth_key
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    before = {n["node_id"] for n in ray_tpu.nodes()}
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.raylet",
+            "--address",
+            f"{host}:{port}",
+            "--num-cpus",
+            str(num_cpus),
+            "--num-tpus",
+            str(num_tpus),
+            "--resources",
+            json.dumps(resources or {}),
+            "--labels",
+            json.dumps(labels or {}),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    if not wait:
+        return proc, None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        fresh = [
+            n for n in ray_tpu.nodes() if n["alive"] and n["node_id"] not in before
+        ]
+        if fresh:
+            return proc, fresh[0]["node_id"]
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"node daemon exited rc={proc.returncode} before registering"
+            )
+        time.sleep(0.02)
+    proc.kill()
+    raise TimeoutError(f"node daemon did not register within {timeout}s")
+
+
 class Cluster:
     def __init__(
         self,
@@ -76,52 +135,19 @@ class Cluster:
             self._nodes.append(node)
             return node
 
-        host, port = self.address
-        env = dict(os.environ)
-        env["RAY_TPU_AUTH"] = driver.config.cluster_auth_key
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        before = {n["node_id"] for n in ray_tpu.nodes()}
-        proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu._private.raylet",
-                "--address",
-                f"{host}:{port}",
-                "--num-cpus",
-                str(num_cpus),
-                "--num-tpus",
-                str(num_tpus),
-                "--resources",
-                json.dumps(resources or {}),
-                "--labels",
-                json.dumps(labels or {}),
-            ],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+        proc, node_id_hex = spawn_daemon_process(
+            driver,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            labels=labels,
+            wait=wait,
         )
         self._procs.append(proc)
-        node = ClusterNode(None, self, proc=proc)
+        node = ClusterNode(
+            NodeID.from_hex(node_id_hex) if node_id_hex else None, self, proc=proc
+        )
         self._nodes.append(node)
-        if wait:
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
-                fresh = [
-                    n
-                    for n in ray_tpu.nodes()
-                    if n["alive"] and n["node_id"] not in before
-                ]
-                if fresh:
-                    node.node_id = NodeID.from_hex(fresh[0]["node_id"])
-                    return node
-                if proc.poll() is not None:
-                    raise RuntimeError(
-                        f"node daemon exited rc={proc.returncode} before registering"
-                    )
-                time.sleep(0.02)
-            raise TimeoutError("node daemon did not register within 30s")
         return node
 
     def remove_node(self, node: ClusterNode, allow_graceful: bool = True) -> None:

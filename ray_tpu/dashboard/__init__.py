@@ -58,6 +58,12 @@ def start_dashboard(port: int = 8765) -> int:
                     from ray_tpu.job_submission import JobSubmissionClient
 
                     body = JobSubmissionClient().list_jobs()
+                elif self.path == "/api/event_stats":
+                    from ray_tpu._private.worker import get_driver
+
+                    body = get_driver().rpc("event_stats")
+                elif self.path == "/api/timeline":
+                    body = ray_tpu.timeline()
                 elif self.path == "/metrics":
                     from ray_tpu.util.metrics import prometheus_text
 

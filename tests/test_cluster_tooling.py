@@ -212,3 +212,51 @@ def test_workflow_resume_after_failure(ray_start_regular, tmp_path):
         workflow.run(dag, workflow_id="wf2", storage=str(tmp_path), args=(4,))
     assert workflow.get_status("wf2", storage=str(tmp_path)) == "FAILED"
     assert workflow.resume("wf2", storage=str(tmp_path)) == 50
+
+
+def test_autoscaler_launches_real_daemons(ray_start_regular):
+    """Scale-up launches REAL node-daemon processes in response to pending
+    demand; scale-down terminates idle ones (parity: the reference tests the
+    autoscaler against fake_multi_node's real raylet processes)."""
+    import time
+
+    from ray_tpu.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        LocalDaemonNodeProvider,
+        NodeType,
+    )
+
+    provider = LocalDaemonNodeProvider()
+    config = AutoscalerConfig(
+        node_types=[NodeType("cpu2", {"CPU": 2.0, "grow": 2.0}, min_workers=0, max_workers=2)],
+        idle_timeout_s=2.0,
+    )
+    scaler = Autoscaler(config, provider)
+    try:
+        assert scaler.update()["launched"] == 0  # no demand yet
+
+        # infeasible demand: tasks needing a custom resource nothing has
+        @ray_tpu.remote(resources={"grow": 1.0})
+        def job():
+            return 1
+
+        refs = [job.remote() for _ in range(2)]
+        time.sleep(0.5)
+        result = scaler.update()
+        assert result["launched"] >= 1  # a real daemon was spawned
+        assert ray_tpu.get(refs, timeout=60) == [1, 1]  # demand now satisfied
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        assert len(alive) >= 2
+
+        # idle: terminated after the timeout
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if scaler.update()["terminated"] >= 1:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("idle daemon never terminated")
+    finally:
+        for n in provider.non_terminated_nodes():
+            provider.terminate_node(n["node_id"])

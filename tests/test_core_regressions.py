@@ -219,3 +219,42 @@ def test_log_to_driver(ray_start_regular, capfd):
             break
         time.sleep(0.1)
     assert "marker-from-worker-xyz" in seen
+
+
+def test_idle_worker_reaping(tmp_path):
+    """Idle workers beyond the keep-warm floor exit after the timeout
+    (parity: WorkerPool idle killing)."""
+    import ray_tpu as rt
+    from ray_tpu.util import state as state_api
+
+    rt.init(
+        num_cpus=4,
+        ignore_reinit_error=True,
+        _system_config={"worker_idle_timeout_s": 1.0},
+    )
+    try:
+        @rt.remote
+        def burst(i):
+            time.sleep(0.1)
+            return i
+
+        rt.get([burst.remote(i) for i in range(8)], timeout=60)
+        # several workers spawned; after the timeout only the floor remains
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            idle = [
+                w for w in state_api.list_workers()
+                if w["state"] == "idle" and not w["actor_id"]
+            ]
+            if len(idle) <= 2:
+                break
+            time.sleep(0.3)
+        assert len(idle) <= 2, idle
+
+        @rt.remote
+        def again():
+            return "ok"
+
+        assert rt.get(again.remote(), timeout=60) == "ok"  # pool respawns fine
+    finally:
+        rt.shutdown()

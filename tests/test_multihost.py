@@ -284,3 +284,58 @@ def test_put_object_lost_is_terminal(real_cluster):
     time.sleep(0.3)
     with pytest.raises(ray_tpu.exceptions.ObjectLostError):
         ray_tpu.get(inner_ref, timeout=20)
+
+
+def test_cross_machine_remote_driver(real_cluster):
+    """A driver that cannot see the head's shm (simulated via
+    RAY_TPU_FORCE_REMOTE_CLIENT) works through the object plane: puts ride
+    the control socket into the head store, gets pull from the head's object
+    server (Ray-Client parity, util/client/ARCHITECTURE.md)."""
+    real_cluster.add_node(num_cpus=2, resources={"rc": 1})
+    host, port = real_cluster.address
+    from ray_tpu._private.worker import get_driver
+
+    script = textwrap.dedent(
+        f"""
+        import numpy as np
+        import ray_tpu
+        ray_tpu.init(address="{host}:{port}")
+        from ray_tpu._private.worker import get_driver
+        assert get_driver()._cross_machine
+
+        @ray_tpu.remote(resources={{"rc": 0.1}})
+        def consume(x):
+            return float(x.sum())
+
+        # upload path: driver put -> head store -> remote node
+        ref = ray_tpu.put(np.full(300_000, 2.0))
+        assert ray_tpu.get(consume.remote(ref), timeout=90) == 600_000.0
+
+        # download path: big result produced on the far node -> driver
+        @ray_tpu.remote(resources={{"rc": 0.1}})
+        def produce():
+            return np.arange(250_000)
+
+        arr = ray_tpu.get(produce.remote(), timeout=90)
+        assert arr.sum() == sum(range(250_000))
+        ray_tpu.shutdown()
+        print("CROSS-MACHINE-OK")
+        """
+    )
+    env = dict(os.environ)
+    env["RAY_TPU_AUTH"] = get_driver().config.cluster_auth_key
+    env["RAY_TPU_FORCE_REMOTE_CLIENT"] = "1"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CROSS-MACHINE-OK" in r.stdout
