@@ -105,6 +105,10 @@ class RemoteDriverRuntime(WorkerRuntime):
             # then fetch it from the head's object server into the cache
             from ray_tpu._private.object_transfer import fetch_object_bytes
 
+            import logging
+
+            logger = logging.getLogger(__name__)
+            warned = False
             deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
             while not self.store.contains(oid):
                 try:
@@ -115,8 +119,16 @@ class RemoteDriverRuntime(WorkerRuntime):
                     if blob is not None:
                         self.store.put_bytes(oid, blob)
                         break
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    if not warned:
+                        warned = True
+                        logger.warning(
+                            "fetch of %s from head object server %r failing "
+                            "(%r); retrying until the timeout",
+                            oid.hex()[:8],
+                            self._head_object_addr,
+                            e,
+                        )
                 if time.monotonic() >= deadline:
                     # the fetch budget is spent; don't let the base class
                     # poll the private cache for the same timeout again

@@ -44,6 +44,7 @@ class Config:
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 = num_cpus
     worker_idle_timeout_s: float = 300.0
+    worker_keep_warm: int = 2  # idle workers kept per node despite the timeout
     prestart_workers: bool = True
     # --- health / fault tolerance ---
     health_check_period_ms: int = 1000  # ref: gcs_health_check_manager.h:55

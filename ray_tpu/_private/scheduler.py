@@ -43,6 +43,7 @@ from ray_tpu._private.ids import (
     TaskID,
     WorkerID,
 )
+from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec, TaskType
 
 logger = logging.getLogger(__name__)
@@ -195,6 +196,7 @@ class WorkerState:
     node_id: NodeID
     state: str = "starting"  # starting|idle|busy|blocked|dead
     idle_since: float = 0.0
+    dead_since: float = 0.0
     current_task: Optional[TaskID] = None
     acquired: Dict[str, float] = field(default_factory=dict)
     acquired_node: Optional[NodeID] = None
@@ -359,6 +361,10 @@ class Scheduler:
         # head node's own object server address (set by HeadServer)
         self.head_object_addr = None
         self._last_gcs_snapshot = 0.0
+        # event-driven dispatch bookkeeping
+        self._dispatch_dirty = True
+        self._last_full_dispatch = 0.0
+        self._last_reap_scan = 0.0
 
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
@@ -509,6 +515,7 @@ class Scheduler:
         if w is None:
             return
         if kind == "ready":
+            self._dispatch_dirty = True
             w.state = "idle"
             w.idle_since = time.monotonic()
             self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
@@ -551,14 +558,17 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001
                 logger.exception("client put of %s failed", oid.hex()[:8])
                 # surface the failure to consumers instead of hanging them
+                err_cls = (
+                    exc.ObjectStoreFullError
+                    if isinstance(e, StoreFullError)
+                    else exc.RayTpuError
+                )
                 self._commit_result(
                     oid,
                     (
                         "error",
                         pickle.dumps(
-                            exc.ObjectStoreFullError(
-                                f"client upload of {oid.hex()} failed: {e!r}"
-                            )
+                            err_cls(f"client upload of {oid.hex()} failed: {e!r}")
                         ),
                     ),
                 )
@@ -776,12 +786,14 @@ class Scheduler:
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
             self._commit_result(cmd[1], cmd[2])
         elif kind == "add_node":
+            self._dispatch_dirty = True
             node: NodeState = cmd[1]
             self.nodes[node.node_id] = node
             self._retry_pending_pgs()
         elif kind == "remove_node":
             self._on_remove_node(cmd[1])
         elif kind == "worker_spawned":
+            self._dispatch_dirty = True
             _, wstate = cmd
             self.workers[wstate.worker_id] = wstate
             # only real (waitable) pipes join the wait set; remote workers'
@@ -789,6 +801,7 @@ class Scheduler:
             if not isinstance(wstate.conn, DaemonWorkerChannel):
                 self._conn_to_worker[wstate.conn] = wstate.worker_id
         elif kind == "register_daemon":
+            self._dispatch_dirty = True
             _, conn, ns = cmd
             self.nodes[ns.node_id] = ns
             self._daemon_conns[conn] = ns.node_id
@@ -819,8 +832,10 @@ class Scheduler:
                 ):
                     self._kill_actor(actor_id, no_restart=True)
         elif kind == "create_pg":
+            self._dispatch_dirty = True
             self._create_pg(cmd[1])
         elif kind == "remove_pg":
+            self._dispatch_dirty = True
             self._remove_pg(cmd[1])
         elif kind == "add_ref":
             for oid in cmd[1]:
@@ -920,6 +935,7 @@ class Scheduler:
         return deps
 
     def _make_schedulable(self, rec: TaskRecord):
+        self._dispatch_dirty = True
         rec.state = "PENDING"
         if rec.spec.task_type == TaskType.ACTOR_TASK:
             self._dispatch_actor_task(rec)
@@ -932,18 +948,21 @@ class Scheduler:
         Parity: ``ClusterTaskManager::ScheduleAndDispatchTasks``
         (``cluster_task_manager.cc:136``)."""
         # idle-worker reaping (parity: WorkerPool idle killing,
-        # worker_pool.h:83): idle beyond the timeout and above the keep-warm
-        # floor -> exit. Actor workers are dedicated and never reaped here.
+        # worker_pool.h:83): idle beyond the timeout and above a per-node
+        # keep-warm floor -> exit. Actor workers are dedicated and never
+        # reaped here. Rate-limited: this is the hot loop.
         timeout_s = self.config.worker_idle_timeout_s
-        if timeout_s > 0:
-            now_r = time.monotonic()
-            idle_workers = [
-                w
-                for w in self.workers.values()
-                if w.state == "idle" and w.actor_id is None and w.idle_since
-            ]
-            keep = 2
-            if len(idle_workers) > keep:
+        now_r = time.monotonic()
+        if timeout_s > 0 and now_r - self._last_reap_scan > 1.0:
+            self._last_reap_scan = now_r
+            by_node: Dict[NodeID, List[WorkerState]] = collections.defaultdict(list)
+            for w in self.workers.values():
+                if w.state == "idle" and w.actor_id is None and w.idle_since:
+                    by_node[w.node_id].append(w)
+            keep = self.config.worker_keep_warm
+            for idle_workers in by_node.values():
+                if len(idle_workers) <= keep:
+                    continue
                 idle_workers.sort(key=lambda w: w.idle_since)
                 for w in idle_workers[: len(idle_workers) - keep]:
                     if now_r - w.idle_since > timeout_s:
@@ -952,6 +971,17 @@ class Scheduler:
                         except (OSError, EOFError):
                             pass
                         self._on_worker_death(w.worker_id, graceful=True)
+            # prune long-dead WorkerState entries: with reaping, worker death
+            # is steady-state and the table must not grow without bound
+            doomed = [
+                wid
+                for wid, w in self.workers.items()
+                if w.state == "dead"
+                and w.dead_since
+                and now_r - w.dead_since > 30.0
+            ]
+            for wid in doomed:
+                del self.workers[wid]
         # control-plane persistence: periodically snapshot the GCS tables +
         # detached-actor specs so a restarted head rebuilds them (parity:
         # GcsTableStorage + Redis persistence, redis_store_client.h:33,
@@ -995,6 +1025,15 @@ class Scheduler:
                 self._create_pg(pg)
         if not self._pending:
             return
+        # event-driven dispatch: rescanning the deferred queue every loop
+        # tick is O(pending^2) under load — only rescan when capacity or the
+        # queue changed (dirty), with a periodic safety rescan bounding any
+        # missed wake-up
+        now_d = time.monotonic()
+        if not self._dispatch_dirty and now_d - self._last_full_dispatch < 0.5:
+            return
+        self._dispatch_dirty = False
+        self._last_full_dispatch = now_d
         deferred = []
         while self._pending:
             task_id = self._pending.popleft()
@@ -1240,6 +1279,7 @@ class Scheduler:
                 self._maybe_free(oid)
 
     def _downgrade_to_lifetime(self, w: WorkerState, spec: TaskSpec):
+        self._dispatch_dirty = True
         lifetime = spec.lifetime_resources or {}
         if w.pg_reservation is not None:
             pg_id, i = w.pg_reservation
@@ -1259,6 +1299,7 @@ class Scheduler:
         w.current_task = None
 
     def _release_resources(self, w: WorkerState):
+        self._dispatch_dirty = True
         if w.pg_reservation is not None:
             pg_id, i = w.pg_reservation
             pg = self.placement_groups.get(pg_id)
@@ -1312,6 +1353,7 @@ class Scheduler:
         if w is None or w.state == "dead":
             return
         w.state = "dead"
+        w.dead_since = time.monotonic()
         self._conn_to_worker.pop(w.conn, None)
         try:
             w.conn.close()

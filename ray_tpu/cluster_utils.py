@@ -54,12 +54,18 @@ def spawn_daemon_process(
     The single spawn protocol shared by the test Cluster fixture and the
     autoscaler's LocalDaemonNodeProvider. Returns (Popen, node_id_hex|None).
     """
+    import uuid
+
     host, port = driver.node.start_head_server()
     env = dict(os.environ)
     env["RAY_TPU_AUTH"] = driver.config.cluster_auth_key
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-    before = {n["node_id"] for n in ray_tpu.nodes()}
+    # a unique label identifies THIS spawn exactly (set-difference against a
+    # before-snapshot mis-attributes nodes when two spawns overlap)
+    token = uuid.uuid4().hex[:12]
+    all_labels = dict(labels or {})
+    all_labels["spawn-token"] = token
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -74,7 +80,7 @@ def spawn_daemon_process(
             "--resources",
             json.dumps(resources or {}),
             "--labels",
-            json.dumps(labels or {}),
+            json.dumps(all_labels),
         ],
         env=env,
         stdout=subprocess.DEVNULL,
@@ -85,7 +91,9 @@ def spawn_daemon_process(
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         fresh = [
-            n for n in ray_tpu.nodes() if n["alive"] and n["node_id"] not in before
+            n
+            for n in ray_tpu.nodes()
+            if n["alive"] and n.get("labels", {}).get("spawn-token") == token
         ]
         if fresh:
             return proc, fresh[0]["node_id"]
