@@ -49,7 +49,11 @@ class Config:
     # --- health / fault tolerance ---
     health_check_period_ms: int = 1000  # ref: gcs_health_check_manager.h:55
     health_check_failure_threshold: int = 5
-    health_check_timeout_s: float = 10.0  # daemon declared dead after this
+    # Daemon declared dead after this many seconds without a heartbeat.
+    # Crashed daemons are detected immediately via socket close; this timeout
+    # only catches *hung* daemons, so it can be generous (heartbeats come from
+    # a dedicated thread but can still lag under heavy load on small boxes).
+    health_check_timeout_s: float = 30.0
     # --- multi-host cluster ---
     cluster_host: str = "127.0.0.1"  # head listener bind address
     cluster_auth_key: str = ""  # shared secret; generated per session if empty

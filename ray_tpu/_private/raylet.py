@@ -105,17 +105,21 @@ class NodeDaemon:
 
     # -- main loop ---------------------------------------------------------
 
+    def _heartbeat_loop(self):
+        # Dedicated thread: heartbeats must not be starved by long object
+        # transfers or a busy event loop (single-core boxes stall the main
+        # loop for seconds under load).
+        while not self._stop:
+            try:
+                self._send(("heartbeat", time.monotonic()))
+            except (OSError, EOFError):
+                return
+            time.sleep(HEARTBEAT_PERIOD_S)
+
     def run(self):
-        last_beat = 0.0
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         try:
             while not self._stop:
-                now = time.monotonic()
-                if now - last_beat >= HEARTBEAT_PERIOD_S:
-                    last_beat = now
-                    try:
-                        self._send(("heartbeat", now))
-                    except (OSError, EOFError):
-                        break
                 waitables = [self.conn] + list(self._pipe_to_wid.keys())
                 try:
                     ready = mpc.wait(waitables, timeout=0.2)

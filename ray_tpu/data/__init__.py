@@ -11,13 +11,16 @@ from ray_tpu.data.context import ActorPoolStrategy, DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
     range,  # noqa: A004
+    read_binary_files,
     read_csv,
     read_json,
     read_parquet,
+    read_text,
 )
 
 __all__ = [
@@ -33,10 +36,13 @@ __all__ = [
     "Mean",
     "Std",
     "range",
+    "from_arrow",
     "from_items",
     "from_numpy",
     "from_pandas",
+    "read_binary_files",
     "read_csv",
     "read_json",
     "read_parquet",
+    "read_text",
 ]

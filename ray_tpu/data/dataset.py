@@ -11,6 +11,7 @@ reference's ``StreamingExecutor`` backpressure, ``streaming_executor.py:48``).
 from __future__ import annotations
 
 import builtins
+import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import numpy as np
@@ -183,6 +184,59 @@ class Dataset:
         if buffered:
             raise ValueError("zip(): datasets have different row counts")
         return Dataset(refs)
+
+    # -- column ops (parity: Dataset.add_column/drop_columns/select_columns/
+    # rename_columns, python/ray/data/dataset.py) -------------------------
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        """fn receives the whole batch (dict of columns) and returns the new
+        column as an array (the reference's batch-wise contract)."""
+
+        def _add(batch):
+            out = dict(batch)
+            out[name] = np.asarray(fn(batch))
+            return out
+
+        return self._with_op("map_batches", _add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        cols = list(cols)
+
+        def _drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self._with_op("map_batches", _drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        cols = list(cols)
+
+        def _select(batch):
+            missing = [c for c in cols if c not in batch]
+            if missing:
+                raise KeyError(f"select_columns: missing {missing}")
+            return {k: batch[k] for k in cols}
+
+        return self._with_op("map_batches", _select)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        mapping = dict(mapping)
+
+        def _rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self._with_op("map_batches", _rename)
+
+    def unique(self, column: str) -> List:
+        """Distinct values of one column: per-block remote uniques, only the
+        small distinct sets travel to the driver."""
+        seen: set = set()
+        refs = [
+            _block_unique.remote(ref, self._ops, column)
+            for ref in self._block_refs
+        ]
+        for vals in ray_tpu.get(refs, timeout=600):
+            seen.update(vals)
+        return sorted(seen)
 
     def limit(self, n: int) -> "Dataset":
         out_blocks = []
@@ -465,6 +519,64 @@ class Dataset:
         if buffered and not drop_last:
             yield concat_blocks([slice_block(b, o, block_num_rows(b)) for b, o in blocks])
 
+    def to_pandas(self):
+        import pandas as pd
+
+        block = self.to_block()
+        return pd.DataFrame({k: list(v) if getattr(v, "ndim", 1) > 1 else v
+                             for k, v in block.items()})
+
+    def to_numpy_refs(self) -> List:
+        return list(self._iter_exec_block_refs())
+
+    # -- writes (parity: Dataset.write_parquet/csv/json — one file per
+    # block, written by distributed tasks) --------------------------------
+
+    def _write(self, path: str, ext: str, writer_fn) -> List[str]:
+        import cloudpickle
+
+        os.makedirs(path, exist_ok=True)
+        blob = cloudpickle.dumps(writer_fn)
+        refs = [
+            _write_block.remote(ref, self._ops,
+                                os.path.join(path, f"part-{i:05d}{ext}"), blob)
+            for i, ref in enumerate(self._block_refs)
+        ]
+        return ray_tpu.get(refs, timeout=600)
+
+    def write_parquet(self, path: str) -> List[str]:
+        def _w(block, out_path):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(pa.table({k: list(v) for k, v in block.items()}), out_path)
+
+        return self._write(path, ".parquet", _w)
+
+    def write_csv(self, path: str) -> List[str]:
+        def _w(block, out_path):
+            import csv
+
+            cols = list(block)
+            with open(out_path, "w", newline="") as fh:
+                w = csv.writer(fh)
+                w.writerow(cols)
+                for i in builtins.range(block_num_rows(block)):
+                    w.writerow([block[c][i] for c in cols])
+
+        return self._write(path, ".csv", _w)
+
+    def write_json(self, path: str) -> List[str]:
+        def _w(block, out_path):
+            import json
+
+            with open(out_path, "w") as fh:
+                for row in block_to_rows(block):
+                    fh.write(json.dumps({k: v.tolist() if hasattr(v, "tolist") else v
+                                         for k, v in row.items()}) + "\n")
+
+        return self._write(path, ".json", _w)
+
     def schema(self) -> Dict[str, str]:
         for block in self._iter_exec_blocks():
             return {k: str(v.dtype) for k, v in block.items()}
@@ -478,6 +590,21 @@ class Dataset:
 
     def __repr__(self):
         return self.stats()
+
+
+@ray_tpu.remote
+def _block_unique(block, ops, column: str):
+    block = _apply_ops(block, ops)
+    return np.unique(np.asarray(block[column])).tolist()
+
+
+@ray_tpu.remote
+def _write_block(block, ops, out_path: str, writer_blob):
+    import cloudpickle
+
+    block = _apply_ops(block, ops)
+    cloudpickle.loads(writer_blob)(block, out_path)
+    return out_path
 
 
 @ray_tpu.remote

@@ -123,6 +123,37 @@ def _read_json_file(path: str):
     return rows_to_block(rows)
 
 
+@ray_tpu.remote
+def _read_text_file(path: str):
+    with open(path) as fh:
+        lines = [ln.rstrip("\r\n") for ln in fh]
+    return {"text": np.array(lines, dtype=object)}
+
+
+@ray_tpu.remote
+def _read_binary_file(path: str):
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return {"bytes": np.array([data], dtype=object),
+            "path": np.array([path], dtype=object)}
+
+
+def read_text(paths) -> Dataset:
+    """One block per file of ``{"text": line}`` rows (parity: read_text)."""
+    return Dataset([_read_text_file.remote(p) for p in _expand_paths(paths, ".txt")])
+
+
+def read_binary_files(paths) -> Dataset:
+    """One row per file: ``{"bytes": ..., "path": ...}``."""
+    return Dataset([_read_binary_file.remote(p) for p in _expand_paths(paths, "")])
+
+
+def from_arrow(table) -> Dataset:
+    block = {c: table.column(c).to_numpy(zero_copy_only=False)
+             for c in table.column_names}
+    return Dataset([ray_tpu.put(block)])
+
+
 def read_parquet(paths) -> Dataset:
     return Dataset([_read_parquet_file.remote(p) for p in _expand_paths(paths, ".parquet")])
 

@@ -9,8 +9,10 @@ actors. In-tree algorithms: PPO (CartPole learning target: return >= 150,
 ``tuned_examples/ppo/cartpole-ppo.yaml:5-7``).
 """
 
+from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import CartPoleEnv, EnvSpec, make_env, register_env
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.offline import BC, MARWIL, BCConfig, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 
 __all__ = [
@@ -18,6 +20,12 @@ __all__ = [
     "PPOConfig",
     "IMPALA",
     "IMPALAConfig",
+    "DQN",
+    "DQNConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "CartPoleEnv",
     "make_env",
     "register_env",

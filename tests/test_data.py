@@ -310,3 +310,59 @@ def test_event_stats_rpc(ray_start_regular):
     stats = get_driver().rpc("event_stats")
     assert stats.get("cmd.submit", {}).get("count", 0) >= 5
     assert any(k.startswith("worker.") for k in stats)
+
+
+def test_column_ops(ray_start_regular):
+    ds = ray_tpu.data.range(10)
+    ds = ds.add_column("double", lambda b: b["id"] * 2)
+    ds = ds.rename_columns({"id": "orig"})
+    rows = ds.select_columns(["double"]).take_all()
+    assert [r["double"] for r in rows] == [i * 2 for i in range(10)]
+    assert "orig" in ds.schema() and "id" not in ds.schema()
+    dropped = ds.drop_columns(["double"])
+    assert list(dropped.schema()) == ["orig"]
+
+
+def test_unique(ray_start_regular):
+    ds = ray_tpu.data.from_items([{"v": i % 3} for i in range(12)])
+    assert ds.unique("v") == [0, 1, 2]
+
+
+def test_write_read_roundtrip(ray_start_regular, tmp_path):
+    ds = ray_tpu.data.range(20, num_blocks=3)
+    files = ds.write_csv(str(tmp_path / "csv"))
+    assert len(files) == 3
+    back = ray_tpu.data.read_csv(str(tmp_path / "csv"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(20))
+
+    jfiles = ds.write_json(str(tmp_path / "json"))
+    backj = ray_tpu.data.read_json(str(tmp_path / "json"))
+    assert sorted(r["id"] for r in backj.take_all()) == list(range(20))
+
+    try:
+        import pyarrow  # noqa: F401
+    except ImportError:
+        return
+    ds.write_parquet(str(tmp_path / "pq"))
+    backp = ray_tpu.data.read_parquet(str(tmp_path / "pq"))
+    assert sorted(r["id"] for r in backp.take_all()) == list(range(20))
+
+
+def test_read_text_binary(ray_start_regular, tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\nworld\n")
+    ds = ray_tpu.data.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+    b = tmp_path / "blob.bin"
+    b.write_bytes(b"\x00\x01\x02")
+    bds = ray_tpu.data.read_binary_files(str(b))
+    row = bds.take_all()[0]
+    assert row["bytes"] == b"\x00\x01\x02" and row["path"].endswith("blob.bin")
+
+
+def test_to_pandas(ray_start_regular):
+    import pandas as pd
+
+    df = ray_tpu.data.range(5).to_pandas()
+    assert isinstance(df, pd.DataFrame) and list(df["id"]) == list(range(5))
