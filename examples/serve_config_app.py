@@ -1,0 +1,23 @@
+"""A bound two-deployment composition graph, importable by the declarative
+Serve config path (``serve build`` / ``serve run examples.serve_config_app:app``).
+"""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment
+class Ingress:
+    def __init__(self, doubler):
+        self.doubler = doubler
+
+    def __call__(self, x):
+        return self.doubler.remote(x).result() + 1
+
+
+app = Ingress.bind(Doubler.bind())

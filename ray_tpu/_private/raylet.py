@@ -105,21 +105,31 @@ class NodeDaemon:
 
     # -- main loop ---------------------------------------------------------
 
+    # Main loop considered hung (and heartbeats withheld, so the head declares
+    # the node dead) after this long without completing an iteration. Shorter
+    # than health_check_timeout_s but generous enough for slow single-core
+    # boxes where one handler can lawfully block for seconds.
+    LOOP_HUNG_S = 20.0
+
     def _heartbeat_loop(self):
-        # Dedicated thread: heartbeats must not be starved by long object
-        # transfers or a busy event loop (single-core boxes stall the main
-        # loop for seconds under load).
+        # Dedicated thread: heartbeats must not be starved by a merely *busy*
+        # event loop (single-core boxes stall it for seconds under load), but
+        # must still stop for a genuinely *hung* one — so each beat is gated
+        # on the main loop having completed an iteration recently.
         while not self._stop:
-            try:
-                self._send(("heartbeat", time.monotonic()))
-            except (OSError, EOFError):
-                return
+            if time.monotonic() - self._loop_tick < self.LOOP_HUNG_S:
+                try:
+                    self._send(("heartbeat", time.monotonic()))
+                except (OSError, EOFError):
+                    return
             time.sleep(HEARTBEAT_PERIOD_S)
 
     def run(self):
+        self._loop_tick = time.monotonic()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         try:
             while not self._stop:
+                self._loop_tick = time.monotonic()
                 waitables = [self.conn] + list(self._pipe_to_wid.keys())
                 try:
                     ready = mpc.wait(waitables, timeout=0.2)

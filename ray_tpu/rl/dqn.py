@@ -146,7 +146,7 @@ class DQN(Algorithm):
         cfg = self.config
         n_envs = cfg.num_envs_per_runner
         metrics: Dict[str, Any] = {}
-        for _ in range(cfg.steps_per_iter // n_envs):
+        for _ in range(max(1, cfg.steps_per_iter // n_envs)):
             eps = self._epsilon()
             q = np.asarray(self._q_values(self.params, self._obs))
             actions = q.argmax(axis=1)

@@ -146,6 +146,56 @@ def cmd_job(args):
             print(f"{rec['job_id']}  {rec.get('status')}  {rec['entrypoint'][:60]}")
 
 
+def cmd_serve(args):
+    """``serve run/build/status/shutdown`` (parity: the serve CLI,
+    ``python/ray/serve/scripts.py``)."""
+    from ray_tpu import serve
+
+    _init(args)
+    if args.serve_cmd == "run":
+        target = args.target
+        if target.endswith((".yaml", ".yml")):
+            if args.name != "default" or args.route_prefix:
+                print("warning: --name/--route-prefix come from the yaml for "
+                      "config deploys; flags ignored")
+            handles = serve.deploy_config_file(target)
+            print(f"deployed: {', '.join(handles)}")
+        else:
+            from ray_tpu.serve.schema import _import_bound_app
+
+            serve.run(_import_bound_app(target), name=args.name,
+                      route_prefix=args.route_prefix)
+            print(f"deployed: {args.name}")
+        if args.blocking:
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+    elif args.serve_cmd == "build":
+        from ray_tpu.serve.schema import _import_bound_app
+
+        config = serve.build(
+            _import_bound_app(args.target),
+            name=args.name,
+            import_path=args.target,
+            route_prefix=args.route_prefix,
+        )
+        text = serve.dump_config(config, args.output)
+        if not args.output:
+            print(text, end="")
+        else:
+            print(f"wrote {args.output}")
+    elif args.serve_cmd == "status":
+        try:
+            print(json.dumps(serve.status(), indent=2))
+        except ValueError:
+            print("{}")  # no controller -> nothing deployed
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_dashboard(args):
     from ray_tpu.dashboard import start_dashboard
 
@@ -199,6 +249,22 @@ def main(argv=None):
     jsub.add_parser("stop").add_argument("job_id")
     jsub.add_parser("list")
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="model serving")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    ps = ssub.add_parser("run", help="deploy a config yaml or module:app")
+    ps.add_argument("target")
+    ps.add_argument("--name", default="default")
+    ps.add_argument("--route-prefix", dest="route_prefix")
+    ps.add_argument("--blocking", action="store_true")
+    ps = ssub.add_parser("build", help="emit declarative config for module:app")
+    ps.add_argument("target")
+    ps.add_argument("--name", default="default")
+    ps.add_argument("--route-prefix", dest="route_prefix")
+    ps.add_argument("--output", "-o")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("dashboard", help="start the HTTP dashboard")
     p.add_argument("--port", type=int, default=8765)

@@ -20,6 +20,12 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.schema import (
+    build,
+    deploy_config,
+    deploy_config_file,
+    dump_config,
+)
 from ray_tpu.serve.handle import (
     DeploymentHandle,
     DeploymentResponse,
@@ -35,6 +41,10 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "batch",
+    "build",
+    "deploy_config",
+    "deploy_config_file",
+    "dump_config",
     "multiplexed",
     "get_multiplexed_model_id",
     "DeploymentHandle",

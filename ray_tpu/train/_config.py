@@ -58,6 +58,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # stop criteria for Tune trials: a tune.Stopper, a {"metric": threshold}
+    # dict, or a callable(trial_id, result) -> bool
+    stop: Optional[Any] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -16,8 +16,17 @@ from ray_tpu.tune.search import (
     randn,
     uniform,
 )
+from ray_tpu.tune import bayesopt
+from ray_tpu.tune.bayesopt import BayesOptSearch
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import PopulationBasedTraining, ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.tune.tune_config import TuneConfig
 from ray_tpu.tune.tuner import Tuner
 
@@ -25,6 +34,13 @@ __all__ = [
     "Tuner",
     "TuneConfig",
     "ResultGrid",
+    "BayesOptSearch",
+    "bayesopt",
+    "Stopper",
+    "MaximumIterationStopper",
+    "TrialPlateauStopper",
+    "FunctionStopper",
+    "CombinedStopper",
     "grid_search",
     "choice",
     "uniform",

@@ -1,0 +1,176 @@
+"""BayesOptSearch: Gaussian-process search with expected improvement.
+
+Parity: the role of ``python/ray/tune/search/bayesopt/`` (which wraps the
+external ``bayesian-optimization`` package). Implemented natively on numpy:
+an RBF-kernel GP posterior over the observed (config, objective) pairs and
+candidate ranking by expected improvement. Continuous domains
+(uniform/loguniform/randint/qrandint) are modeled in a normalized unit cube;
+``choice`` axes are sampled uniformly (categorical kernels are out of scope,
+matching the wrapped package's behavior of encoding them numerically).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search import Domain, GridSearch
+
+
+class _Axis:
+    """A continuous parameter axis mapped to [0, 1]."""
+
+    def __init__(self, name: str, low: float, high: float, *, log: bool,
+                 integer: bool, q: int = 1):
+        self.name = name
+        self.low = low
+        self.high = high
+        self.log = log
+        self.integer = integer
+        self.q = q
+
+    def to_unit(self, v: float) -> float:
+        lo, hi = self.low, self.high
+        if self.log:
+            return (math.log(v) - math.log(lo)) / (math.log(hi) - math.log(lo))
+        return (v - lo) / (hi - lo)
+
+    def from_unit(self, u: float) -> Any:
+        lo, hi = self.low, self.high
+        if self.log:
+            v = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+        else:
+            v = lo + u * (hi - lo)
+        if self.integer:
+            v = int(round(v / self.q) * self.q)
+            v = max(int(lo), min(int(hi), v))
+        return v
+
+
+def _classify_axes(param_space: Dict[str, Any]) -> Tuple[List[_Axis], Dict[str, Any]]:
+    """Split the space into GP-modeled axes and passthrough entries."""
+    axes: List[_Axis] = []
+    passthrough: Dict[str, Any] = {}
+    for name, dom in param_space.items():
+        meta = getattr(dom, "_bayes_meta", None)
+        if isinstance(dom, GridSearch):
+            raise ValueError("BayesOptSearch does not support grid_search axes")
+        if meta is not None:
+            axes.append(_Axis(name, **meta))
+        else:
+            passthrough[name] = dom
+    return axes, passthrough
+
+
+# Domains advertise their bounds for the GP through _bayes_meta; patching the
+# constructors here keeps search.py dependency-free.
+def uniform(low: float, high: float) -> Domain:
+    d = Domain(lambda rng: rng.uniform(low, high))
+    d._bayes_meta = dict(low=low, high=high, log=False, integer=False)
+    return d
+
+
+def loguniform(low: float, high: float) -> Domain:
+    d = Domain(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+    d._bayes_meta = dict(low=low, high=high, log=True, integer=False)
+    return d
+
+
+def randint(low: int, high: int) -> Domain:
+    d = Domain(lambda rng: rng.randrange(low, high))
+    d._bayes_meta = dict(low=low, high=high - 1, log=False, integer=True)
+    return d
+
+
+class BayesOptSearch:
+    def __init__(self, *, metric: str, mode: str = "max",
+                 n_initial_points: int = 5, n_candidates: int = 256,
+                 length_scale: float = 0.25, noise: float = 1e-4,
+                 seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._axes: Optional[List[_Axis]] = None
+        self._passthrough: Dict[str, Any] = {}
+        self._pending: Dict[str, np.ndarray] = {}  # tid -> unit vector
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    def set_search_space(self, param_space: Dict[str, Any]):
+        self._axes, self._passthrough = _classify_axes(param_space)
+        if not self._axes:
+            raise ValueError(
+                "BayesOptSearch needs at least one bayesopt.uniform/"
+                "loguniform/randint axis in param_space"
+            )
+
+    def _sample_passthrough(self) -> Dict[str, Any]:
+        out = {}
+        for name, dom in self._passthrough.items():
+            out[name] = dom.sample(self._rng) if isinstance(dom, Domain) else dom
+        return out
+
+    def _vec_to_config(self, u: np.ndarray) -> Dict[str, Any]:
+        cfg = {ax.name: ax.from_unit(float(u[i])) for i, ax in enumerate(self._axes)}
+        cfg.update(self._sample_passthrough())
+        return cfg
+
+    # -- GP machinery ------------------------------------------------------
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.length_scale**2)
+
+    def _posterior(self, Xc: np.ndarray):
+        X = np.stack(self._X)
+        y = np.asarray(self._y)
+        if self.mode == "min":
+            y = -y
+        y_mean, y_std = y.mean(), y.std() + 1e-9
+        yn = (y - y_mean) / y_std
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = self._kernel(Xc, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var), yn.max()
+
+    def _expected_improvement(self, mu, sigma, best):
+        z = (mu - best) / sigma
+        Phi = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+        phi = np.exp(-0.5 * z**2) / math.sqrt(2.0 * math.pi)
+        return (mu - best) * Phi + sigma * phi
+
+    # -- searcher protocol -------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if self._axes is None:
+            raise RuntimeError("set_search_space was not called")
+        dim = len(self._axes)
+        if len(self._X) < self.n_initial:
+            u = self._np_rng.random(dim)
+        else:
+            cand = self._np_rng.random((self.n_candidates, dim))
+            mu, sigma, best = self._posterior(cand)
+            u = cand[int(np.argmax(self._expected_improvement(mu, sigma, best)))]
+        self._pending[trial_id] = u
+        return self._vec_to_config(u)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict[str, Any]]):
+        u = self._pending.pop(trial_id, None)
+        if u is None or not result or self.metric not in result:
+            return
+        self._X.append(u)
+        self._y.append(float(result[self.metric]))

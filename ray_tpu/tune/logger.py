@@ -1,0 +1,56 @@
+"""Trial result loggers: result.json (JSONL) + progress.csv per trial.
+
+Parity: ``python/ray/tune/logger/`` — the reference writes ``result.json``
+and ``progress.csv`` into every trial dir by default (CSV/JSON logger
+callbacks); TensorBoard is a third sink when available. Loggers here are
+driver-side (results already stream to the controller), writing line-at-a-time
+so a crashed experiment keeps everything reported so far.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class TrialLoggers:
+    """One instance per experiment; fans each result out to per-trial files."""
+
+    def __init__(self):
+        self._csv_writers: Dict[str, tuple] = {}  # tid -> (fh, writer, fields)
+
+    def log_result(self, trial_id: str, trial_dir: str, result: Dict[str, Any]):
+        os.makedirs(trial_dir, exist_ok=True)
+        flat = {k: _jsonable(v) for k, v in result.items()}
+        with open(os.path.join(trial_dir, "result.json"), "a") as fh:
+            fh.write(json.dumps(flat) + "\n")
+        entry = self._csv_writers.get(trial_id)
+        if entry is None:
+            fields = list(flat)
+            fh = open(os.path.join(trial_dir, "progress.csv"), "a", newline="")
+            writer = csv.DictWriter(fh, fieldnames=fields, extrasaction="ignore")
+            if fh.tell() == 0:
+                writer.writeheader()
+            entry = (fh, writer, fields)
+            self._csv_writers[trial_id] = entry
+        fh, writer, fields = entry
+        writer.writerow({k: flat.get(k, "") for k in fields})
+        fh.flush()
+
+    def close(self):
+        for fh, _, _ in self._csv_writers.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+        self._csv_writers.clear()

@@ -1,0 +1,103 @@
+"""Stoppers: experiment/trial-level stop criteria.
+
+Parity: ``python/ray/tune/stopper/`` — ``Stopper.__call__(trial_id, result)``
+returns True to stop the trial; ``stop_all()`` ends the experiment.
+``RunConfig(stop=...)`` accepts a Stopper, a dict of metric thresholds, or a
+callable.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Any, Callable, Dict, Optional
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+
+    def __call__(self, trial_id, result):
+        return result.get("training_iteration", 0) >= self._max_iter
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial when its metric stops improving: std of the last
+    ``num_results`` values falls at or below ``std`` (parity:
+    ``tune/stopper/trial_plateau.py``)."""
+
+    def __init__(self, metric: str, *, std: float = 0.01, num_results: int = 4,
+                 grace_period: int = 4):
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._history: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=num_results)
+        )
+        self._iters: Dict[str, int] = defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        if self._metric not in result:
+            return False
+        self._iters[trial_id] += 1
+        h = self._history[trial_id]
+        h.append(float(result[self._metric]))
+        if self._iters[trial_id] < self._grace or len(h) < self._num_results:
+            return False
+        import numpy as np
+
+        return float(np.std(h)) <= self._std
+
+
+class FunctionStopper(Stopper):
+    def __init__(self, fn: Callable[[str, Dict[str, Any]], bool]):
+        self._fn = fn
+
+    def __call__(self, trial_id, result):
+        return bool(self._fn(trial_id, result))
+
+
+class MetricThresholdStopper(Stopper):
+    """dict-form stop criteria: {"metric": threshold} stops a trial once
+    metric >= threshold (or training_iteration >= threshold)."""
+
+    def __init__(self, thresholds: Dict[str, float]):
+        self._thresholds = dict(thresholds)
+
+    def __call__(self, trial_id, result):
+        for metric, bound in self._thresholds.items():
+            if metric in result and float(result[metric]) >= float(bound):
+                return True
+        return False
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self._stoppers)
+
+
+def coerce_stopper(stop) -> Optional[Stopper]:
+    """RunConfig.stop -> Stopper (dict / callable / Stopper / None)."""
+    if stop is None:
+        return None
+    if isinstance(stop, Stopper):
+        return stop
+    if isinstance(stop, dict):
+        return MetricThresholdStopper(stop)
+    if callable(stop):
+        return FunctionStopper(stop)
+    raise TypeError(f"unsupported stop criteria: {type(stop)}")

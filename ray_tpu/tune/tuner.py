@@ -184,6 +184,15 @@ class Tuner:
         fn_blob = cloudpickle.dumps(self._as_function())
         collector = _TuneCollector.remote()
 
+        from ray_tpu.tune.logger import TrialLoggers
+        from ray_tpu.tune.stopper import coerce_stopper
+
+        stopper = coerce_stopper(self.run_config.stop)
+        loggers = TrialLoggers()
+        search_alg = cfg.search_alg
+        if search_alg is not None and self._restored is None:
+            search_alg.set_search_space(self.param_space)
+
         max_conc = cfg.max_concurrent_trials or max(
             1, int(ray_tpu.cluster_resources().get("CPU", 1))
         )
@@ -209,7 +218,12 @@ class Tuner:
                     trials[tid]["state"] = "PENDING"
                     queue.append(tid)
         else:
-            variants = generate_variants(self.param_space, cfg.num_samples, cfg.seed)
+            if search_alg is not None:
+                # configs are suggested lazily at launch time so later trials
+                # benefit from earlier results (sequential model-based search)
+                variants = [None] * cfg.num_samples
+            else:
+                variants = generate_variants(self.param_space, cfg.num_samples, cfg.seed)
             for i, variant in enumerate(variants):
                 tid = f"trial_{i:05d}_{uuid.uuid4().hex[:4]}"
                 trials[tid] = {
@@ -232,6 +246,8 @@ class Tuner:
 
         def launch(tid):
             t = trials[tid]
+            if t["config"] is None:
+                t["config"] = search_alg.suggest(tid)
             os.makedirs(t["dir"], exist_ok=True)
             actor = _TrialActor.remote(tid, t["dir"])
             ref = actor.run.remote(fn_blob, t["config"], collector, t.get("resume_from"))
@@ -269,12 +285,37 @@ class Tuner:
                 t["iteration"] = iteration
                 if ckpt_path:
                     t["checkpoint"] = Checkpoint(ckpt_path)
+                logged = {**metrics, "training_iteration": iteration,
+                          "trial_id": tid}
+                loggers.log_result(tid, t["dir"], logged)
                 verdict = scheduler.on_result(tid, iteration, metrics)
+                if stopper is not None and verdict == CONTINUE and stopper(tid, logged):
+                    verdict = STOP
+                    if stopper.stop_all():
+                        # stop every other live trial too
+                        for otid, ot in trials.items():
+                            if otid != tid and ot["state"] == "RUNNING":
+                                ot["state"] = "STOPPED"
+                                if ot["actor"] is not None:
+                                    ray_tpu.kill(ot["actor"])
+                                running.pop(ot["ref"], None)
+                                if search_alg is not None:
+                                    search_alg.on_trial_complete(
+                                        otid, ot["last_metrics"]
+                                    )
+                        # queued trials never ran: drop them (lazily-suggested
+                        # ones have no config yet and would surface as phantom
+                        # empty rows in the ResultGrid)
+                        for qtid in queue:
+                            trials.pop(qtid, None)
+                        queue.clear()
                 if verdict == STOP:
                     t["state"] = "STOPPED"
                     if t["actor"] is not None:
                         ray_tpu.kill(t["actor"])
                     running.pop(t["ref"], None)
+                    if search_alg is not None:
+                        search_alg.on_trial_complete(tid, t["last_metrics"])
                 elif verdict == "EXPLOIT":
                     exploit(tid)
             for ref in ready:
@@ -296,6 +337,8 @@ class Tuner:
                     t["error"] = e
                 if t["actor"] is not None and t["state"] != "STOPPED":
                     ray_tpu.kill(t["actor"])
+                if search_alg is not None and t["state"] in ("TERMINATED", "ERROR"):
+                    search_alg.on_trial_complete(tid, t["last_metrics"])
             now = time.monotonic()
             if now - last_snap > 2.0:
                 last_snap = now
@@ -307,6 +350,7 @@ class Tuner:
             exp_dir, trials, fn_blob, self.param_space,
             self.tune_config, self.run_config,
         )
+        loggers.close()
 
         results = []
         for tid, t in trials.items():

@@ -256,3 +256,58 @@ def test_lm_generation_deployment(serve_cluster):
     out = handle.generate.remote([1, 2, 3, 4], max_new_tokens=4).result(timeout_s=120)
     assert len(out["tokens"]) == 4
     assert all(isinstance(t, int) for t in out["tokens"])
+
+
+def _repo_root_on_path():
+    import os
+    import sys
+
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+def test_build_and_deploy_config(serve_cluster, tmp_path):
+    """serve.build -> yaml -> deploy_config_file round trip with overrides."""
+    _repo_root_on_path()
+    from examples.serve_config_app import app
+
+    config = serve.build(
+        app, name="cfgapp", import_path="examples.serve_config_app:app"
+    )
+    names = [d["name"] for d in config["applications"][0]["deployments"]]
+    assert set(names) == {"Doubler", "Ingress"}
+    # override replica count through the config
+    for d in config["applications"][0]["deployments"]:
+        if d["name"] == "Doubler":
+            d["num_replicas"] = 2
+    path = str(tmp_path / "serve.yaml")
+    serve.dump_config(config, path)
+
+    handles = serve.deploy_config_file(path)
+    assert serve.status()["cfgapp"]["Doubler"]["num_replicas"] == 2
+    assert handles["cfgapp"].remote(20).result(timeout_s=60) == 41
+    serve.delete("cfgapp")
+
+
+def test_serve_cli_status_and_build(serve_cluster, tmp_path, capsys):
+    _repo_root_on_path()
+    from examples.serve_config_app import app as _app  # noqa: F401
+    from ray_tpu.scripts.cli import main
+
+    out = str(tmp_path / "out.yaml")
+    main(["serve", "build", "examples.serve_config_app:app",
+          "--name", "cliapp", "-o", out])
+    import yaml
+
+    config = yaml.safe_load(open(out))
+    assert config["applications"][0]["import_path"] == "examples.serve_config_app:app"
+
+    main(["serve", "run", out])
+    main(["serve", "status"])
+    captured = capsys.readouterr().out
+    assert "cliapp" in captured
+    from ray_tpu.serve import get_app_handle
+
+    assert get_app_handle("cliapp").remote(1).result(timeout_s=60) == 3
+    serve.delete("cliapp")

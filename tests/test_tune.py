@@ -215,3 +215,86 @@ def test_tuner_restore_resumes_experiment(ray_start_regular, tmp_path):
     tags = sorted(r.metrics["tag"] for r in results)
     assert tags == [1, 2]  # both trials resumed and completed
     assert all(r.error is None for r in results)
+
+
+def test_stoppers_and_loggers(ray_start_regular, tmp_path):
+    import json
+    import os
+
+    def objective(config):
+        for i in range(50):
+            train.report({"score": i})
+
+    tuner = Tuner(
+        objective,
+        param_space={"a": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="stoptest",
+            stop=tune.MaximumIterationStopper(5),
+        ),
+    )
+    grid = tuner.fit()
+    assert all(r.metrics["training_iteration"] <= 6 for r in grid)
+    # result.json + progress.csv written into each trial dir
+    trial_dirs = [r.path for r in grid]
+    for d in trial_dirs:
+        lines = open(os.path.join(d, "result.json")).read().splitlines()
+        assert 1 <= len(lines) <= 6
+        assert "score" in json.loads(lines[0])
+        csv_text = open(os.path.join(d, "progress.csv")).read()
+        assert csv_text.startswith("score")
+
+
+def test_plateau_stopper(ray_start_regular, tmp_path):
+    def objective(config):
+        for i in range(40):
+            train.report({"loss": 1.0 if i > 5 else 10.0 - i})
+
+    grid = Tuner(
+        objective,
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            stop=tune.TrialPlateauStopper("loss", std=1e-6, num_results=4),
+        ),
+    ).fit()
+    assert grid[0].metrics["training_iteration"] < 40
+
+
+def test_dict_stop_criteria(ray_start_regular, tmp_path):
+    def objective(config):
+        for i in range(100):
+            train.report({"score": i})
+
+    grid = Tuner(
+        objective,
+        run_config=RunConfig(storage_path=str(tmp_path), stop={"score": 7}),
+    ).fit()
+    assert grid[0].metrics["score"] <= 8
+
+
+def test_bayesopt_beats_random_on_quadratic(ray_start_regular, tmp_path):
+    """GP search should concentrate samples near the optimum of a smooth
+    1-d objective and find a better best-value than coarse random search."""
+    from ray_tpu.tune import BayesOptSearch, bayesopt
+
+    def objective(config):
+        x = config["x"]
+        train.report({"neg_loss": -((x - 0.73) ** 2)})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": bayesopt.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(
+            num_samples=16,
+            max_concurrent_trials=1,  # sequential: each suggest sees history
+            search_alg=BayesOptSearch(metric="neg_loss", mode="max", seed=0,
+                                      n_initial_points=4),
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="neg_loss", mode="max")
+    assert best.metrics["neg_loss"] > -0.01  # within 0.1 of the optimum
+    assert abs(best.metrics["config"]["x"] - 0.73) < 0.1
