@@ -178,6 +178,21 @@ def timeline() -> List[dict]:
     events = rt.scheduler.task_events()
     out = []
     for e in events:
+        if e["type"] == "PROFILE":
+            # user span -> chrome "complete" event with a real duration
+            out.append(
+                {
+                    "cat": "PROFILE",
+                    "name": e["name"],
+                    "pid": e.get("pid", 1),
+                    "tid": (hash(e["task_id"]) % 1000),
+                    "ph": "X",
+                    "ts": e["time"] * 1e6,
+                    "dur": (e.get("duration_ms") or 0.0) * 1e3,
+                    "args": {"task_id": e["task_id"], **e.get("extra", {})},
+                }
+            )
+            continue
         out.append(
             {
                 "cat": e["type"],

@@ -1,0 +1,60 @@
+"""User-annotated profile spans for the task timeline.
+
+Parity: ``ray._private.profiling.profile`` (``profiling.py:84``) →
+``TaskEventBuffer`` (``src/ray/core_worker/task_event_buffer.h:206``) → GCS
+``GcsTaskManager``: code inside tasks/actors wraps hot sections in
+``with profile("name"):`` and the spans appear in ``ray_tpu.timeline()``
+alongside task state events (chrome://tracing "X" complete events).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+_local = threading.local()
+
+
+@contextlib.contextmanager
+def profile(event_name: str, extra_data: dict | None = None):
+    """Record a timed span from inside a task, actor method, or the driver."""
+    start = time.time()
+    try:
+        yield
+    finally:
+        end = time.time()
+        span = {
+            "event": str(event_name),
+            "start": start,
+            "end": end,
+            "duration_ms": (end - start) * 1e3,
+            "pid": os.getpid(),
+            "extra": dict(extra_data or {}),
+        }
+        _emit(span)
+
+
+def _emit(span: dict) -> None:
+    from ray_tpu._private import worker as worker_mod
+
+    rt = None
+    try:
+        rt = worker_mod.get_runtime()
+    except Exception:  # not connected: drop silently, profiling is best-effort
+        return
+    if rt is None:
+        return
+    tid = getattr(rt, "current_task_id", None)
+    if callable(tid):  # DriverRuntime exposes it as a method
+        tid = tid()
+    span["task_id"] = tid.hex() if tid is not None else None
+    try:
+        scheduler = getattr(rt, "scheduler", None)
+        if scheduler is not None:  # local driver: post straight to the loop
+            scheduler.post(("profile_event", span))
+        else:  # worker / remote driver: ride the command pipe
+            rt._send(("cmd", ("profile_event", span)))
+    except Exception:  # dead pipe during shutdown
+        pass

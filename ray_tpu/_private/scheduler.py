@@ -781,6 +781,24 @@ class Scheduler:
         kind = cmd[0]
         if kind == "submit":
             self._on_submit(cmd[1])
+        elif kind == "profile_event":
+            # user-annotated span (profiling.profile); joins the task event
+            # log so ray_tpu.timeline() shows it (TaskEventBuffer role)
+            span = cmd[1]
+            self._task_events.append(
+                {
+                    "task_id": span.get("task_id"),
+                    "name": span.get("event", "span"),
+                    "type": "PROFILE",
+                    "state": "PROFILE",
+                    "time": span.get("start", time.time()),
+                    "end_time": span.get("end"),
+                    "duration_ms": span.get("duration_ms"),
+                    "pid": span.get("pid"),
+                    "extra": span.get("extra", {}),
+                    "actor_id": None,
+                }
+            )
         elif kind == "put_done":
             if cmd[2][0] == "stored":
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
@@ -1741,6 +1759,52 @@ class Scheduler:
             return False
         if op == "object_locations":
             return [n.hex() for n in self._object_locations.get(args[0], set())]
+        if op == "call_actor":
+            # Frontend-agnostic actor invocation (no Python pickled callables
+            # required from the caller) — the entry point for the C++ API
+            # frontend (parity role: ``cpp/src/ray/runtime/task/``). args_blob
+            # is a plain-pickled tuple of positional arguments.
+            ns, name, method, args_blob = args
+            actor_id = self.gcs.named_actors.get((ns or "default", name))
+            if actor_id is None:
+                raise ValueError(f"no actor named '{name}' in namespace '{ns}'")
+            import cloudpickle as _cp
+            import pickle as _pkl
+
+            call_args = _pkl.loads(args_blob) if args_blob else ()
+            st = self.actors.get(actor_id)
+            spec = TaskSpec(
+                task_id=TaskID.for_task(actor_id),
+                task_type=TaskType.ACTOR_TASK,
+                function=_cp.dumps(method),
+                args=[Arg(value=v) for v in call_args],
+                kwargs={},
+                num_returns=1,
+                resources={},
+                name=method,
+                actor_id=actor_id,
+                max_task_retries=st.max_task_retries if st else 0,
+            )
+            self._on_submit(spec)
+            return spec.return_ids()[0].binary()
+        if op == "get_object_blob":
+            # Small-object fetch over the control socket (C++ frontend get):
+            # returns ("ok", bytes) | ("err", bytes) | None if not ready yet.
+            oid = args[0] if isinstance(args[0], ObjectID) else ObjectID(args[0])
+            entry = self.memory_store.get_entry(oid)
+            if entry is None:
+                return None
+            if entry[0] == "inline":
+                return ("ok", bytes(entry[1]))
+            if entry[0] == "error":
+                return ("err", bytes(entry[1]))
+            store = self._node.store_client
+            if store is not None and store.contains(oid):
+                view = store.get(oid)
+                if view is not None:
+                    return ("ok", bytes(view))
+            self._ensure_local(oid, self._node.head_node_id)
+            return None
         if op == "event_stats":
             # parity: event_stats.h handler instrumentation
             return {

@@ -298,6 +298,10 @@ class DriverRuntime:
 
     def shutdown(self):
         self.closed = True
+        from ray_tpu._private import usage
+
+        if usage.usage_stats_enabled():
+            usage.write_usage_report(self.node.session_dir)
         self.node.shutdown()
 
 

@@ -366,7 +366,23 @@ class WorkerRuntime:
     def execute(self, spec: TaskSpec) -> List[Tuple]:
         self.current_task_id = spec.task_id
         saved_env = {}
+        trace_ctx = None
+        span_cm = None
+        from ray_tpu.util import tracing as _tracing
+
         try:
+            # adopt the caller's trace context (span tree across processes;
+            # parity: tracing_helper extract on the execution side). Inside
+            # the try: a malformed user-supplied _trace_ctx must surface as a
+            # TaskError, like any other runtime_env failure.
+            trace_ctx = _tracing.extract_and_activate(spec.runtime_env)
+            if trace_ctx is not None:
+                from ray_tpu._private import profiling as _prof
+
+                span_cm = _prof.profile(
+                    f"task:{spec.name}", extra_data=trace_ctx.to_dict()
+                )
+                span_cm.__enter__()
             # inside the try: a runtime_env setup failure (missing package,
             # bad zip, rpc timeout) must surface as a TaskError, not kill the
             # worker loop (parity: RuntimeEnvSetupError)
@@ -430,6 +446,10 @@ class WorkerRuntime:
                 blob = pickle.dumps(err)
             return [("error", blob)] * max(1, spec.num_returns)
         finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+            if trace_ctx is not None:
+                _tracing.deactivate()
             if saved_env:
                 self._restore_env(saved_env)
             self.current_task_id = None

@@ -1,0 +1,915 @@
+// Implementation of the C++ API frontend (see ray_tpu_client.h).
+//
+// Wire stack, bottom-up:
+//   1. TCP socket.
+//   2. multiprocessing.connection framing: !i length prefix (-1 sentinel +
+//      !Q for >2**31-1 payloads).
+//   3. Challenge auth (CPython 3.12 scheme): both sides exchange
+//      b"#CHALLENGE#{digest}<random>" and answer with
+//      b"{digest}" + HMAC(authkey, challenge-after-prefix). SHA-256 based.
+//   4. Messages: pickled Python tuples. A minimal pickler (protocol 3) emits
+//      requests; a minimal unpickler decodes the reply subset (protocol 4/5
+//      opcodes observed from CPython's default pickler).
+
+#include "ray_tpu_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+#include <thread>
+
+namespace ray_tpu {
+
+// ---------------------------------------------------------------------------
+// SHA-256 + HMAC (FIPS 180-4 / RFC 2104; public standard algorithms)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(init));
+  }
+
+  static uint32_t Rot(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void Block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+        0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+        0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+        0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+        0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+        0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+        0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+        0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+        0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = Rot(w[i - 15], 7) ^ Rot(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rot(w[i - 2], 17) ^ Rot(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t s1 = Rot(e, 6) ^ Rot(e, 11) ^ Rot(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = Rot(a, 2) ^ Rot(a, 13) ^ Rot(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = std::min(n, sizeof(buf) - buflen);
+      memcpy(buf + buflen, p, take);
+      buflen += take;
+      p += take;
+      n -= take;
+      if (buflen == 64) {
+        Block(buf);
+        buflen = 0;
+      }
+    }
+  }
+
+  void Final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (buflen != 56) Update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    Update(lenb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+std::string HmacSha256(const std::string& key, const std::string& msg) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.Update(reinterpret_cast<const uint8_t*>(key.data()), key.size());
+    kh.Final(k);
+  } else {
+    memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  Sha256 inner;
+  inner.Update(ipad, 64);
+  inner.Update(reinterpret_cast<const uint8_t*>(msg.data()), msg.size());
+  uint8_t ih[32];
+  inner.Final(ih);
+  Sha256 outer;
+  outer.Update(opad, 64);
+  outer.Update(ih, 32);
+  uint8_t oh[32];
+  outer.Final(oh);
+  return std::string(reinterpret_cast<char*>(oh), 32);
+}
+
+bool ConstantTimeEq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); i++) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+// ---------------------------------------------------------------------------
+// Mini pickler (protocol 3 requests; loadable by any modern CPython)
+// ---------------------------------------------------------------------------
+
+class Pickler {
+ public:
+  Pickler() { out_ += "\x80\x03"; }  // PROTO 3
+
+  void None() { out_ += 'N'; }
+  void Bool(bool v) { out_ += v ? '\x88' : '\x89'; }  // NEWTRUE/NEWFALSE
+
+  void Int(int64_t v) {
+    if (v >= 0 && v < 256) {
+      out_ += 'K';  // BININT1
+      out_ += char(uint8_t(v));
+    } else if (v >= INT32_MIN && v <= INT32_MAX) {
+      out_ += 'J';  // BININT (4-byte LE signed)
+      AppendLE32(uint32_t(int32_t(v)));
+    } else {
+      out_ += '\x8a';  // LONG1
+      uint8_t bytes[9];
+      int n = 0;
+      uint64_t uv = uint64_t(v);
+      // two's-complement little-endian, minimal width
+      for (n = 1; n <= 8; n++) {
+        int64_t trunc = int64_t(uv << (64 - 8 * n)) >> (64 - 8 * n);
+        if (trunc == v) break;
+      }
+      out_ += char(uint8_t(n));
+      for (int i = 0; i < n; i++) bytes[i] = uint8_t(uv >> (8 * i));
+      out_.append(reinterpret_cast<char*>(bytes), n);
+    }
+  }
+
+  void Float(double v) {
+    out_ += 'G';  // BINFLOAT: big-endian double
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    for (int i = 7; i >= 0; i--) out_ += char(uint8_t(bits >> (8 * i)));
+  }
+
+  void Str(const std::string& s) {
+    out_ += 'X';  // BINUNICODE (utf-8, 4-byte LE length)
+    AppendLE32(uint32_t(s.size()));
+    out_ += s;
+  }
+
+  void Bytes(const std::string& b) {
+    if (b.size() < 256) {
+      out_ += 'C';  // SHORT_BINBYTES
+      out_ += char(uint8_t(b.size()));
+    } else {
+      out_ += 'B';  // BINBYTES
+      AppendLE32(uint32_t(b.size()));
+    }
+    out_ += b;
+  }
+
+  void Mark() { out_ += '('; }
+  void Tuple() { out_ += 't'; }    // from mark
+  void Tuple1() { out_ += '\x85'; }
+  void Tuple2() { out_ += '\x86'; }
+  void Tuple3() { out_ += '\x87'; }
+  void EmptyTuple() { out_ += ')'; }
+
+  // GLOBAL ray_tpu._private.ids ObjectID ; TUPLE1(bytes) ; REDUCE
+  void ObjectId(const std::string& bin) {
+    out_ += 'c';
+    out_ += "ray_tpu._private.ids\nObjectID\n";
+    Bytes(bin);
+    Tuple1();
+    out_ += 'R';  // REDUCE
+  }
+
+  void Value(const PyValue& v) {
+    switch (v.kind) {
+      case PyValue::Kind::kNone: None(); break;
+      case PyValue::Kind::kBool: Bool(v.b); break;
+      case PyValue::Kind::kInt: Int(v.i); break;
+      case PyValue::Kind::kFloat: Float(v.f); break;
+      case PyValue::Kind::kStr: Str(v.s); break;
+      case PyValue::Kind::kBytes: Bytes(v.s); break;
+      case PyValue::Kind::kTuple:
+      case PyValue::Kind::kList: {
+        Mark();
+        for (const auto& it : v.items) Value(it);
+        if (v.kind == PyValue::Kind::kTuple) {
+          Tuple();
+        } else {
+          out_ += 'l';  // LIST from mark
+        }
+        break;
+      }
+      case PyValue::Kind::kDict: {
+        out_ += '}';  // EMPTY_DICT
+        Mark();
+        for (const auto& kv : v.dict) {
+          Value(kv.first);
+          Value(kv.second);
+        }
+        out_ += 'u';  // SETITEMS
+        break;
+      }
+      case PyValue::Kind::kObject:
+        throw std::runtime_error("cannot pickle opaque object value");
+    }
+  }
+
+  std::string Finish() {
+    std::string r = out_;
+    r += '.';  // STOP
+    return r;
+  }
+
+ private:
+  void AppendLE32(uint32_t v) {
+    for (int i = 0; i < 4; i++) out_ += char(uint8_t(v >> (8 * i)));
+  }
+  std::string out_;
+};
+
+// ---------------------------------------------------------------------------
+// Mini unpickler: the opcode subset CPython's default pickler emits for the
+// tuples/dicts/bytes/str/num replies this protocol carries.
+// ---------------------------------------------------------------------------
+
+class Unpickler {
+ public:
+  explicit Unpickler(const std::string& data) : d_(data) {}
+
+  PyValue Load() {
+    while (true) {
+      if (pos_ >= d_.size()) throw std::runtime_error("pickle truncated");
+      uint8_t op = uint8_t(d_[pos_++]);
+      switch (op) {
+        case 0x80: pos_ += 1; break;                      // PROTO
+        case 0x95: pos_ += 8; break;                      // FRAME
+        case '.':                                          // STOP
+          if (stack_.empty()) throw std::runtime_error("empty pickle stack");
+          return stack_.back();
+        case 'N': Push(PyValue::None()); break;           // NONE
+        case 0x88: Push(PyValue::Bool(true)); break;      // NEWTRUE
+        case 0x89: Push(PyValue::Bool(false)); break;     // NEWFALSE
+        case 'K': Push(PyValue::Int(U8())); break;        // BININT1
+        case 'M': Push(PyValue::Int(U16())); break;       // BININT2
+        case 'J': Push(PyValue::Int(int32_t(U32()))); break;  // BININT
+        case 0x8a: {                                      // LONG1
+          size_t n = U8();
+          int64_t v = 0;
+          for (size_t i = 0; i < n; i++)
+            v |= int64_t(uint8_t(Next())) << (8 * i);
+          if (n > 0 && n < 8 && (uint8_t(d_[pos_ - 1]) & 0x80))
+            v |= int64_t(~uint64_t(0) << (8 * n));  // sign-extend
+          Push(PyValue::Int(v));
+          break;
+        }
+        case 'G': {                                       // BINFLOAT (BE)
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; i++) bits = (bits << 8) | uint8_t(Next());
+          double v;
+          memcpy(&v, &bits, 8);
+          Push(PyValue::Float(v));
+          break;
+        }
+        case 0x8c: Push(PyValue::Str(Take(U8()))); break;     // SHORT_BINUNICODE
+        case 'X': Push(PyValue::Str(Take(U32()))); break;     // BINUNICODE
+        case 'C': Push(PyValue::Bytes(Take(U8()))); break;    // SHORT_BINBYTES
+        case 'B': Push(PyValue::Bytes(Take(U32()))); break;   // BINBYTES
+        case 0x8e: Push(PyValue::Bytes(Take(U64()))); break;  // BINBYTES8
+        case ')': PushTuple(0); break;                    // EMPTY_TUPLE
+        case 0x85: PushTuple(1); break;                   // TUPLE1
+        case 0x86: PushTuple(2); break;                   // TUPLE2
+        case 0x87: PushTuple(3); break;                   // TUPLE3
+        case '(': marks_.push_back(stack_.size()); break; // MARK
+        case 't': {                                       // TUPLE
+          size_t m = PopMark();
+          PyValue t;
+          t.kind = PyValue::Kind::kTuple;
+          t.items.assign(stack_.begin() + m, stack_.end());
+          stack_.resize(m);
+          Push(std::move(t));
+          break;
+        }
+        case ']': {                                       // EMPTY_LIST
+          PyValue l;
+          l.kind = PyValue::Kind::kList;
+          Push(std::move(l));
+          break;
+        }
+        case 'e': {                                       // APPENDS
+          size_t m = PopMark();
+          auto& list = stack_[m - 1];
+          for (size_t i = m; i < stack_.size(); i++)
+            list.items.push_back(stack_[i]);
+          stack_.resize(m);
+          break;
+        }
+        case 'a': {                                       // APPEND
+          PyValue v = Pop();
+          stack_.back().items.push_back(std::move(v));
+          break;
+        }
+        case '}': {                                       // EMPTY_DICT
+          PyValue d;
+          d.kind = PyValue::Kind::kDict;
+          Push(std::move(d));
+          break;
+        }
+        case 'u': {                                       // SETITEMS
+          size_t m = PopMark();
+          auto& dict = stack_[m - 1];
+          for (size_t i = m; i + 1 < stack_.size(); i += 2)
+            dict.dict.emplace_back(stack_[i], stack_[i + 1]);
+          stack_.resize(m);
+          break;
+        }
+        case 's': {                                       // SETITEM
+          PyValue v = Pop();
+          PyValue k = Pop();
+          stack_.back().dict.emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 0x94:                                        // MEMOIZE
+          memo_.push_back(stack_.back());
+          break;
+        case 'q': memo_put(U8()); break;                  // BINPUT
+        case 'r': memo_put(U32()); break;                 // LONG_BINPUT
+        case 'h': Push(memo_.at(U8())); break;            // BINGET
+        case 'j': Push(memo_.at(U32())); break;           // LONG_BINGET
+        case 0x93: {                                      // STACK_GLOBAL
+          PyValue name = Pop();
+          PyValue mod = Pop();
+          PyValue o;
+          o.kind = PyValue::Kind::kObject;
+          o.repr = mod.s + "." + name.s;
+          Push(std::move(o));
+          break;
+        }
+        case 'c': {                                       // GLOBAL
+          std::string mod = Line(), name = Line();
+          PyValue o;
+          o.kind = PyValue::Kind::kObject;
+          o.repr = mod + "." + name;
+          Push(std::move(o));
+          break;
+        }
+        case 'R': {                                       // REDUCE
+          PyValue args = Pop();
+          PyValue callee = Pop();
+          PyValue o;
+          o.kind = PyValue::Kind::kObject;
+          o.repr = callee.repr + "(";
+          for (size_t i = 0; i < args.items.size(); i++) {
+            if (i) o.repr += ", ";
+            const auto& a = args.items[i];
+            if (a.kind == PyValue::Kind::kStr) o.repr += a.s;
+            else if (a.kind == PyValue::Kind::kInt)
+              o.repr += std::to_string(a.i);
+            else o.repr += "...";
+          }
+          o.repr += ")";
+          Push(std::move(o));
+          break;
+        }
+        case 'b': {                                       // BUILD
+          Pop();  // state: drop, keep the object summary
+          break;
+        }
+        case 0x81: {                                      // NEWOBJ
+          PyValue args = Pop();
+          PyValue cls = Pop();
+          PyValue o;
+          o.kind = PyValue::Kind::kObject;
+          o.repr = cls.repr + "(...)";
+          (void)args;
+          Push(std::move(o));
+          break;
+        }
+        default:
+          throw std::runtime_error("unsupported pickle opcode " +
+                                   std::to_string(int(op)));
+      }
+    }
+  }
+
+ private:
+  char Next() {
+    if (pos_ >= d_.size()) throw std::runtime_error("pickle truncated");
+    return d_[pos_++];
+  }
+  uint64_t U8() { return uint8_t(Next()); }
+  uint64_t U16() {
+    uint64_t v = U8();
+    return v | (U8() << 8);
+  }
+  uint64_t U32() {
+    uint64_t v = 0;
+    for (int i = 0; i < 4; i++) v |= U8() << (8 * i);
+    return v;
+  }
+  uint64_t U64() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= U8() << (8 * i);
+    return v;
+  }
+  std::string Take(size_t n) {
+    if (pos_ + n > d_.size()) throw std::runtime_error("pickle truncated");
+    std::string s = d_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  std::string Line() {
+    std::string s;
+    while (true) {
+      char c = Next();
+      if (c == '\n') return s;
+      s += c;
+    }
+  }
+  void Push(PyValue v) { stack_.push_back(std::move(v)); }
+  PyValue Pop() {
+    PyValue v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  void PushTuple(size_t n) {
+    PyValue t;
+    t.kind = PyValue::Kind::kTuple;
+    t.items.assign(stack_.end() - n, stack_.end());
+    stack_.resize(stack_.size() - n);
+    Push(std::move(t));
+  }
+  size_t PopMark() {
+    size_t m = marks_.back();
+    marks_.pop_back();
+    return m;
+  }
+  void memo_put(size_t idx) {
+    if (memo_.size() <= idx) memo_.resize(idx + 1);
+    memo_[idx] = stack_.back();
+  }
+
+  const std::string& d_;
+  size_t pos_ = 0;
+  std::vector<PyValue> stack_;
+  std::vector<size_t> marks_;
+  std::vector<PyValue> memo_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// PyValue helpers
+// ---------------------------------------------------------------------------
+
+PyValue PyValue::None() { return PyValue{}; }
+PyValue PyValue::Bool(bool v) {
+  PyValue p;
+  p.kind = Kind::kBool;
+  p.b = v;
+  return p;
+}
+PyValue PyValue::Int(int64_t v) {
+  PyValue p;
+  p.kind = Kind::kInt;
+  p.i = v;
+  return p;
+}
+PyValue PyValue::Float(double v) {
+  PyValue p;
+  p.kind = Kind::kFloat;
+  p.f = v;
+  return p;
+}
+PyValue PyValue::Str(std::string v) {
+  PyValue p;
+  p.kind = Kind::kStr;
+  p.s = std::move(v);
+  return p;
+}
+PyValue PyValue::Bytes(std::string v) {
+  PyValue p;
+  p.kind = Kind::kBytes;
+  p.s = std::move(v);
+  return p;
+}
+const PyValue* PyValue::DictGet(const std::string& key) const {
+  for (const auto& kv : dict)
+    if (kv.first.kind == Kind::kStr && kv.first.s == key) return &kv.second;
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Client impl
+// ---------------------------------------------------------------------------
+
+struct Client::Impl {
+  int fd = -1;
+  std::string auth_key;
+  int rpc_seq = 0;
+  uint32_t put_counter = 0;
+  std::string driver_task_id;  // 24 bytes: synthesized driver task id
+
+  bool SendAll(const char* p, size_t n, std::string* err) {
+    while (n) {
+      ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (w <= 0) {
+        *err = "socket send failed";
+        return false;
+      }
+      p += w;
+      n -= size_t(w);
+    }
+    return true;
+  }
+
+  bool RecvAll(char* p, size_t n, std::string* err) {
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) {
+        *err = "socket recv failed / closed";
+        return false;
+      }
+      p += r;
+      n -= size_t(r);
+    }
+    return true;
+  }
+
+  bool SendFrame(const std::string& payload, std::string* err) {
+    if (payload.size() > 0x7fffffffULL) {
+      char hdr[12];
+      int32_t neg = -1;
+      uint32_t nbe = htonl(uint32_t(neg));
+      memcpy(hdr, &nbe, 4);
+      uint64_t n = payload.size();
+      for (int i = 0; i < 8; i++) hdr[4 + i] = char(uint8_t(n >> (56 - 8 * i)));
+      if (!SendAll(hdr, 12, err)) return false;
+    } else {
+      uint32_t nbe = htonl(uint32_t(payload.size()));
+      char hdr[4];
+      memcpy(hdr, &nbe, 4);
+      if (!SendAll(hdr, 4, err)) return false;
+    }
+    return SendAll(payload.data(), payload.size(), err);
+  }
+
+  bool RecvFrame(std::string* payload, std::string* err) {
+    char hdr[4];
+    if (!RecvAll(hdr, 4, err)) return false;
+    uint32_t nbe;
+    memcpy(&nbe, hdr, 4);
+    int64_t n = int32_t(ntohl(nbe));
+    if (n == -1) {
+      char hdr8[8];
+      if (!RecvAll(hdr8, 8, err)) return false;
+      n = 0;
+      for (int i = 0; i < 8; i++) n = (n << 8) | uint8_t(hdr8[i]);
+    }
+    payload->resize(size_t(n));
+    return RecvAll(payload->data(), size_t(n), err);
+  }
+
+  // CPython 3.12 answer_challenge + deliver_challenge (mutual auth).
+  bool Authenticate(std::string* err) {
+    const std::string kChallenge = "#CHALLENGE#";
+    const std::string kWelcome = "#WELCOME#";
+    std::string msg;
+    if (!RecvFrame(&msg, err)) return false;
+    if (msg.rfind(kChallenge, 0) != 0) {
+      *err = "protocol error: expected challenge";
+      return false;
+    }
+    std::string challenge = msg.substr(kChallenge.size());
+    // challenge is b"{digest}<random>"; MAC covers the whole remainder
+    std::string digest_name = "md5";
+    if (!challenge.empty() && challenge[0] == '{') {
+      size_t close = challenge.find('}');
+      if (close != std::string::npos)
+        digest_name = challenge.substr(1, close - 1);
+    }
+    if (digest_name != "sha256") {
+      *err = "unsupported auth digest " + digest_name +
+             " (this client implements sha256)";
+      return false;
+    }
+    std::string mac = HmacSha256(auth_key, challenge);
+    if (!SendFrame("{sha256}" + mac, err)) return false;
+    std::string resp;
+    if (!RecvFrame(&resp, err)) return false;
+    if (resp != kWelcome) {
+      *err = "authentication rejected";
+      return false;
+    }
+    // Now the client challenges the server.
+    std::string rnd(32, '\0');
+    std::random_device rd;
+    for (auto& c : rnd) c = char(rd() & 0xff);
+    std::string my_challenge = "{sha256}" + rnd;
+    if (!SendFrame(kChallenge + my_challenge, err)) return false;
+    std::string answer;
+    if (!RecvFrame(&answer, err)) return false;
+    std::string expect = HmacSha256(auth_key, my_challenge);
+    std::string got = answer;
+    if (got.rfind("{sha256}", 0) == 0) got = got.substr(8);
+    if (!ConstantTimeEq(expect, got)) {
+      SendFrame("#FAILURE#", err);
+      *err = "server failed our challenge";
+      return false;
+    }
+    return SendFrame(kWelcome, err);
+  }
+
+  bool SendMsg(const std::string& pickled, std::string* err) {
+    return SendFrame(pickled, err);
+  }
+
+  bool RecvMsg(PyValue* out, std::string* err) {
+    std::string payload;
+    if (!RecvFrame(&payload, err)) return false;
+    try {
+      Unpickler u(payload);
+      *out = u.Load();
+    } catch (const std::exception& e) {
+      *err = std::string("unpickle failed: ") + e.what();
+      return false;
+    }
+    return true;
+  }
+};
+
+Client::Client() : impl_(new Impl) {}
+Client::~Client() { Close(); }
+
+bool Client::connected() const { return impl_->fd >= 0; }
+
+void Client::Close() {
+  if (impl_->fd >= 0) {
+    ::close(impl_->fd);
+    impl_->fd = -1;
+  }
+}
+
+bool Client::Connect(const std::string& host, int port,
+                     const std::string& auth_key, std::string* error) {
+  impl_->auth_key = auth_key;
+  struct addrinfo hints {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+    *error = "getaddrinfo failed for " + host;
+    return false;
+  }
+  impl_->fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (impl_->fd < 0 ||
+      ::connect(impl_->fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    Close();
+    *error = "connect failed to " + host + ":" + port_s;
+    return false;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(impl_->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (!impl_->Authenticate(error)) {
+    Close();
+    return false;
+  }
+  // register_driver handshake
+  Pickler p;
+  p.Mark();
+  p.Str("register_driver");
+  p.Int(int64_t(::getpid()));
+  p.Tuple();
+  if (!impl_->SendMsg(p.Finish(), error)) return false;
+  PyValue reply;
+  if (!impl_->RecvMsg(&reply, error)) return false;
+  if (reply.kind != PyValue::Kind::kTuple || reply.items.size() != 2 ||
+      reply.items[0].s != "driver_registered") {
+    *error = "unexpected handshake reply";
+    Close();
+    return false;
+  }
+  // synthesize this driver's put namespace: TaskID.for_driver(random job)
+  std::random_device rd;
+  std::string task_id(8, '\0');
+  for (auto& c : task_id) c = char(rd() & 0xff);
+  task_id += std::string(12, '\0');                  // ActorID zero-unique part
+  for (int i = 0; i < 4; i++) task_id += char(rd() & 0xff);  // JobID
+  impl_->driver_task_id = task_id;
+  return true;
+}
+
+bool Client::Rpc(const std::string& op, const std::vector<PyValue>& args,
+                 PyValue* result, std::string* error) {
+  int req_id = impl_->rpc_seq++;
+  Pickler p;
+  p.Mark();
+  p.Str("rpc");
+  p.Int(req_id);
+  p.Str(op);
+  {
+    p.Mark();
+    for (const auto& a : args) p.Value(a);
+    p.Tuple();
+  }
+  p.Tuple();
+  if (!impl_->SendMsg(p.Finish(), error)) return false;
+  // replies are ordered per connection for a client that only issues rpcs
+  PyValue reply;
+  while (true) {
+    if (!impl_->RecvMsg(&reply, error)) return false;
+    if (reply.kind == PyValue::Kind::kTuple && reply.items.size() >= 3 &&
+        reply.items[0].kind == PyValue::Kind::kStr &&
+        reply.items[0].s == "rpc_reply" &&
+        reply.items[1].i == req_id) {
+      break;
+    }
+    // ignore unrelated pushed messages (log lines etc.)
+  }
+  *result = reply.items[2];
+  if (result->kind == PyValue::Kind::kObject) {
+    *error = "rpc " + op + " raised: " + result->repr;
+    return false;
+  }
+  return true;
+}
+
+bool Client::ClusterResources(std::map<std::string, double>* out,
+                              std::string* error) {
+  PyValue nodes;
+  if (!Rpc("list_nodes", {}, &nodes, error)) return false;
+  out->clear();
+  for (const auto& node : nodes.items) {
+    const PyValue* alive = node.DictGet("alive");
+    if (alive && alive->kind == PyValue::Kind::kBool && !alive->b) continue;
+    const PyValue* total = node.DictGet("total");
+    if (!total) continue;
+    for (const auto& kv : total->dict) {
+      double v = kv.second.kind == PyValue::Kind::kFloat ? kv.second.f
+                                                         : double(kv.second.i);
+      (*out)[kv.first.s] += v;
+    }
+  }
+  return true;
+}
+
+bool Client::Put(const PyValue& value, std::string* object_id,
+                 std::string* error) {
+  // ObjectID = driver task id + (2^31 + counter) LE
+  uint32_t index = 0x80000000u + impl_->put_counter++;
+  std::string oid = impl_->driver_task_id;
+  for (int i = 0; i < 4; i++) oid += char(uint8_t(index >> (8 * i)));
+  // serde blob: [u32 nbufs=0][u64 plen] + pickle(value)
+  Pickler vp;
+  vp.Value(value);
+  std::string pickled = vp.Finish();
+  std::string blob(12, '\0');
+  uint64_t plen = pickled.size();
+  for (int i = 0; i < 8; i++) blob[4 + i] = char(uint8_t(plen >> (8 * i)));
+  blob += pickled;
+  Pickler p;
+  p.Mark();
+  p.Str("put_object");
+  p.ObjectId(oid);
+  p.Bytes(blob);
+  p.Tuple();
+  if (!impl_->SendMsg(p.Finish(), error)) return false;
+  *object_id = oid;
+  return true;
+}
+
+bool Client::Get(const std::string& object_id, double timeout_s, PyValue* out,
+                 std::string* error) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  while (true) {
+    PyValue reply;
+    std::vector<PyValue> args{PyValue::Bytes(object_id)};
+    if (!Rpc("get_object_blob", args, &reply, error)) return false;
+    if (reply.kind == PyValue::Kind::kTuple && reply.items.size() == 2) {
+      const std::string& tag = reply.items[0].s;
+      const std::string& blob = reply.items[1].s;
+      if (tag == "err") {
+        // the payload is a serialized exception; surface its class summary
+        *error = "task failed";
+        if (blob.size() > 12) {
+          uint64_t plen = 0;
+          for (int i = 0; i < 8; i++)
+            plen |= uint64_t(uint8_t(blob[4 + i])) << (8 * i);
+          std::string pickled_err = blob.substr(12, plen);
+          try {
+            Unpickler u_err(pickled_err);
+            PyValue e = u_err.Load();
+            if (e.kind == PyValue::Kind::kObject)
+              *error = "task failed: " + e.repr;
+          } catch (...) {
+          }
+        }
+        return false;
+      }
+      if (blob.size() < 12) {
+        *error = "malformed object blob";
+        return false;
+      }
+      uint32_t nbufs = 0;
+      for (int i = 0; i < 4; i++) nbufs |= uint32_t(uint8_t(blob[i])) << (8 * i);
+      uint64_t plen = 0;
+      for (int i = 0; i < 8; i++)
+        plen |= uint64_t(uint8_t(blob[4 + i])) << (8 * i);
+      if (nbufs != 0) {
+        *error = "object has out-of-band buffers (numpy); unsupported in the "
+                 "C++ frontend";
+        return false;
+      }
+      std::string pickled = blob.substr(12, plen);
+      try {
+        Unpickler u(pickled);
+        *out = u.Load();
+      } catch (const std::exception& e) {
+        *error = std::string("object unpickle failed: ") + e.what();
+        return false;
+      }
+      return true;
+    }
+    if (std::chrono::steady_clock::now() > deadline) {
+      *error = "get timed out";
+      return false;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+}
+
+bool Client::CallActor(const std::string& name, const std::string& method,
+                       const std::vector<PyValue>& args,
+                       std::string* object_id, std::string* error,
+                       const std::string& ns) {
+  Pickler ap;
+  ap.Mark();
+  for (const auto& a : args) ap.Value(a);
+  ap.Tuple();
+  std::string args_blob = ap.Finish();
+  PyValue reply;
+  std::vector<PyValue> rpc_args{PyValue::Str(ns), PyValue::Str(name),
+                                PyValue::Str(method),
+                                PyValue::Bytes(args_blob)};
+  if (!Rpc("call_actor", rpc_args, &reply, error)) return false;
+  if (reply.kind != PyValue::Kind::kBytes || reply.s.size() != 28) {
+    *error = "call_actor returned unexpected value";
+    return false;
+  }
+  *object_id = reply.s;
+  return true;
+}
+
+}  // namespace ray_tpu

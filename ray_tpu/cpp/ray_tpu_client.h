@@ -1,0 +1,89 @@
+// C++ API frontend for the ray_tpu cluster.
+//
+// Parity role: the reference's C++ user API (`cpp/include/ray/api/*.h`,
+// `cpp/src/ray/runtime/`) — a third-language client of the cluster core.
+// This client speaks the head's native socket protocol directly
+// (multiprocessing.connection framing + HMAC-SHA256 challenge auth +
+// a pickled-tuple message encoding), registering as a remote driver the way
+// `ray_tpu.init(address=...)` does (`ray_tpu/_private/client.py`).
+//
+// Supported surface: cluster introspection, object put/get (bytes and
+// primitive values), named-actor method invocation (the `call_actor` RPC).
+// Task submission with C++ function payloads would require C++ workers and is
+// out of scope (the reference ships a full C++ worker runtime for that).
+
+#ifndef RAY_TPU_CPP_CLIENT_H_
+#define RAY_TPU_CPP_CLIENT_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+// A tagged union for the subset of Python values the wire protocol carries.
+struct PyValue {
+  enum class Kind { kNone, kBool, kInt, kFloat, kStr, kBytes, kTuple, kList,
+                    kDict, kObject };
+  Kind kind = Kind::kNone;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                       // kStr / kBytes payload
+  std::vector<PyValue> items;          // kTuple / kList
+  std::vector<std::pair<PyValue, PyValue>> dict;  // kDict
+  std::string repr;                    // kObject: "module.Name(...)" summary
+
+  static PyValue None();
+  static PyValue Bool(bool v);
+  static PyValue Int(int64_t v);
+  static PyValue Float(double v);
+  static PyValue Str(std::string v);
+  static PyValue Bytes(std::string v);
+  const PyValue* DictGet(const std::string& key) const;
+};
+
+class Client {
+ public:
+  Client();
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Connect + authenticate + register as a remote driver.
+  bool Connect(const std::string& host, int port, const std::string& auth_key,
+               std::string* error);
+  void Close();
+  bool connected() const;
+
+  // Aggregate {resource: total} over alive nodes (rpc "list_nodes").
+  bool ClusterResources(std::map<std::string, double>* out, std::string* error);
+
+  // Store a value in the cluster object store; returns the 28-byte object id.
+  bool Put(const PyValue& value, std::string* object_id, std::string* error);
+
+  // Fetch an object committed in the cluster (polls rpc "get_object_blob").
+  bool Get(const std::string& object_id, double timeout_s, PyValue* out,
+           std::string* error);
+
+  // Invoke `method` on the actor registered under `name`; returns the result
+  // object id (fetch it with Get).
+  bool CallActor(const std::string& name, const std::string& method,
+                 const std::vector<PyValue>& args, std::string* object_id,
+                 std::string* error,
+                 const std::string& ns = "default");
+
+  // Raw RPC escape hatch: op + already-pickled args tuple.
+  bool Rpc(const std::string& op, const std::vector<PyValue>& args,
+           PyValue* result, std::string* error);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace ray_tpu
+
+#endif  // RAY_TPU_CPP_CLIENT_H_

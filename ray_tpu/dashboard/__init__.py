@@ -64,6 +64,23 @@ def start_dashboard(port: int = 8765) -> int:
                     body = get_driver().rpc("event_stats")
                 elif self.path == "/api/timeline":
                     body = ray_tpu.timeline()
+                elif self.path.startswith("/api/profiler/start"):
+                    # device-trace capture (parity role: the reporter agent's
+                    # py-spy/memray profiling endpoints; on TPU the profile of
+                    # record is jax.profiler's XPlane trace)
+                    from urllib.parse import parse_qs, urlparse
+
+                    import jax
+
+                    q = parse_qs(urlparse(self.path).query)
+                    logdir = q.get("logdir", ["/tmp/ray_tpu_jax_trace"])[0]
+                    jax.profiler.start_trace(logdir)
+                    body = {"status": "tracing", "logdir": logdir}
+                elif self.path == "/api/profiler/stop":
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    body = {"status": "stopped"}
                 elif self.path == "/metrics":
                     from ray_tpu.util.metrics import prometheus_text
 

@@ -46,3 +46,8 @@ __all__ = [
     "read_parquet",
     "read_text",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("data")
+del _usage

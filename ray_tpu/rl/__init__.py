@@ -31,3 +31,8 @@ __all__ = [
     "register_env",
     "EnvSpec",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("rl")
+del _usage

@@ -51,3 +51,8 @@ __all__ = [
     "DeploymentResponse",
     "DeploymentResponseGenerator",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("serve")
+del _usage

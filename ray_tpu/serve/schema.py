@@ -21,15 +21,19 @@ _DEPLOYMENT_OVERRIDE_KEYS = (
 )
 
 
-def build(app, *, name: str = "default", route_prefix: Optional[str] = None,
-          import_path: Optional[str] = None) -> Dict[str, Any]:
+def build(app, *, import_path: str, name: str = "default",
+          route_prefix: Optional[str] = None) -> Dict[str, Any]:
     """Produce the declarative config for a bound application (parity:
-    ``serve build``). ``import_path`` should be "module:attr" pointing at the
-    bound app so ``deploy`` can re-import it."""
+    ``serve build``). ``import_path`` must be "module:attr" pointing at the
+    bound app — deploy re-imports it, so a config without one is undeployable."""
     from ray_tpu.serve.api import Application, _flatten_graph
 
     if not isinstance(app, Application):
         raise TypeError("serve.build expects a bound deployment (use .bind())")
+    if not import_path or ":" not in import_path:
+        raise ValueError(
+            f"import_path must be 'module:attribute', got {import_path!r}"
+        )
     specs, _ = _flatten_graph(app)
     deployments: List[Dict[str, Any]] = []
     for spec in specs:
@@ -43,7 +47,7 @@ def build(app, *, name: str = "default", route_prefix: Optional[str] = None,
         deployments.append(d)
     app_schema: Dict[str, Any] = {
         "name": name,
-        "import_path": import_path or "",
+        "import_path": import_path,
         "deployments": deployments,
     }
     if route_prefix is not None:

@@ -36,3 +36,8 @@ __all__ = [
     "get_context",
     "get_checkpoint",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("train")
+del _usage

@@ -53,3 +53,8 @@ __all__ = [
     "MedianStoppingRule",
     "PopulationBasedTraining",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("tune")
+del _usage

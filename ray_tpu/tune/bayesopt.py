@@ -113,6 +113,11 @@ class BayesOptSearch:
                 "BayesOptSearch needs at least one bayesopt.uniform/"
                 "loguniform/randint axis in param_space"
             )
+        # fresh model per fit(): Tuner.restore re-feeds finished trials via
+        # observe(), so carrying pickled observations would double-count
+        self._pending.clear()
+        self._X = []
+        self._y = []
 
     def _sample_passthrough(self) -> Dict[str, Any]:
         out = {}
@@ -173,4 +178,19 @@ class BayesOptSearch:
         if u is None or not result or self.metric not in result:
             return
         self._X.append(u)
+        self._y.append(float(result[self.metric]))
+
+    def observe(self, config: Dict[str, Any], result: Optional[Dict[str, Any]]):
+        """Feed a finished (config, result) pair whose suggest-time vector is
+        unavailable — e.g. trials reloaded by ``Tuner.restore``. The unit
+        vector is reconstructed from the config via the axis mappings."""
+        if self._axes is None or not result or self.metric not in result:
+            return
+        try:
+            u = np.array(
+                [ax.to_unit(float(config[ax.name])) for ax in self._axes]
+            )
+        except (KeyError, TypeError, ValueError):
+            return
+        self._X.append(np.clip(u, 0.0, 1.0))
         self._y.append(float(result[self.metric]))

@@ -190,7 +190,7 @@ class Tuner:
         stopper = coerce_stopper(self.run_config.stop)
         loggers = TrialLoggers()
         search_alg = cfg.search_alg
-        if search_alg is not None and self._restored is None:
+        if search_alg is not None:
             search_alg.set_search_space(self.param_space)
 
         max_conc = cfg.max_concurrent_trials or max(
@@ -217,6 +217,10 @@ class Tuner:
                 if st["state"] in ("PENDING", "RUNNING"):
                     trials[tid]["state"] = "PENDING"
                     queue.append(tid)
+                elif search_alg is not None and hasattr(search_alg, "observe"):
+                    # re-feed finished trials so the restored search model
+                    # isn't empty (suggest-time vectors died with the driver)
+                    search_alg.observe(st["config"] or {}, st["last_metrics"])
         else:
             if search_alg is not None:
                 # configs are suggested lazily at launch time so later trials

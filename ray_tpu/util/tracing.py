@@ -1,0 +1,116 @@
+"""Distributed trace-context propagation across task/actor boundaries.
+
+Parity: ``python/ray/util/tracing/tracing_helper.py`` (``:34``,
+``_DictPropagator:165``) — when tracing is enabled, the caller's span context
+is injected into every task spec (runtime_env side channel) and extracted in
+the executing worker, so spans form one tree across processes. The reference
+delegates to OpenTelemetry; this environment has no OTel package, so the
+context model (16-byte trace id, 8-byte span ids, parent links) is
+implemented natively and spans land in the task timeline
+(``ray_tpu.timeline()``) via the profiling event plane.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+_CTX_KEY = "_trace_ctx"
+
+_enabled = False
+_local = threading.local()
+
+
+@dataclass
+class TraceContext:
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+    parent_id: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, str]:
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            d["parent_id"] = self.parent_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, str]) -> "TraceContext":
+        return cls(d["trace_id"], d["span_id"], d.get("parent_id"))
+
+
+def enable_tracing() -> None:
+    """Parity: ``ray start --tracing-startup-hook`` turning span export on."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def get_current_context() -> Optional[TraceContext]:
+    return getattr(_local, "ctx", None)
+
+
+def _set_current_context(ctx: Optional[TraceContext]) -> None:
+    _local.ctx = ctx
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def start_span() -> TraceContext:
+    """Begin a span under the current context (new trace if none)."""
+    cur = get_current_context()
+    if cur is None:
+        ctx = TraceContext(trace_id=_new_id(16), span_id=_new_id(8))
+    else:
+        ctx = TraceContext(
+            trace_id=cur.trace_id, span_id=_new_id(8), parent_id=cur.span_id
+        )
+    _set_current_context(ctx)
+    return ctx
+
+
+def inject(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Attach the caller's context to an outgoing task spec (submission side).
+
+    Parity: ``_DictPropagator.inject_current_context``.
+    """
+    ctx = get_current_context()
+    if ctx is None:
+        if not _enabled:
+            return runtime_env
+        ctx = start_span()
+    # note: an active context propagates even in processes that never called
+    # enable_tracing() — workers executing a traced task must keep the chain
+    # for nested submissions (the reference achieves this via a cluster-wide
+    # tracing startup hook on every worker)
+    out = dict(runtime_env or {})
+    out[_CTX_KEY] = ctx.to_dict()
+    return out
+
+
+def extract_and_activate(runtime_env: Optional[dict]) -> Optional[TraceContext]:
+    """Executing-worker side: adopt the caller's context as parent and open a
+    child span for this task. Returns the new context (None if untraced)."""
+    if not runtime_env or _CTX_KEY not in runtime_env:
+        return None
+    parent = TraceContext.from_dict(runtime_env[_CTX_KEY])
+    child = TraceContext(
+        trace_id=parent.trace_id, span_id=_new_id(8), parent_id=parent.span_id
+    )
+    _set_current_context(child)
+    return child
+
+
+def deactivate() -> None:
+    _set_current_context(None)

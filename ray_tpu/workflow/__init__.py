@@ -9,3 +9,8 @@ completed steps instead of recomputing them.
 from ray_tpu.workflow.api import get_output, get_status, resume, run, run_async
 
 __all__ = ["run", "run_async", "resume", "get_status", "get_output"]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("workflow")
+del _usage

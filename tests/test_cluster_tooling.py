@@ -260,3 +260,106 @@ def test_autoscaler_launches_real_daemons(ray_start_regular):
     finally:
         for n in provider.non_terminated_nodes():
             provider.terminate_node(n["node_id"])
+
+
+def test_usage_stats_recording(tmp_path, monkeypatch):
+    """Parity: usage_lib tag recording + opt-out (SURVEY §2.2)."""
+    from ray_tpu._private import usage
+
+    usage.reset_for_test()
+    usage.record_extra_usage_tag("test_tag", "42")
+    usage.record_library_usage("data")
+    report = usage.get_usage_report()
+    assert report["extra_usage_tags"]["test_tag"] == "42"
+    assert "data" in report["libraries_used"]
+    path = usage.write_usage_report(str(tmp_path))
+    import json
+
+    assert json.load(open(path))["extra_usage_tags"]["test_tag"] == "42"
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    usage.reset_for_test()
+    usage.record_extra_usage_tag("nope", "1")
+    assert usage.get_usage_report()["extra_usage_tags"] == {}
+
+
+def test_profile_spans_in_timeline(ray_start_regular):
+    """Parity: ray._private.profiling.profile -> chrome trace 'X' events."""
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.profiling import profile
+
+    @ray_tpu.remote
+    def work():
+        with profile("inner_phase", extra_data={"k": "v"}):
+            time.sleep(0.02)
+        return 1
+
+    assert ray_tpu.get(work.remote(), timeout=60) == 1
+    with profile("driver_phase"):
+        time.sleep(0.01)
+    time.sleep(0.5)  # let the pipe-carried span land in the scheduler
+    events = ray_tpu.timeline()
+    spans = [e for e in events if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert "inner_phase" in names and "driver_phase" in names
+    inner = next(e for e in spans if e["name"] == "inner_phase")
+    assert inner["dur"] >= 15_000  # >= 15 ms in chrome-trace microseconds
+    assert inner["args"]["k"] == "v"
+
+
+def test_trace_context_propagation(ray_start_regular):
+    """Parity: tracing_helper inject/extract across nested tasks."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def child():
+            ctx = tracing.get_current_context()
+            return ctx.to_dict()
+
+        @ray_tpu.remote
+        def parent():
+            ctx = tracing.get_current_context()
+            inner = ray_tpu.get(child.remote(), timeout=60)
+            return ctx.to_dict(), inner
+
+        root = tracing.start_span()
+        outer, inner = ray_tpu.get(parent.remote(), timeout=60)
+        # one trace across all three processes; parent links chain
+        assert outer["trace_id"] == root.trace_id == inner["trace_id"]
+        assert outer["parent_id"] == root.span_id
+        assert inner["parent_id"] == outer["span_id"]
+    finally:
+        tracing.disable_tracing()
+        tracing.deactivate()
+
+
+def test_dashboard_jax_profiler(ray_start_regular, tmp_path):
+    import glob
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    port = start_dashboard(port=0)
+    try:
+        logdir = str(tmp_path / "trace")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/profiler/start?logdir={logdir}"
+        ) as r:
+            assert json.load(r)["status"] == "tracing"
+        import jax
+        import jax.numpy as jnp
+
+        jax.jit(lambda x: x * 2)(jnp.ones(8)).block_until_ready()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/profiler/stop"
+        ) as r:
+            assert json.load(r)["status"] == "stopped"
+        assert glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
+    finally:
+        stop_dashboard()
