@@ -34,6 +34,11 @@ class Config:
     # --- object store ---
     object_store_memory: int = 2 * 1024**3  # bytes of shm for the store arena
     max_direct_call_object_size: int = 100 * 1024  # inline small returns (ref: ray_config_def.h)
+    # how long a pickled ObjectRef's handoff pin keeps its object alive while
+    # in transit to the consumer (see ObjectRef.__reduce__): long enough for
+    # submission->deserialization under load, short enough that dropped
+    # objects don't linger
+    transit_ref_ttl_s: float = 10.0
     object_spilling_threshold: float = 0.8  # fraction of store full before spilling
     spill_directory: str = ""  # default: <session>/spill
     # --- scheduler ---

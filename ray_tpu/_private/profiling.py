@@ -11,10 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 import time
-
-_local = threading.local()
 
 
 @contextlib.contextmanager

@@ -337,6 +337,9 @@ class Scheduler:
         self._starting_count: Dict[NodeID, int] = collections.defaultdict(int)
         # object ref counts (owner-side): oid -> count; deletion when 0
         self._ref_counts: Dict[ObjectID, int] = collections.defaultdict(int)
+        # FIFO of (expiry, oid) transit pins; deadlines are monotone because
+        # the TTL is constant, so expiry only ever pops from the left
+        self._transit_pins: collections.deque = collections.deque()
         self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
         # name-claimed actors whose creation spec has not arrived yet:
         # actor_id -> deadline for the spec to land
@@ -858,6 +861,15 @@ class Scheduler:
         elif kind == "add_ref":
             for oid in cmd[1]:
                 self._ref_counts[oid] += 1
+        elif kind == "transit_ref":
+            # pickled-ref handoff pin: keeps the object alive while a
+            # serialized ObjectRef travels to its consumer, auto-expiring
+            # because a blob may be deserialized any number of times (see
+            # ObjectRef.__reduce__)
+            deadline = time.monotonic() + self.config.transit_ref_ttl_s
+            for oid in cmd[1]:
+                self._ref_counts[oid] += 1
+                self._transit_pins.append((deadline, oid))
         elif kind == "remove_ref":
             self._unpin(cmd[1])
         elif kind == "cancel":
@@ -1025,6 +1037,13 @@ class Scheduler:
                 ):
                     logger.warning("node %s missed heartbeats", nid.hex()[:8])
                     self._on_daemon_death(conn)
+        if self._transit_pins:
+            now = time.monotonic()
+            expired = []
+            while self._transit_pins and self._transit_pins[0][0] < now:
+                expired.append(self._transit_pins.popleft()[1])
+            if expired:
+                self._unpin(expired)
         if self._placeholder_deadlines:
             now = time.monotonic()
             for aid in [
@@ -1773,11 +1792,17 @@ class Scheduler:
 
             call_args = _pkl.loads(args_blob) if args_blob else ()
             st = self.actors.get(actor_id)
+            from ray_tpu._private import serialization as _serde
+
+            serde = _serde.get_context()
             spec = TaskSpec(
                 task_id=TaskID.for_task(actor_id),
                 task_type=TaskType.ACTOR_TASK,
                 function=_cp.dumps(method),
-                args=[Arg(value=v) for v in call_args],
+                # inline-serde framing exactly like pack_args: a raw bytes
+                # value beginning with 0x01 must not be misread as a blob
+                args=[Arg(value=b"\x01" + serde.serialize_to_bytes(v))
+                      for v in call_args],
                 kwargs={},
                 num_returns=1,
                 resources={},

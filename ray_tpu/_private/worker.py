@@ -57,8 +57,10 @@ class ObjectRef:
     def __init__(self, oid: ObjectID, _owned: bool = False):
         self._id = oid
         self._owned = _owned
-        if _owned and _driver is not None:
-            _driver.add_refs([oid])
+        if _owned:
+            rt = _worker_runtime if _worker_runtime is not None else _driver
+            if rt is not None:
+                rt.add_refs([oid])
 
     def id(self) -> ObjectID:
         return self._id
@@ -79,13 +81,34 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        # refs deserialize un-owned (borrower side does not count)
-        return (ObjectRef, (self._id,))
+        # A deserialized ref registers as a borrower in its process (parity:
+        # the borrower sets of reference_count.h:61): the object stays alive
+        # while any process holds a live handle, not just the driver.
+        #
+        # The sender also takes a time-limited TRANSIT pin here. Without it, a
+        # worker that puts an object and returns the ref could GC its local
+        # handle (count -> 0 => free) before the consumer's borrow
+        # registration arrives; the pin rides the sender's ordered channel
+        # before its own decrement, so the count never touches zero
+        # mid-handoff. The pin expires scheduler-side (rather than being
+        # released by the receiver) because one pickled blob may be
+        # deserialized any number of times — receiver-side release would
+        # over-decrement on the second deserialization.
+        rt = _worker_runtime if _worker_runtime is not None else _driver
+        if rt is not None and not getattr(rt, "closed", False):
+            try:
+                rt.transit_refs([self._id])
+            except Exception:
+                pass
+        return (_deserialize_ref, (self._id,))
 
     def __del__(self):
-        if self._owned and _driver is not None and not _driver.closed:
+        if not self._owned:
+            return
+        rt = _worker_runtime if _worker_runtime is not None else _driver
+        if rt is not None and not getattr(rt, "closed", False):
             try:
-                _driver.remove_refs([self._id])
+                rt.remove_refs([self._id])
             except Exception:
                 pass
 
@@ -110,6 +133,18 @@ class ObjectRef:
         loop = asyncio.get_event_loop()
         fut = loop.run_in_executor(None, lambda: get_runtime().get_objects([self._id])[0])
         return fut.__await__()
+
+
+def _deserialize_ref(oid: ObjectID) -> "ObjectRef":
+    """Unpickle an ObjectRef as a counted borrow when a runtime is connected
+    (worker or driver); an unconnected process gets an inert handle."""
+    connected = _worker_runtime is not None or _driver is not None
+    return ObjectRef(oid, _owned=connected)
+
+
+def _deserialize_ref_transit(oid: ObjectID) -> "ObjectRef":
+    # retained for unpickling blobs produced by older builds
+    return _deserialize_ref(oid)
 
 
 class ObjectRefGenerator:
@@ -168,6 +203,9 @@ class DriverRuntime:
 
     def add_refs(self, oids):
         self.scheduler.post(("add_ref", list(oids)))
+
+    def transit_refs(self, oids):
+        self.scheduler.post(("transit_ref", list(oids)))
 
     def remove_refs(self, oids):
         self.scheduler.post(("remove_ref", list(oids)))

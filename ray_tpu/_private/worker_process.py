@@ -56,6 +56,9 @@ class WorkerRuntime:
         self._responses_lock = threading.Lock()
         self.exec_queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._stopped = threading.Event()
+        # pickled-function blob -> deserialized callable/method-name (parity:
+        # the reference's per-worker function table; same blob = same object)
+        self._fn_cache: Dict[bytes, Any] = {}
 
     # -- task context (per executing thread) ------------------------------
 
@@ -283,6 +286,9 @@ class WorkerRuntime:
     def add_refs(self, oids):
         self._send(("cmd", ("add_ref", list(oids))))
 
+    def transit_refs(self, oids):
+        self._send(("cmd", ("transit_ref", list(oids))))
+
     def remove_refs(self, oids):
         self._send(("cmd", ("remove_ref", list(oids))))
 
@@ -395,7 +401,10 @@ class WorkerRuntime:
                 self._actor_id = spec.actor_id
                 return [("inline", self.serde.serialize_to_bytes(None))]
             if spec.task_type == TaskType.ACTOR_TASK:
-                method_name = cloudpickle.loads(spec.function)
+                method_name = self._fn_cache.get(spec.function)
+                if method_name is None:
+                    method_name = cloudpickle.loads(spec.function)
+                    self._fn_cache[spec.function] = method_name
                 args, kwargs = self._resolve_args(spec)
                 if method_name == "__ray_terminate__":
                     self._send(("actor_exit",))
@@ -406,7 +415,12 @@ class WorkerRuntime:
                 method = getattr(self._actor_instance, method_name)
                 result = method(*args, **kwargs)
             else:
-                fn = cloudpickle.loads(spec.function)
+                fn = self._fn_cache.get(spec.function)
+                if fn is None:
+                    fn = cloudpickle.loads(spec.function)
+                    if len(self._fn_cache) > 256:
+                        self._fn_cache.clear()
+                    self._fn_cache[spec.function] = fn
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
             if spec.is_streaming:

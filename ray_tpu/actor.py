@@ -84,14 +84,22 @@ class ActorHandle:
             return ActorMethod(self, name, meta[name])
         raise AttributeError(name)
 
+    # method-name pickles are identical across calls: cache them (hot path —
+    # one cloudpickle.dumps per actor call showed up in the core microbench)
+    _method_blob_cache: dict = {}
+
     def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
         rt = get_runtime()
         streaming = num_returns == "streaming"
         packed_args, packed_kwargs = pack_args(rt, args, kwargs)
+        blob = self._method_blob_cache.get(method_name)
+        if blob is None:
+            blob = cloudpickle.dumps(method_name)
+            self._method_blob_cache[method_name] = blob
         spec = TaskSpec(
             task_id=rt.new_task_id(),
             task_type=TaskType.ACTOR_TASK,
-            function=cloudpickle.dumps(method_name),
+            function=blob,
             args=packed_args,
             kwargs=packed_kwargs,
             num_returns=1 if streaming else num_returns,

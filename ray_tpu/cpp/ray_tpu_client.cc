@@ -731,9 +731,15 @@ bool Client::Connect(const std::string& host, int port,
   p.Str("register_driver");
   p.Int(int64_t(::getpid()));
   p.Tuple();
-  if (!impl_->SendMsg(p.Finish(), error)) return false;
+  if (!impl_->SendMsg(p.Finish(), error)) {
+    Close();
+    return false;
+  }
   PyValue reply;
-  if (!impl_->RecvMsg(&reply, error)) return false;
+  if (!impl_->RecvMsg(&reply, error)) {
+    Close();
+    return false;
+  }
   if (reply.kind != PyValue::Kind::kTuple || reply.items.size() != 2 ||
       reply.items[0].s != "driver_registered") {
     *error = "unexpected handshake reply";
@@ -841,20 +847,15 @@ bool Client::Get(const std::string& object_id, double timeout_s, PyValue* out,
       const std::string& tag = reply.items[0].s;
       const std::string& blob = reply.items[1].s;
       if (tag == "err") {
-        // the payload is a serialized exception; surface its class summary
+        // error entries hold a raw-pickled exception (no serde frame —
+        // they come from pickle.dumps directly, unlike "ok" blobs)
         *error = "task failed";
-        if (blob.size() > 12) {
-          uint64_t plen = 0;
-          for (int i = 0; i < 8; i++)
-            plen |= uint64_t(uint8_t(blob[4 + i])) << (8 * i);
-          std::string pickled_err = blob.substr(12, plen);
-          try {
-            Unpickler u_err(pickled_err);
-            PyValue e = u_err.Load();
-            if (e.kind == PyValue::Kind::kObject)
-              *error = "task failed: " + e.repr;
-          } catch (...) {
-          }
+        try {
+          Unpickler u_err(blob);
+          PyValue e = u_err.Load();
+          if (e.kind == PyValue::Kind::kObject)
+            *error = "task failed: " + e.repr;
+        } catch (...) {
         }
         return false;
       }

@@ -20,3 +20,7 @@ __all__ = [
     "loss_fn",
     "param_logical_axes",
 ]
+
+from ray_tpu.models import vit  # noqa: E402  (ViT family: models/vit.py)
+
+__all__.append("vit")

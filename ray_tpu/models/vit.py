@@ -1,0 +1,158 @@
+"""Vision Transformer (ViT), TPU-first.
+
+Widens the model-family coverage beyond the LM/MoE/MLP/CNN families (the
+reference frameworks' train/serve stacks are model-agnostic; vision models are
+their second-most-common workload). Same design rules as
+``models/transformer.py``:
+
+* patch embedding is a reshape + one matmul (pure MXU work — no conv needed
+  for non-overlapping patches);
+* stacked per-layer params scanned with ``jax.lax.scan`` — one compiled block
+  body regardless of depth;
+* every parameter carries logical axes (``param_logical_axes``) so DP/FSDP/TP
+  are annotation changes through ``ray_tpu.parallel.sharding``;
+* bfloat16 compute with fp32 norms; bidirectional (non-causal) attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import _init
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.layers import gelu, rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.num_channels
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# CI-sized and standard presets
+VIT_TINY_TEST = ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                          d_model=64, n_layers=2, n_heads=4, d_ff=128)
+VIT_B_16 = ViTConfig()  # ViT-Base/16 geometry (public standard)
+VIT_L_16 = ViTConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+
+
+def init_params(key: jax.Array, cfg: ViTConfig) -> Dict[str, jax.Array]:
+    keys = jax.random.split(key, 10)
+    L, D, H, Hd, F = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    dt = cfg.dtype
+    s_in = 1.0 / math.sqrt(D)
+    return {
+        "patch_embed": _init(keys[0], (cfg.patch_dim, D), 1.0 / math.sqrt(cfg.patch_dim), dt),
+        "pos_embed": _init(keys[1], (cfg.num_patches + 1, D), 0.02, jnp.float32),
+        "cls_token": _init(keys[2], (D,), 0.02, jnp.float32),
+        "wq": _init(keys[3], (L, D, H, Hd), s_in, dt),
+        "wk": _init(keys[4], (L, D, H, Hd), s_in, dt),
+        "wv": _init(keys[5], (L, D, H, Hd), s_in, dt),
+        "wo": _init(keys[6], (L, H, Hd, D), s_in / math.sqrt(2 * L), dt),
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+        "w_up": _init(keys[7], (L, D, F), s_in, dt),
+        "w_down": _init(keys[8], (L, F, D), 1.0 / math.sqrt(F) / math.sqrt(2 * L), dt),
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "head": _init(keys[9], (D, cfg.num_classes), s_in, dt),
+    }
+
+
+def param_logical_axes(cfg: ViTConfig) -> Dict[str, Tuple]:
+    return {
+        "patch_embed": ("patch", "embed"),
+        "pos_embed": (None, "embed"),
+        "cls_token": ("embed",),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "heads", "head_dim"),
+        "wv": ("layers", "embed", "heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "attn_norm": ("layers", "norm"),
+        "mlp_norm": ("layers", "norm"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "final_norm": ("norm",),
+        "head": ("embed", "vocab"),
+    }
+
+
+def patchify(cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """(B, H, W, C) -> (B, num_patches, patch_dim) by pure reshape/transpose
+    (non-overlapping patches need no convolution)."""
+    B = images.shape[0]
+    P = cfg.patch_size
+    n = cfg.image_size // P
+    x = images.reshape(B, n, P, n, P, cfg.num_channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, n, n, P, P, C)
+    return x.reshape(B, n * n, cfg.patch_dim)
+
+
+def _block(cfg: ViTConfig, x: jax.Array, layer: Dict) -> jax.Array:
+    h = rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    att = attention(q, k, v, causal=False)
+    x = x + jnp.einsum("bshk,hkd->bsd", att, layer["wo"])
+    m = rms_norm(x, layer["mlp_norm"])
+    ff = gelu(jnp.einsum("bsd,df->bsf", m, layer["w_up"]))
+    return x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"])
+
+
+def forward(cfg: ViTConfig, params: Dict, images: jax.Array) -> jax.Array:
+    """images (B, H, W, C) float -> logits (B, num_classes)."""
+    x = patchify(cfg, images).astype(cfg.dtype) @ params["patch_embed"]
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype), (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+
+    stacked = {
+        k: params[k]
+        for k in ("wq", "wk", "wv", "wo", "attn_norm", "mlp_norm", "w_up", "w_down")
+    }
+
+    def body(carry, layer):
+        fn = _block
+        if cfg.remat:
+            fn = jax.checkpoint(_block, static_argnums=(0,))
+        return fn(cfg, carry, layer), None
+
+    x, _ = jax.lax.scan(body, x, stacked)
+    x = rms_norm(x, params["final_norm"])
+    # classify on the CLS token in fp32
+    return (x[:, 0, :] @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(cfg: ViTConfig, params: Dict, images: jax.Array, labels: jax.Array):
+    logits = forward(cfg, params, images)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    acc = jnp.mean((jnp.argmax(logits, axis=1) == labels).astype(jnp.float32))
+    return loss, acc

@@ -19,6 +19,7 @@ from ray_tpu.serve.api import (
     shutdown,
     status,
 )
+from ray_tpu.serve._grpc_proxy import grpc_predict, start_grpc_proxy
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.schema import (
     build,
@@ -45,6 +46,8 @@ __all__ = [
     "deploy_config",
     "deploy_config_file",
     "dump_config",
+    "grpc_predict",
+    "start_grpc_proxy",
     "multiplexed",
     "get_multiplexed_model_id",
     "DeploymentHandle",

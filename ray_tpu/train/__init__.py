@@ -19,6 +19,7 @@ from ray_tpu.train._config import (
 from ray_tpu.train._result import Result
 from ray_tpu.train._session import get_checkpoint, get_context, report
 from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.tensorflow_trainer import TensorflowTrainer, prepare_dataset_shard
 from ray_tpu.train.torch_trainer import TorchTrainer, prepare_data_loader, prepare_model
 
 __all__ = [
@@ -30,6 +31,8 @@ __all__ = [
     "Result",
     "JaxTrainer",
     "TorchTrainer",
+    "TensorflowTrainer",
+    "prepare_dataset_shard",
     "prepare_model",
     "prepare_data_loader",
     "report",

@@ -1,0 +1,131 @@
+"""TensorflowTrainer: multi-worker TF training on the worker group.
+
+Parity: ``TensorflowTrainer`` + ``_TensorflowBackend``
+(``python/ray/train/tensorflow/config.py`` — ``_setup_tensorflow_environment``
+assembles ``TF_CONFIG`` from the workers' published addresses so
+``tf.distribute.MultiWorkerMirroredStrategy`` can rendezvous). Here every
+worker publishes host:port through the cluster KV, worker 0 collects the
+roster, and each worker exports TF_CONFIG before the user loop runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import RunConfig, ScalingConfig
+from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.torch_trainer import _node_ip
+
+
+def _setup_tf_config(rendezvous_key: str) -> bool:
+    """Publish this worker's address, gather the full roster, set TF_CONFIG."""
+    import socket
+
+    from ray_tpu._private.worker import get_runtime
+    from ray_tpu.train._session import get_context
+
+    ctx = get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    if world <= 1:
+        os.environ.pop("TF_CONFIG", None)
+        return False
+    rt = get_runtime()
+    # reserve a port (close before TF binds it; the small race window is the
+    # same one the reference accepts in its setup_address)
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rt.rpc(
+        "kv_put",
+        "tf_rendezvous",
+        f"{rendezvous_key}:{rank}".encode(),
+        f"{_node_ip()}:{port}".encode(),
+        True,
+    )
+    roster = [None] * world
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        missing = False
+        for r in range(world):
+            if roster[r] is None:
+                raw = rt.rpc("kv_get", "tf_rendezvous", f"{rendezvous_key}:{r}".encode())
+                if raw:
+                    roster[r] = raw.decode()
+                else:
+                    missing = True
+        if not missing:
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("tensorflow rendezvous timed out")
+    os.environ["TF_CONFIG"] = json.dumps(
+        {"cluster": {"worker": roster}, "task": {"type": "worker", "index": rank}}
+    )
+    return True
+
+
+def prepare_dataset_shard(dataset):
+    """Disable TF auto-sharding (the data is already per-worker sharded by
+    this framework's Data library; parity: train.tensorflow.prepare_dataset_shard)."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF
+    )
+    return dataset.with_options(options)
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Same fit machinery (worker group in a PG, report/checkpoint plumbing);
+    the train loop runs with TF_CONFIG exported for MultiWorkerMirroredStrategy."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        key = f"tf_{uuid.uuid4().hex[:12]}"
+        user_fn = train_loop_per_worker
+
+        def wrapped(config=None):
+            import inspect
+
+            joined = _setup_tf_config(key)
+            try:
+                if config is not None and len(inspect.signature(user_fn).parameters):
+                    return user_fn(config)
+                return user_fn()
+            finally:
+                if joined:
+                    os.environ.pop("TF_CONFIG", None)
+                    from ray_tpu._private.worker import get_runtime
+                    from ray_tpu.train._session import get_context
+
+                    try:
+                        rank = get_context().get_world_rank()
+                        get_runtime().rpc(
+                            "kv_del", "tf_rendezvous", f"{key}:{rank}".encode()
+                        )
+                    except Exception:
+                        pass
+
+        super().__init__(
+            wrapped,
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )

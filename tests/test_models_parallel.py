@@ -137,3 +137,93 @@ def test_kv_cache_generation_matches_full_forward(cpu_mesh_devices):
         nxt = np.argmax(np.asarray(logits[:, -1, :], dtype=np.float32), axis=-1)
         assert (toks[:, step] == nxt).all(), f"divergence at step {step}"
         cur = np.concatenate([cur, nxt[:, None].astype(np.int32)], axis=1)
+
+
+def test_vit_forward_and_grads():
+    """ViT family: forward shapes, fp32 logits, grads flow."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import vit
+
+    cfg = vit.VIT_TINY_TEST
+    params = vit.init_params(jax.random.PRNGKey(0), cfg)
+    images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    logits = jax.jit(lambda p, x: vit.forward(cfg, p, x))(params, images)
+    assert logits.shape == (4, 10) and logits.dtype == jnp.float32
+
+    labels = jnp.array([0, 1, 2, 3])
+    (loss, acc), grads = jax.value_and_grad(
+        lambda p: vit.loss_fn(cfg, p, images, labels), has_aux=True
+    )(params)
+    assert jnp.isfinite(loss)
+    gnorm = jax.tree_util.tree_reduce(
+        lambda a, g: a + jnp.sum(jnp.abs(g.astype(jnp.float32))), grads, 0.0
+    )
+    assert gnorm > 0
+
+
+def test_vit_patchify_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import vit
+
+    cfg = vit.ViTConfig(image_size=4, patch_size=2, num_channels=1,
+                        d_model=8, n_layers=1, n_heads=1, d_ff=8)
+    img = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    patches = vit.patchify(cfg, img)
+    assert patches.shape == (1, 4, 4)
+    # first patch = top-left 2x2 block in row-major order
+    np.testing.assert_array_equal(np.asarray(patches[0, 0]), [0, 1, 4, 5])
+
+
+def test_vit_sharded_train_step_on_mesh():
+    """ViT under DP+TP GSPMD sharding on the virtual mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import vit
+    from ray_tpu.parallel.mesh import create_mesh
+
+    from ray_tpu.parallel.sharding import (
+        DEFAULT_LM_RULES,
+        batch_sharding,
+        shard_params,
+    )
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = create_mesh(data=-1, tensor=2, drop_trivial_axes=True)
+    cfg = vit.VIT_TINY_TEST
+    params = vit.init_params(jax.random.PRNGKey(0), cfg)
+    params = shard_params(
+        params, vit.param_logical_axes(cfg), DEFAULT_LM_RULES, mesh
+    )
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+
+    batch_shard = batch_sharding(mesh)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        (loss, acc), grads = jax.value_and_grad(
+            lambda p: vit.loss_fn(cfg, p, images, labels), has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    images = jax.device_put(
+        np.random.RandomState(0).randn(8, 32, 32, 3).astype(np.float32),
+        batch_shard,
+    )
+    labels = jax.device_put(np.arange(8) % 10, batch_shard)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # it optimizes

@@ -311,3 +311,31 @@ def test_serve_cli_status_and_build(serve_cluster, tmp_path, capsys):
 
     assert get_app_handle("cliapp").remote(1).result(timeout_s=60) == 3
     serve.delete("cliapp")
+
+
+def test_grpc_ingress(serve_cluster):
+    """Parity: the gRPC proxy ingress (proxy.py gRPCProxy)."""
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload, "n": len(payload)}
+
+    serve.run(Echo.bind(), name="grpcapp")
+    port = serve.start_grpc_proxy()
+    out = serve.grpc_predict(f"127.0.0.1:{port}", "hello", application="grpcapp")
+    assert out == {"echo": "hello", "n": 5}
+
+    # errors surface as exceptions, not hung calls
+    @serve.deployment
+    class Boom:
+        def __call__(self, payload):
+            raise ValueError("nope")
+
+    serve.run(Boom.bind(), name="grpcboom")
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="nope"):
+        serve.grpc_predict(f"127.0.0.1:{port}", "x", application="grpcboom")
+    serve.delete("grpcapp")
+    serve.delete("grpcboom")

@@ -191,3 +191,72 @@ def test_torch_trainer_ddp_gloo(ray_start_regular, tmp_path):
     ).fit()
     assert result.error is None
     assert result.metrics["final_loss"] < result.metrics["first_loss"] * 0.2
+
+
+def test_tensorflow_trainer_multiworker(ray_start_regular, tmp_path):
+    """2-worker TensorflowTrainer: TF_CONFIG rendezvous via the cluster KV,
+    MultiWorkerMirroredStrategy grad sync proven by rank-identical weights
+    after divergent per-rank data."""
+    from ray_tpu.train import RunConfig, ScalingConfig, TensorflowTrainer
+
+    def train_fn(config):
+        import os
+
+        import numpy as np
+
+        from ray_tpu.train import get_context, report
+
+        os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+        import tensorflow as tf
+
+        ctx = get_context()
+        rank = ctx.get_world_rank()
+        assert "TF_CONFIG" in os.environ
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+        assert strategy.num_replicas_in_sync == 2
+        with strategy.scope():
+            w = tf.Variable(tf.zeros((4, 1)), name="w")
+            opt = tf.keras.optimizers.SGD(0.05)
+
+        @tf.function
+        def train_step(x, y):
+            def step_fn(x, y):
+                with tf.GradientTape() as tape:
+                    loss = tf.reduce_mean((tf.matmul(x, w) - y) ** 2)
+                grads = tape.gradient(loss, [w])
+                opt.apply_gradients(zip(grads, [w]))  # allreduced here
+                return loss
+
+            per = strategy.run(step_fn, args=(x, y))
+            return strategy.reduce(tf.distribute.ReduceOp.MEAN, per, axis=None)
+
+        rng = np.random.default_rng(rank)  # DIFFERENT data per rank
+        w_true = np.array([1.0, -2.0, 3.0, 0.5], dtype=np.float32)
+        loss = None
+        for _ in range(20):
+            x = tf.constant(rng.normal(size=(16, 4)).astype(np.float32))
+            y = tf.matmul(x, tf.constant(w_true[:, None]))
+            loss = float(train_step(x, y))
+        import json
+
+        weights = [float(v) for v in w.numpy().reshape(-1)]
+        with open(config["out_dir"] + f"/rank{rank}.json", "w") as fh:
+            json.dump({"weights": weights, "final_loss": loss}, fh)
+        report({"final_loss": loss, "rank": rank})
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    result = TensorflowTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="tf_test"),
+        train_loop_config={"out_dir": str(out_dir)},
+    ).fit()
+    assert result.error is None, result.error
+    import json as _json
+
+    r0 = _json.load(open(out_dir / "rank0.json"))
+    r1 = _json.load(open(out_dir / "rank1.json"))
+    # grad allreduce => rank-identical weights despite divergent data
+    assert all(abs(a - b) < 1e-5 for a, b in zip(r0["weights"], r1["weights"]))
+    assert r0["final_loss"] < 1.0
