@@ -1,0 +1,31 @@
+"""DQN on CartPole with the RL library.
+
+Run: python examples/dqn_cartpole.py
+"""
+
+from ray_tpu.rl import DQNConfig
+
+
+def main():
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+        .debugging(seed=0)
+        .build()
+    )
+    for i in range(120):
+        result = algo.train()
+        if i % 10 == 0:
+            print(
+                f"iter {i:3d} return={result['episode_return_mean']:7.1f} "
+                f"steps={result['num_env_steps_sampled_lifetime']} "
+                f"eps={result['epsilon']:.2f}"
+            )
+        if result["episode_return_mean"] >= 300:
+            print("solved")
+            break
+
+
+if __name__ == "__main__":
+    main()

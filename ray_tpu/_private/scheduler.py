@@ -67,10 +67,22 @@ class MemoryStore:
         self._cv = threading.Condition(self._lock)
         # oid -> ("inline", bytes) | ("stored",) | ("error", bytes)
         self._table: Dict[ObjectID, Tuple] = {}
+        # per-oid waiter index: put() hits exactly the waiters of that oid,
+        # so a get() over N objects costs O(N) total instead of O(N) per
+        # commit (rescanning every oid on notify_all was the driver-side
+        # hot spot in the deep-queue microbench)
+        self._waiters: Dict[ObjectID, List[dict]] = {}
 
     def put(self, oid: ObjectID, entry: Tuple) -> None:
         with self._cv:
             self._table[oid] = entry
+            for waiter in self._waiters.pop(oid, ()):
+                waiter["remaining"].discard(oid)
+                waiter["hits"] += 1
+                if (
+                    waiter["need"] is None and not waiter["remaining"]
+                ) or (waiter["need"] is not None and waiter["hits"] >= waiter["need"]):
+                    waiter["done"] = True
             self._cv.notify_all()
 
     def get_entry(self, oid: ObjectID) -> Optional[Tuple]:
@@ -81,37 +93,68 @@ class MemoryStore:
         with self._lock:
             return oid in self._table
 
+    def _register_waiter(self, missing: Set[ObjectID], need: Optional[int]) -> dict:
+        # caller holds the lock
+        waiter = {"remaining": missing, "hits": 0, "need": need, "done": False}
+        for o in missing:
+            self._waiters.setdefault(o, []).append(waiter)
+        return waiter
+
+    def _drop_waiter(self, waiter: dict) -> None:
+        # caller holds the lock; prune index entries on timeout so oids that
+        # never commit don't accumulate dead waiters
+        for o in waiter["remaining"]:
+            lst = self._waiters.get(o)
+            if lst is not None:
+                try:
+                    lst.remove(waiter)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._waiters[o]
+
     def wait_for(self, oids, timeout: Optional[float]) -> Set[ObjectID]:
         """Block until all oids present or timeout; returns the ready set."""
         oids = set(oids)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while True:
-                ready = {o for o in oids if o in self._table}
-                if len(ready) == len(oids):
-                    return ready
-                remaining = None
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return ready
-                self._cv.wait(remaining if remaining is not None else 1.0)
+            missing = {o for o in oids if o not in self._table}
+            if not missing:
+                return oids
+            waiter = self._register_waiter(missing, None)
+            try:
+                while not waiter["done"]:
+                    remaining = None
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                    self._cv.wait(remaining if remaining is not None else 1.0)
+            finally:
+                self._drop_waiter(waiter)
+            return oids - waiter["remaining"]
 
     def wait_num(self, oids, num_returns: int, timeout: Optional[float]) -> List[ObjectID]:
         """Block until >= num_returns of oids are present or timeout."""
         oids = list(dict.fromkeys(oids))
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while True:
-                ready = [o for o in oids if o in self._table]
-                if len(ready) >= num_returns:
-                    return ready
-                remaining = None
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return ready
-                self._cv.wait(remaining if remaining is not None else 1.0)
+            missing = {o for o in oids if o not in self._table}
+            have = len(oids) - len(missing)
+            if have >= num_returns or not missing:
+                return [o for o in oids if o in self._table]
+            waiter = self._register_waiter(missing, num_returns - have)
+            try:
+                while not waiter["done"]:
+                    remaining = None
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                    self._cv.wait(remaining if remaining is not None else 1.0)
+            finally:
+                self._drop_waiter(waiter)
+            return [o for o in oids if o in self._table]
 
     def evict(self, oid: ObjectID) -> None:
         with self._lock:
@@ -860,16 +903,19 @@ class Scheduler:
             self._remove_pg(cmd[1])
         elif kind == "add_ref":
             for oid in cmd[1]:
-                self._ref_counts[oid] += 1
+                self._apply_ref_op(1, oid)
+        elif kind == "ref_batch":
+            # ordered batch of driver-side ref ops (1 = add, -1 = remove,
+            # 2 = transit pin); order within the batch matters
+            for op, oid in cmd[1]:
+                self._apply_ref_op(op, oid)
         elif kind == "transit_ref":
             # pickled-ref handoff pin: keeps the object alive while a
             # serialized ObjectRef travels to its consumer, auto-expiring
             # because a blob may be deserialized any number of times (see
             # ObjectRef.__reduce__)
-            deadline = time.monotonic() + self.config.transit_ref_ttl_s
             for oid in cmd[1]:
-                self._ref_counts[oid] += 1
-                self._transit_pins.append((deadline, oid))
+                self._apply_ref_op(2, oid)
         elif kind == "remove_ref":
             self._unpin(cmd[1])
         elif kind == "cancel":
@@ -1067,11 +1113,20 @@ class Scheduler:
         # queue changed (dirty), with a periodic safety rescan bounding any
         # missed wake-up
         now_d = time.monotonic()
-        if not self._dispatch_dirty and now_d - self._last_full_dispatch < 0.5:
+        periodic = now_d - self._last_full_dispatch >= 0.5
+        if not self._dispatch_dirty and not periodic:
             return
         self._dispatch_dirty = False
-        self._last_full_dispatch = now_d
+        # Dirty-path scans (a worker freed, a task arrived) bail after a few
+        # consecutive placement failures: with a deep homogeneous queue the
+        # rest of the scan is O(pending) of guaranteed failures, turning the
+        # whole drain into O(pending^2). Heterogeneous stragglers that a
+        # capped scan skips are picked up by the periodic full rescan.
+        fail_cap = None if periodic else 32
+        if periodic:
+            self._last_full_dispatch = now_d
         deferred = []
+        consecutive_fails = 0
         while self._pending:
             task_id = self._pending.popleft()
             rec = self.tasks.get(task_id)
@@ -1080,7 +1135,12 @@ class Scheduler:
             placed = self._try_dispatch(rec)
             if not placed:
                 deferred.append(task_id)
-        self._pending.extend(deferred)
+                consecutive_fails += 1
+                if fail_cap is not None and consecutive_fails >= fail_cap:
+                    break
+            else:
+                consecutive_fails = 0
+        self._pending.extendleft(reversed(deferred))
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
         """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
@@ -1839,6 +1899,19 @@ class Scheduler:
         raise ValueError(f"unknown rpc {op}")
 
     # ---- misc ------------------------------------------------------------
+
+    def _apply_ref_op(self, op: int, oid: ObjectID) -> None:
+        """One ref-count mutation: 1 = add, -1 = remove, 2 = TTL transit pin.
+        The single body behind add_ref / remove_ref / transit_ref / ref_batch
+        so pin semantics can't diverge between the single and batched paths."""
+        if op == -1:
+            self._unpin([oid])
+            return
+        self._ref_counts[oid] += 1
+        if op == 2:
+            self._transit_pins.append(
+                (time.monotonic() + self.config.transit_ref_ttl_s, oid)
+            )
 
     def _maybe_free(self, oid: ObjectID):
         self.memory_store.evict(oid)

@@ -49,6 +49,25 @@ class DataIterator:
                 out[k] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
             yield out
 
+    def iter_tf_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        dtypes: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as tf tensors (parity: ``iter_tf_batches``)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = tf.convert_to_tensor(np.asarray(v))
+                if dtypes and k in dtypes:
+                    t = tf.cast(t, dtypes[k])
+                out[k] = t
+            yield out
+
     def iter_torch_batches(
         self,
         *,

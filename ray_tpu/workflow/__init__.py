@@ -7,8 +7,26 @@ completed steps instead of recomputing them.
 """
 
 from ray_tpu.workflow.api import get_output, get_status, resume, run, run_async
+from ray_tpu.workflow.events import (
+    EventListener,
+    KVEventListener,
+    TimerListener,
+    post_event,
+    wait_for_event,
+)
 
-__all__ = ["run", "run_async", "resume", "get_status", "get_output"]
+__all__ = [
+    "run",
+    "run_async",
+    "resume",
+    "get_status",
+    "get_output",
+    "wait_for_event",
+    "post_event",
+    "EventListener",
+    "TimerListener",
+    "KVEventListener",
+]
 
 from ray_tpu._private import usage as _usage
 

@@ -1,0 +1,93 @@
+"""Workflow events: durable external triggers.
+
+Parity: ``python/ray/workflow/event_listener.py`` (``EventListener.
+poll_for_event``) + the HTTP event provider (``http_event_provider.py``) —
+a workflow step can block until an external event arrives; the received
+payload is checkpointed like any step output, so a resumed workflow does NOT
+re-wait for an event it already consumed (exactly-once consumption).
+
+The in-framework event transport is the cluster KV (``post_event`` publishes,
+``KVEventListener`` polls), playing the reference's HTTP-provider role without
+an extra ingress; arbitrary listeners plug in via the EventListener protocol.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import ray_tpu
+
+
+class EventListener:
+    """Subclass and implement poll_for_event (blocking) to integrate any
+    external event source."""
+
+    def poll_for_event(self, *args) -> Any:
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (parity: workflow TimerListener)."""
+
+    def poll_for_event(self, fire_at: float):
+        delay = fire_at - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        return fire_at
+
+
+class KVEventListener(EventListener):
+    """Waits for a payload published under a cluster-KV key via post_event."""
+
+    POLL_S = 0.1
+
+    def poll_for_event(self, key: str, timeout_s: float = 300.0):
+        from ray_tpu._private.worker import get_runtime
+
+        rt = get_runtime()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            raw = rt.rpc("kv_get", "workflow_events", key.encode())
+            if raw is not None:
+                import pickle
+
+                return pickle.loads(raw)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"no event published under {key!r}")
+            time.sleep(self.POLL_S)
+
+
+def post_event(key: str, payload: Any) -> None:
+    """Publish an event for KVEventListener waiters (the reference posts to
+    the HTTP event provider's endpoint; here the KV is the mailbox)."""
+    import pickle
+
+    from ray_tpu._private.worker import get_runtime
+
+    get_runtime().rpc(
+        "kv_put", "workflow_events", key.encode(), pickle.dumps(payload), True
+    )
+
+
+def wait_for_event(listener_cls, *args):
+    """A DAG node that resolves to the event payload; durable like any step.
+
+    Parity: ``ray.workflow.wait_for_event``. Use inside a workflow DAG:
+    ``result = process.bind(wait_for_event(KVEventListener, "approval"))``.
+    """
+    import cloudpickle
+
+    listener_blob = cloudpickle.dumps(listener_cls)
+
+    @ray_tpu.remote
+    def _wait_for_event(blob, *inner_args):
+        import cloudpickle as cp
+
+        listener = cp.loads(blob)()
+        return listener.poll_for_event(*inner_args)
+
+    # a stable name so the step id (hash of name+args) is deterministic
+    # across resume (see workflow.api._node_key)
+    _wait_for_event._name = f"wait_for_event[{getattr(listener_cls, '__name__', 'listener')}]"
+    return _wait_for_event.bind(listener_blob, *args)

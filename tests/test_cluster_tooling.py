@@ -363,3 +363,53 @@ def test_dashboard_jax_profiler(ray_start_regular, tmp_path):
         assert glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
     finally:
         stop_dashboard()
+
+
+def test_workflow_wait_for_event(ray_start_regular, tmp_path):
+    """Event steps: the workflow blocks on a published event, consumes it
+    exactly once (resume does not re-wait), parity: wait_for_event +
+    http_event_provider roles."""
+    import threading
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def process(payload):
+        return f"approved:{payload}"
+
+    dag = process.bind(
+        workflow.wait_for_event(workflow.KVEventListener, "approval", 60.0)
+    )
+
+    def approve_later():
+        _time.sleep(1.0)
+        workflow.post_event("approval", {"by": "alice"})
+
+    t = threading.Thread(target=approve_later)
+    t.start()
+    out = workflow.run(dag, workflow_id="wf_event", storage=str(tmp_path))
+    t.join()
+    assert out == "approved:{'by': 'alice'}"
+
+    # resume replays from the checkpointed event payload — no new event needed
+    out2 = workflow.resume("wf_event", storage=str(tmp_path))
+    assert out2 == out
+
+
+def test_workflow_timer_listener(ray_start_regular, tmp_path):
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def after(ts):
+        return "fired"
+
+    fire_at = _time.time() + 0.5
+    dag = after.bind(workflow.wait_for_event(workflow.TimerListener, fire_at))
+    t0 = _time.time()
+    assert workflow.run(dag, storage=str(tmp_path)) == "fired"
+    assert _time.time() - t0 >= 0.4

@@ -326,3 +326,23 @@ def test_object_freed_after_all_borrowers_drop(ray_start_regular):
             break
         time.sleep(0.25)
     assert all(o["object_id"] != oid_hex for o in state.list_objects())
+
+
+def test_borrowed_ref_survives_transit_pin_expiry(ray_start_regular):
+    """A driver-held ref deserialized from a task result must outlive the
+    sender's transit pin: the borrow flushes with the get, not lazily."""
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.worker import get_driver
+
+    @ray_tpu.remote
+    def producer():
+        return ray_tpu.put(np.full(30_000, 7.0))
+
+    inner = ray_tpu.get(producer.remote(), timeout=60)
+    ttl = get_driver().config.transit_ref_ttl_s
+    time.sleep(ttl + 2.0)  # idle across the pin expiry without any get/put
+    assert float(ray_tpu.get(inner, timeout=30).sum()) == 7.0 * 30_000

@@ -366,3 +366,16 @@ def test_to_pandas(ray_start_regular):
 
     df = ray_tpu.data.range(5).to_pandas()
     assert isinstance(df, pd.DataFrame) and list(df["id"]) == list(range(5))
+
+
+def test_iter_tf_batches(ray_start_regular):
+    import numpy as np
+
+    ds = ray_tpu.data.from_numpy({"x": np.arange(20, dtype=np.float32)})
+    it = ds.streaming_split(1)[0]
+    batches = list(it.iter_tf_batches(batch_size=8))
+    import tensorflow as tf
+
+    assert all(isinstance(b["x"], tf.Tensor) for b in batches)
+    total = float(sum(tf.reduce_sum(b["x"]) for b in batches))
+    assert total == float(np.arange(20).sum())
