@@ -66,6 +66,9 @@ def main():
             max_seq_len=2048,
             parallel_block=True,
             use_swiglu=False,
+            # dots-saveable selective remat: backward re-runs only cheap
+            # elementwise work; matmul outputs stay in HBM (fits at batch 8)
+            remat_policy="dots",
         )
         batch, seq, steps = 8, 2048, 10
     else:  # CPU fallback so the script always emits its line

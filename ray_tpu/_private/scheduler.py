@@ -365,6 +365,7 @@ class Scheduler:
 
         self._cmd_queue: queue.SimpleQueue = queue.SimpleQueue()
         self._wakeup_r, self._wakeup_w = os.pipe()
+        self._wakeup_pending = False
 
         self.nodes: Dict[NodeID, NodeState] = {}
         self.workers: Dict[WorkerID, WorkerState] = {}
@@ -429,10 +430,17 @@ class Scheduler:
     def post(self, cmd: Tuple) -> None:
         """Thread-safe command injection into the loop."""
         self._cmd_queue.put(cmd)
-        try:
-            os.write(self._wakeup_w, b"x")
-        except OSError:
-            pass
+        # elide the wakeup syscall when one is already pending: high-rate
+        # posters (ObjectRef churn) otherwise pay a pipe write per op. The
+        # flag race is benign — a stale False costs one extra write; the loop
+        # clears the flag BEFORE draining, so a put landing after the drain
+        # starts sets it again and re-signals.
+        if not self._wakeup_pending:
+            self._wakeup_pending = True
+            try:
+                os.write(self._wakeup_w, b"x")
+            except OSError:
+                pass
 
     # ---- main loop -------------------------------------------------------
 
@@ -447,6 +455,9 @@ class Scheduler:
                 ready = []
             for r in ready:
                 if r is wake:
+                    # clear the elision flag BEFORE draining the pipe/queue:
+                    # a post landing mid-drain must re-signal (see post())
+                    self._wakeup_pending = False
                     try:
                         os.read(wake, 4096)
                     except OSError:

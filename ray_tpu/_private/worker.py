@@ -198,54 +198,29 @@ class DriverRuntime:
         self.task_id = TaskID.for_driver(self.job_id)
         self._put_counter = _Counter()
         self.closed = False
-        # ordered (op, oid) ref-count buffer: ObjectRef churn posts thousands
-        # of single-oid commands through the scheduler loop otherwise (the
-        # dominant command volume in the actor-call microbench). Order within
-        # the buffer preserves add/remove interleavings exactly; the batch is
-        # flushed before anything that depends on the counts (submit) or that
-        # should observe frees (get).
-        self._ref_buf: List[Tuple[int, ObjectID]] = []
-        self._ref_buf_lock = threading.Lock()
 
     # -- refs --------------------------------------------------------------
-
-    _REF_FLUSH_AT = 128
-
-    def _buffer_ref_ops(self, op: int, oids):
-        with self._ref_buf_lock:
-            self._ref_buf.extend((op, oid) for oid in oids)
-            flush = len(self._ref_buf) >= self._REF_FLUSH_AT
-        if flush:
-            self.flush_ref_ops()
-
-    def flush_ref_ops(self):
-        # post under the lock: two racing flushers must not enqueue their
-        # batches in swapped order (a remove overtaking its add would free a
-        # live object)
-        with self._ref_buf_lock:
-            if not self._ref_buf:
-                return
-            batch, self._ref_buf = self._ref_buf, []
-            self.scheduler.post(("ref_batch", batch))
+    # Ref ops post individually (no driver-side batching): a buffer would
+    # need a lock that ObjectRef.__del__ can re-enter via GC (deadlock) and
+    # delays adds past the transit-pin TTL. The cheap part of posting —
+    # skipping the wakeup syscall when the loop is already signaled — lives
+    # in Scheduler.post instead.
 
     def add_refs(self, oids):
-        self._buffer_ref_ops(1, oids)
+        self.scheduler.post(("ref_batch", [(1, oid) for oid in oids]))
 
     def remove_refs(self, oids):
-        self._buffer_ref_ops(-1, oids)
+        self.scheduler.post(("ref_batch", [(-1, oid) for oid in oids]))
 
     def transit_refs(self, oids):
-        self._buffer_ref_ops(2, oids)
+        self.scheduler.post(("ref_batch", [(2, oid) for oid in oids]))
+
 
     # -- object plane ------------------------------------------------------
 
     def put(self, value) -> ObjectID:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed")
-        # flush pending removes first: deferred frees otherwise pile dead
-        # objects into the arena and rapid large puts degrade into
-        # spill-evictions instead of in-place reuse
-        self.flush_ref_ops()
         oid = ObjectID.for_put(self.task_id, self._put_counter.next())
         self.store.put_serialized(oid, self.serde, value)
         self.scheduler.memory_store.put(oid, ("stored",))
@@ -256,8 +231,8 @@ class DriverRuntime:
         return self.scheduler.memory_store.contains(oid) or self.store.contains(oid)
 
     def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
-        self.flush_ref_ops()  # don't let pending frees sit across a blocking get
         ms = self.scheduler.memory_store
+        deadline = None if timeout is None else time.monotonic() + timeout
         missing = list(dict.fromkeys(o for o in oids if not ms.contains(o)))
         if missing:
             ready = ms.wait_for(missing, timeout)
@@ -266,19 +241,23 @@ class DriverRuntime:
                     f"get() timed out waiting for {len(missing) - len(ready)} objects"
                 )
         out = []
-        try:
-            for oid in oids:
+        for oid in oids:
+            entry = ms.get_entry(oid)
+            while entry is None:
+                # committed earlier but evicted since (lineage reconstruction
+                # of a lost return): wait for the recomputation to recommit
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid.hex()} to be "
+                        "reconstructed"
+                    )
+                ms.wait_for([oid], min(remaining, 5.0) if remaining else 5.0)
                 entry = ms.get_entry(oid)
-                val, is_err = self._entry_value(oid, entry, timeout)
-                if is_err:
-                    raise val
-                out.append(val)
-        finally:
-            # deserialization above may have created borrowed ObjectRefs
-            # (+1 ops): flush them promptly so they land before the sender's
-            # 10s transit pin expires — a borrow buffered across a long idle
-            # period would let the object be freed underneath it
-            self.flush_ref_ops()
+            val, is_err = self._entry_value(oid, entry, timeout)
+            if is_err:
+                raise val
+            out.append(val)
         return out
 
     def _entry_value(self, oid: ObjectID, entry: Tuple, timeout=None) -> Tuple[Any, bool]:
@@ -324,16 +303,12 @@ class DriverRuntime:
 
     def submit(self, spec: TaskSpec) -> None:
         # pin ref args for the duration of the task (submitted-task references,
-        # parity: reference_count.h). The pin — and any buffered ref ops that
-        # precede it — is flushed to the command queue *before* submit, so a
-        # subsequent ObjectRef.__del__ remove_ref can never drop the count to
-        # zero while the task is in flight.
+        # parity: reference_count.h). add_ref is posted to the same command
+        # queue *before* submit, so a subsequent ObjectRef.__del__ remove_ref
+        # can never drop the count to zero while the task is in flight.
         arg_refs = spec.arg_ref_ids()
         if arg_refs:
-            # flush is only needed to order the pin ahead of this submit; a
-            # task without ref args has no count dependency at all
-            self._buffer_ref_ops(1, arg_refs)
-            self.flush_ref_ops()
+            self.add_refs(arg_refs)
         self.scheduler.submit(spec)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool):
@@ -378,7 +353,6 @@ class DriverRuntime:
         return TaskID.for_task(self.task_id.actor_id())
 
     def shutdown(self):
-        self.flush_ref_ops()
         self.closed = True
         from ray_tpu._private import usage
 

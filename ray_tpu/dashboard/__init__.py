@@ -54,6 +54,15 @@ def start_dashboard(port: int = 8765) -> int:
                     body = state.list_objects()
                 elif self.path == "/api/placement_groups":
                     body = state.list_placement_groups()
+                elif self.path == "/api/serve":
+                    from ray_tpu import serve as serve_lib
+
+                    try:
+                        body = serve_lib.status()
+                    except ValueError:
+                        body = {}
+                elif self.path == "/api/logs":
+                    body = state.list_logs()
                 elif self.path == "/api/jobs":
                     from ray_tpu.job_submission import JobSubmissionClient
 

@@ -80,15 +80,22 @@ def _can_use_flash(q, k) -> bool:
 
 
 def _tuned_block_sizes(head_dim: int, q_seq: int, kv_seq: int):
-    """Measured on v5e: for head_dim 64 the defaults underfill the MXU; 512
-    blocks throughout (fwd + dkv/dq passes) beat both the defaults and the
-    einsum path. None = library defaults."""
-    if head_dim != 64:
-        return None
+    """Measured on v5e: the library defaults underfill the MXU at both ends
+    of the head_dim range. head_dim 64: 512 blocks throughout beat defaults
+    and the einsum path (~1.4x at seq 1k). head_dim 256 (GPT-J geometry):
+    block_q 512 / block_k 1024 in all passes cuts the 6B-shaped train step
+    ~19% vs defaults (957 -> 773 ms, seq 2048, with dots-saveable remat).
+    None = library defaults."""
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
-    bq = min(512, q_seq)
-    bk = min(512, kv_seq)
+    if head_dim == 256:
+        bq = min(512, q_seq)
+        bk = min(1024, kv_seq)
+    elif head_dim == 64:
+        bq = min(512, q_seq)
+        bk = min(512, kv_seq)
+    else:
+        return None
     return BlockSizes(
         block_q=bq,
         block_k_major=bk,

@@ -7,7 +7,9 @@ backed by the scheduler's task-event buffer and tables (the reference's
 """
 
 from ray_tpu.util.state.api import (
+    get_log,
     list_actors,
+    list_logs,
     list_nodes,
     list_objects,
     list_placement_groups,
@@ -23,5 +25,7 @@ __all__ = [
     "list_nodes",
     "list_workers",
     "list_placement_groups",
+    "list_logs",
+    "get_log",
     "summarize_tasks",
 ]

@@ -57,3 +57,35 @@ def list_placement_groups(filters=None, limit: int = 10_000) -> List[dict]:
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return _rpc("summarize_tasks")
+
+
+def list_logs(limit: int = 10_000) -> List[dict]:
+    """Session log files (parity: ``ray.util.state.list_logs`` over the
+    session's logs dir)."""
+    import glob
+    import os
+
+    from ray_tpu._private.worker import get_driver
+
+    d = get_driver()
+    logs_dir = os.path.join(d.node.session_dir, "logs")
+    out = []
+    for path in sorted(glob.glob(os.path.join(logs_dir, "*")))[:limit]:
+        st = os.stat(path)
+        out.append({"filename": os.path.basename(path), "path": path,
+                    "size_bytes": st.st_size, "mtime": st.st_mtime})
+    return out
+
+
+def get_log(filename: str, *, tail: int = 1000) -> str:
+    """Read (the tail of) one session log file."""
+    import os
+
+    from ray_tpu._private.worker import get_driver
+
+    d = get_driver()
+    import collections
+
+    path = os.path.join(d.node.session_dir, "logs", os.path.basename(filename))
+    with open(path, errors="replace") as fh:
+        return "".join(collections.deque(fh, maxlen=tail))

@@ -195,3 +195,17 @@ def test_compile_jax_pipeline():
 
     fused = compile_jax_pipeline([lambda x: x + 1, lambda x: x * 2, jnp.sum])
     assert float(fused(jnp.ones(4))) == 16.0
+
+
+def test_state_logs_api(ray_start_regular, tmp_path):
+    import os
+
+    from ray_tpu.util import state
+    from ray_tpu._private.worker import get_driver
+
+    logs_dir = os.path.join(get_driver().node.session_dir, "logs")
+    with open(os.path.join(logs_dir, "test.log"), "w") as fh:
+        fh.write("line1\nline2\n")
+    rows = state.list_logs()
+    assert any(r["filename"] == "test.log" for r in rows)
+    assert state.get_log("test.log", tail=1) == "line2\n"
