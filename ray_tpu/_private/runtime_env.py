@@ -111,26 +111,84 @@ def _materialize_package(rt, digest: str, subdir_name: str = "") -> str:
     return target
 
 
+def _materialize_pip_env(packages: list) -> str:
+    """Install a pip package set into a content-addressed target dir (cached).
+
+    Parity: ``python/ray/_private/runtime_env/pip.py`` — per-env installed
+    package sets activated for the task. This environment has no network
+    egress, so installation runs ``--no-index`` against a local wheelhouse
+    (``RAY_TPU_WHEELHOUSE``, default ``/tmp/ray_tpu_wheelhouse``); the
+    reference's online index mode is the same command without the flags.
+    """
+    import subprocess
+
+    import shutil
+
+    pkgs = sorted(str(p) for p in packages)
+    wheelhouse = os.environ.get("RAY_TPU_WHEELHOUSE", "/tmp/ray_tpu_wheelhouse")
+    # digest covers the wheelhouse too: the same package names resolved from
+    # a different wheelhouse must not reuse a stale install
+    digest = hashlib.sha256(
+        "\n".join(pkgs + ["@" + os.path.abspath(wheelhouse)]).encode()
+    ).hexdigest()[:24]
+    target = os.path.join("/tmp", "ray_tpu_pip_envs", digest)
+    if os.path.isdir(os.path.join(target, ".done")):
+        return target
+    tmp = target + f".tmp.{os.getpid()}"
+    cmd = [
+        sys.executable, "-m", "pip", "install", "--quiet",
+        "--no-index", "--find-links", wheelhouse,
+        "--target", tmp, "--no-deps", *pkgs,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip runtime_env install failed for {pkgs} "
+                f"(wheelhouse {wheelhouse}): {proc.stderr.strip()[-500:]}"
+            )
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    os.makedirs(os.path.join(tmp, ".done"), exist_ok=True)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
 def apply(rt, runtime_env: dict):
-    """Apply working_dir/py_modules/env_vars; returns a restore token."""
+    """Apply env_vars/pip/working_dir/py_modules; returns a restore token."""
     saved = {"env": {}, "cwd": None, "sys_path": []}
-    env = runtime_env.get("env_vars") or {}
-    for k, v in env.items():
-        saved["env"][k] = os.environ.get(k)
-        os.environ[k] = str(v)
-    wd_uri = runtime_env.get("working_dir_uri")
-    if wd_uri:
-        wd = _materialize_package(rt, wd_uri)
-        saved["cwd"] = os.getcwd()
-        os.chdir(wd)
-        sys.path.insert(0, wd)
-        saved["sys_path"].append(wd)
-    for name, digest in runtime_env.get("py_modules_uris") or []:
-        mod_dir = _materialize_package(rt, digest, subdir_name=name)
-        parent = os.path.dirname(mod_dir)
-        if parent not in sys.path:
-            sys.path.insert(0, parent)
-            saved["sys_path"].append(parent)
+    try:
+        env = runtime_env.get("env_vars") or {}
+        for k, v in env.items():
+            saved["env"][k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        # after env_vars: RAY_TPU_WHEELHOUSE may arrive through them
+        pip_pkgs = runtime_env.get("pip")
+        if pip_pkgs:
+            pip_dir = _materialize_pip_env(pip_pkgs)
+            sys.path.insert(0, pip_dir)
+            saved["sys_path"].append(pip_dir)
+        wd_uri = runtime_env.get("working_dir_uri")
+        if wd_uri:
+            wd = _materialize_package(rt, wd_uri)
+            saved["cwd"] = os.getcwd()
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+            saved["sys_path"].append(wd)
+        for name, digest in runtime_env.get("py_modules_uris") or []:
+            mod_dir = _materialize_package(rt, digest, subdir_name=name)
+            parent = os.path.dirname(mod_dir)
+            if parent not in sys.path:
+                sys.path.insert(0, parent)
+                saved["sys_path"].append(parent)
+    except BaseException:
+        # a half-applied env must not leak into later tasks on this worker
+        restore(saved)
+        raise
     return saved
 
 

@@ -90,7 +90,9 @@ def _tuned_block_sizes(head_dim: int, q_seq: int, kv_seq: int):
 
     if head_dim == 256:
         bq = min(512, q_seq)
-        bk = min(1024, kv_seq)
+        # 1024 k-blocks only when they tile the sequence; otherwise 512
+        # (the kernel requires block_k_major | kv_seq)
+        bk = 1024 if kv_seq % 1024 == 0 else min(512, kv_seq)
     elif head_dim == 64:
         bq = min(512, q_seq)
         bk = min(512, kv_seq)

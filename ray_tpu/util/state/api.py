@@ -59,16 +59,27 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return _rpc("summarize_tasks")
 
 
+def _session_logs_dir() -> str:
+    import os
+
+    from ray_tpu._private.worker import get_driver
+
+    d = get_driver()
+    if d is None or not hasattr(d, "node"):
+        raise RuntimeError(
+            "list_logs/get_log read the session's log directory and are "
+            "driver-only (call them from the process that ran ray_tpu.init)"
+        )
+    return os.path.join(d.node.session_dir, "logs")
+
+
 def list_logs(limit: int = 10_000) -> List[dict]:
     """Session log files (parity: ``ray.util.state.list_logs`` over the
     session's logs dir)."""
     import glob
     import os
 
-    from ray_tpu._private.worker import get_driver
-
-    d = get_driver()
-    logs_dir = os.path.join(d.node.session_dir, "logs")
+    logs_dir = _session_logs_dir()
     out = []
     for path in sorted(glob.glob(os.path.join(logs_dir, "*")))[:limit]:
         st = os.stat(path)
@@ -79,13 +90,9 @@ def list_logs(limit: int = 10_000) -> List[dict]:
 
 def get_log(filename: str, *, tail: int = 1000) -> str:
     """Read (the tail of) one session log file."""
+    import collections
     import os
 
-    from ray_tpu._private.worker import get_driver
-
-    d = get_driver()
-    import collections
-
-    path = os.path.join(d.node.session_dir, "logs", os.path.basename(filename))
+    path = os.path.join(_session_logs_dir(), os.path.basename(filename))
     with open(path, errors="replace") as fh:
         return "".join(collections.deque(fh, maxlen=tail))

@@ -240,3 +240,59 @@ def test_channel_acquire_release_semantics(ray_start_regular, tmp_path):
     assert done.wait(5), "writer never unblocked after consumption"
     assert reader.read(timeout=5) == "b"
     writer.close()
+
+
+def test_runtime_env_pip_wheelhouse(ray_start_regular, tmp_path, monkeypatch):
+    """pip runtime env from a local wheelhouse (offline --no-index mode;
+    parity: runtime_env/pip.py)."""
+    import subprocess
+    import sys
+
+    # build a tiny wheel offline
+    src = tmp_path / "tinypkg_src"
+    (src / "tinypkg").mkdir(parents=True)
+    (src / "tinypkg" / "__init__.py").write_text("MAGIC = 'wheelhouse-ok'\n")
+    (src / "pyproject.toml").write_text(
+        '[build-system]\nrequires=["setuptools"]\n'
+        'build-backend="setuptools.build_meta"\n'
+        '[project]\nname="tinypkg"\nversion="0.1"\n'
+    )
+    wheelhouse = tmp_path / "wheelhouse"
+    wheelhouse.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-index",
+         "--no-build-isolation", "-w", str(wheelhouse), str(src)],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    monkeypatch.setenv("RAY_TPU_WHEELHOUSE", str(wheelhouse))
+
+    @ray_tpu.remote(runtime_env={"pip": ["tinypkg"],
+                                 "env_vars": {"RAY_TPU_WHEELHOUSE": str(wheelhouse)}})
+    def use_pkg():
+        import tinypkg
+
+        return tinypkg.MAGIC
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=120) == "wheelhouse-ok"
+
+    # a missing package surfaces as a task error, not a dead worker
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-package-xyz"],
+                                 "env_vars": {"RAY_TPU_WHEELHOUSE": str(wheelhouse)}})
+    def bad():
+        return 1
+
+    with pytest.raises(Exception, match="pip runtime_env install failed"):
+        ray_tpu.get(bad.remote(), timeout=120)
+
+    # a failed env application must not leak its env_vars into the worker
+    @ray_tpu.remote
+    def check_clean():
+        import os
+
+        return os.environ.get("RAY_TPU_WHEELHOUSE")
+
+    leaked = ray_tpu.get([check_clean.remote() for _ in range(4)], timeout=60)
+    # none of the workers may carry the failed task's env var
+    assert str(wheelhouse) not in [v for v in leaked if v is not None]

@@ -339,3 +339,25 @@ def test_cross_machine_remote_driver(real_cluster):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "CROSS-MACHINE-OK" in r.stdout
+
+
+def test_node_churn_under_load(real_cluster):
+    """Chaos: nodes join and are SIGKILLed repeatedly while a retriable task
+    load runs; every task must eventually complete (parity: the reference's
+    node-killer chaos, test_utils.py NodeKillerBase)."""
+
+    @ray_tpu.remote(max_retries=8, resources={"churn": 0.1})
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    nodes = [real_cluster.add_node(num_cpus=2, resources={"churn": 4})]
+    real_cluster.wait_for_nodes()
+    refs = [work.remote(i) for i in range(60)]
+    for cycle in range(2):
+        time.sleep(1.0)
+        # kill the newest node mid-load, then replace it
+        real_cluster.remove_node(nodes.pop())
+        nodes.append(real_cluster.add_node(num_cpus=2, resources={"churn": 4}))
+    out = ray_tpu.get(refs, timeout=180)
+    assert sorted(out) == list(range(60))
