@@ -135,10 +135,12 @@ def _materialize_pip_env(packages: list) -> str:
     if os.path.isdir(os.path.join(target, ".done")):
         return target
     tmp = target + f".tmp.{os.getpid()}"
+    # dependencies resolve from the same wheelhouse (--no-index keeps the
+    # whole resolution offline)
     cmd = [
         sys.executable, "-m", "pip", "install", "--quiet",
         "--no-index", "--find-links", wheelhouse,
-        "--target", tmp, "--no-deps", *pkgs,
+        "--target", tmp, *pkgs,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)

@@ -238,6 +238,38 @@ class Dataset:
             seen.update(vals)
         return sorted(seen)
 
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli sample of rows (parity: ``Dataset.random_sample``).
+
+        Seeded per (seed, block index) so a seeded sample is reproducible —
+        including across task retries and lineage reconstruction — regardless
+        of block content or dtype."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        base = seed if seed is not None else int.from_bytes(os.urandom(4), "little")
+        mat = self.materialize()
+        refs = [
+            _sample_block.remote(ref, fraction, base, i)
+            for i, ref in enumerate(mat._block_refs)
+        ]
+        return Dataset(refs, owned_actors=mat._owned_actors)
+
+    def take_batch(self, batch_size: int = 20) -> Batch:
+        """First batch_size rows as one batch dict (parity: take_batch)."""
+        pieces = []
+        taken = 0
+        for block in self._iter_exec_blocks():
+            n = block_num_rows(block)
+            take = min(batch_size - taken, n)
+            if take:
+                pieces.append(slice_block(block, 0, take))
+                taken += take
+            if taken >= batch_size:
+                break
+        if not pieces:
+            raise ValueError("dataset is empty")
+        return concat_blocks(pieces)
+
     def limit(self, n: int) -> "Dataset":
         out_blocks = []
         taken = 0
@@ -590,6 +622,13 @@ class Dataset:
 
     def __repr__(self):
         return self.stats()
+
+
+@ray_tpu.remote
+def _sample_block(block: Batch, fraction: float, base: int, index: int) -> Batch:
+    rng = np.random.default_rng([base, index])
+    keep = rng.random(block_num_rows(block)) < fraction
+    return {k: np.asarray(v)[keep] for k, v in block.items()}
 
 
 @ray_tpu.remote

@@ -88,16 +88,24 @@ def _tuned_block_sizes(head_dim: int, q_seq: int, kv_seq: int):
     None = library defaults."""
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
+    def pick(seq: int, *prefs: int):
+        # largest preferred block that tiles the sequence (the kernel
+        # requires block | seq); a short sequence is its own block
+        for p in prefs:
+            if seq % p == 0:
+                return p
+        return seq if seq <= prefs[0] else None
+
     if head_dim == 256:
-        bq = min(512, q_seq)
-        # 1024 k-blocks only when they tile the sequence; otherwise 512
-        # (the kernel requires block_k_major | kv_seq)
-        bk = 1024 if kv_seq % 1024 == 0 else min(512, kv_seq)
+        bq = pick(q_seq, 512, 256)
+        bk = pick(kv_seq, 1024, 512, 256)
     elif head_dim == 64:
-        bq = min(512, q_seq)
-        bk = min(512, kv_seq)
+        bq = pick(q_seq, 512, 256)
+        bk = pick(kv_seq, 512, 256)
     else:
         return None
+    if bq is None or bk is None:
+        return None  # library defaults
     return BlockSizes(
         block_q=bq,
         block_k_major=bk,

@@ -84,7 +84,8 @@ def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
 
 @ray_tpu.remote
 class Replica:
-    def __init__(self, callable_blob: bytes, init_args, init_kwargs, max_ongoing: int = 8):
+    def __init__(self, callable_blob: bytes, init_args, init_kwargs,
+                 max_ongoing: int = 8, user_config=None):
         # nested DeploymentHandles (model composition) arrive pre-resolved
         # inside init_args/kwargs
         target = cloudpickle.loads(callable_blob)
@@ -99,6 +100,17 @@ class Replica:
         self._gate = threading.Semaphore(max_ongoing)
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        """Apply a user_config without restarting the replica (parity: the
+        deployment ``reconfigure`` contract, serve deployment docs /
+        ``deployment_state.py`` lightweight-update path)."""
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+        return True
 
     def _enter(self, model_id: str):
         with self._ongoing_lock:

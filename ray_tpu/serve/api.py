@@ -34,7 +34,7 @@ class Application:
 class Deployment:
     def __init__(self, target, *, name=None, num_replicas=1, max_ongoing_requests=8,
                  ray_actor_options=None, health_check_period_s=5.0,
-                 autoscaling_config=None):
+                 autoscaling_config=None, user_config=None):
         self._target = target
         self.name = name or getattr(target, "__name__", "deployment")
         self.num_replicas = num_replicas
@@ -44,6 +44,9 @@ class Deployment:
         # {"min_replicas", "max_replicas", "target_ongoing_requests"}
         # (parity: serve autoscaling_policy.py / autoscaling_state.py)
         self.autoscaling_config = dict(autoscaling_config or {}) or None
+        # opaque config delivered to the callable's reconfigure() — updating
+        # ONLY this on redeploy is a lightweight update (no replica restart)
+        self.user_config = user_config
 
     def options(self, **updates) -> "Deployment":
         new = Deployment(
@@ -56,6 +59,7 @@ class Deployment:
                 "health_check_period_s", self.health_check_period_s
             ),
             autoscaling_config=updates.get("autoscaling_config", self.autoscaling_config),
+            user_config=updates.get("user_config", self.user_config),
         )
         return new
 
@@ -73,6 +77,7 @@ class Deployment:
             "max_ongoing_requests": self.max_ongoing_requests,
             "ray_actor_options": self.ray_actor_options,
             "autoscaling_config": self.autoscaling_config,
+            "user_config": self.user_config,
         }
 
 
@@ -110,6 +115,9 @@ class ServeController:
         (arg_index_or_kwarg, child_name) to replace with handles."""
         deployments: Dict[str, dict] = {}
         handles: Dict[str, DeploymentHandle] = {}
+        consumed: set = set()  # deployments whose replicas carried over
+        with self._lock:
+            live = self.apps.get(app_name) or {}
         for spec in specs:
             name = spec["name"]
             init_args = list(spec["init_args"])
@@ -119,6 +127,26 @@ class ServeController:
                     init_args[key] = handles[child]
                 else:
                     init_kwargs[key] = handles[child]
+            prev = live.get(name)
+            if prev is not None and self._only_user_config_changed(prev["spec"], spec):
+                # lightweight update (parity: deployment_state.py): push the
+                # new user_config to live replicas via reconfigure() instead
+                # of restarting them. The live table is NOT mutated here — a
+                # later failure in this deploy leaves it fully consistent.
+                replicas = list(prev["replicas"])
+                ray_tpu.get(
+                    [r.reconfigure.remote(spec["user_config"]) for r in replicas],
+                    timeout=120,
+                )
+                consumed.add(name)
+                deployments[name] = {
+                    "spec": spec,
+                    "init_args": init_args,
+                    "init_kwargs": init_kwargs,
+                    "replicas": replicas,
+                }
+                handles[name] = DeploymentHandle(name, app_name, replicas)
+                continue
             replicas = self._start_replicas(spec, init_args, init_kwargs)
             deployments[name] = {
                 "spec": spec,
@@ -127,12 +155,13 @@ class ServeController:
                 "replicas": replicas,
             }
             handles[name] = DeploymentHandle(name, app_name, replicas)
-        # tear down a previous version of the app
+        # tear down a previous version of the app (minus deployments whose
+        # replicas were carried over by a lightweight user_config update)
         with self._lock:
             old = self.apps.get(app_name)
             self.apps[app_name] = deployments
         if old:
-            self._teardown(old)
+            self._teardown({k: v for k, v in old.items() if k not in consumed})
         return True
 
     def _start_replicas(self, spec: dict, init_args, init_kwargs):
@@ -148,11 +177,29 @@ class ServeController:
                 num_cpus=opts.get("num_cpus", 0.0),
                 num_tpus=opts.get("num_tpus", 0.0),
                 resources=opts.get("resources"),
-            ).remote(spec["callable_blob"], init_args, init_kwargs, max_ongoing)
+            ).remote(spec["callable_blob"], init_args, init_kwargs, max_ongoing,
+                     spec.get("user_config"))
             replicas.append(r)
         # wait until they respond (surface init errors early)
         ray_tpu.get([r.check_health.remote() for r in replicas], timeout=120)
         return replicas
+
+    @staticmethod
+    def _only_user_config_changed(old_spec: dict, new_spec: dict) -> bool:
+        keys = set(old_spec) | set(new_spec)
+        for k in keys - {"user_config"}:
+            try:
+                same = bool(old_spec.get(k) == new_spec.get(k))
+            except Exception:  # e.g. numpy array args: ambiguous truth value
+                same = False
+            if not same:
+                return False
+        try:
+            return bool(
+                old_spec.get("user_config") != new_spec.get("user_config")
+            )
+        except Exception:
+            return True  # un-comparable configs: deliver the new one
 
     def _teardown(self, deployments: Dict[str, dict]):
         for d in deployments.values():

@@ -18,6 +18,7 @@ _DEPLOYMENT_OVERRIDE_KEYS = (
     "ray_actor_options",
     "autoscaling_config",
     "health_check_period_s",
+    "user_config",
 )
 
 
@@ -44,6 +45,8 @@ def build(app, *, import_path: str, name: str = "default",
             d["ray_actor_options"] = spec["ray_actor_options"]
         if spec.get("autoscaling_config"):
             d["autoscaling_config"] = spec["autoscaling_config"]
+        if spec.get("user_config") is not None:
+            d["user_config"] = spec["user_config"]
         deployments.append(d)
     app_schema: Dict[str, Any] = {
         "name": name,

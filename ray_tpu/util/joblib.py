@@ -1,0 +1,92 @@
+"""Joblib backend: run scikit-learn / joblib workloads as cluster tasks.
+
+Parity: ``ray.util.joblib`` (``python/ray/util/joblib/``) — registers a
+joblib parallel backend so ``with parallel_backend("ray_tpu"): ...`` fans
+``Parallel(n_jobs=...)`` batches out as framework tasks instead of local
+processes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def register_ray_tpu() -> None:
+    """Register the backend (parity: ``ray.util.joblib.register_ray``)."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _RayTpuBackend)
+
+
+def _base():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    return ParallelBackendBase
+
+
+class _RayTpuBackend(_base()):
+    """Each dispatched joblib batch becomes one framework task."""
+
+    supports_timeout = True
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs) -> int:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self.parallel = parallel
+        return self.effective_n_jobs(n_jobs)
+
+    def effective_n_jobs(self, n_jobs) -> int:
+        import ray_tpu
+
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs is None or n_jobs == -1:
+            return max(1, cpus)
+        return max(1, int(n_jobs))
+
+    def apply_async(self, func, callback=None):
+        import cloudpickle
+
+        ref = _run_joblib_batch.remote(cloudpickle.dumps(func))
+        return _AsyncResult(ref, callback)
+
+    def abort_everything(self, ensure_ready=True):
+        pass
+
+
+class _AsyncResult:
+    """Duck-types multiprocessing.pool.AsyncResult for joblib."""
+
+    def __init__(self, ref, callback):
+        self._ref = ref
+        self._callback = callback
+        self._value: Any = None
+        self._done = False
+        if callback is not None:
+            import threading
+
+            threading.Thread(target=self._wait_and_callback, daemon=True).start()
+
+    def _wait_and_callback(self):
+        value = self.get()
+        self._callback(value)
+
+    def get(self, timeout=None):
+        import ray_tpu
+
+        if not self._done:
+            # timeout=None is joblib's "wait forever" — pass it through
+            self._value = ray_tpu.get(self._ref, timeout=timeout)
+            self._done = True
+        return self._value
+
+
+import ray_tpu as _ray_tpu  # noqa: E402  (module-level: registered once)
+
+
+@_ray_tpu.remote
+def _run_joblib_batch(blob):
+    import cloudpickle as cp
+
+    return cp.loads(blob)()

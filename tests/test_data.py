@@ -379,3 +379,18 @@ def test_iter_tf_batches(ray_start_regular):
     assert all(isinstance(b["x"], tf.Tensor) for b in batches)
     total = float(sum(tf.reduce_sum(b["x"]) for b in batches))
     assert total == float(np.arange(20).sum())
+
+
+def test_random_sample_and_take_batch(ray_start_regular):
+    import numpy as np
+
+    ds = ray_tpu.data.range(1000, num_blocks=4)
+    sampled = ds.random_sample(0.2, seed=0)
+    n = sampled.count()
+    assert 100 < n < 320  # ~200 expected
+    batch = ds.take_batch(10)
+    assert list(np.asarray(batch["id"])) == list(range(10))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        ray_tpu.data.from_items([]).take_batch(5)

@@ -339,3 +339,43 @@ def test_grpc_ingress(serve_cluster):
         serve.grpc_predict(f"127.0.0.1:{port}", "x", application="grpcboom")
     serve.delete("grpcapp")
     serve.delete("grpcboom")
+
+
+def test_user_config_reconfigure(serve_cluster):
+    """user_config: delivered at startup, and a redeploy changing ONLY
+    user_config reconfigures live replicas without restarting them."""
+    import os
+
+    @serve.deployment(user_config={"threshold": 1})
+    class Configurable:
+        def __init__(self):
+            self.threshold = None
+            self.pid = os.getpid()
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self):
+            return {"threshold": self.threshold, "pid": self.pid}
+
+    handle = serve.run(Configurable.bind(), name="ucfg")
+    first = handle.remote().result(timeout_s=60)
+    assert first["threshold"] == 1
+
+    # redeploy with ONLY user_config changed -> same replica pid, new config
+    handle2 = serve.run(
+        Configurable.options(user_config={"threshold": 7}).bind(), name="ucfg"
+    )
+    second = handle2.remote().result(timeout_s=60)
+    assert second["threshold"] == 7
+    assert second["pid"] == first["pid"], "replica was restarted (heavyweight)"
+
+    # changing num_replicas too -> full restart (new pid allowed)
+    handle3 = serve.run(
+        Configurable.options(user_config={"threshold": 9}, num_replicas=1,
+                             max_ongoing_requests=4).bind(),
+        name="ucfg",
+    )
+    third = handle3.remote().result(timeout_s=60)
+    assert third["threshold"] == 9
+    serve.delete("ucfg")

@@ -209,3 +209,26 @@ def test_state_logs_api(ray_start_regular, tmp_path):
     rows = state.list_logs()
     assert any(r["filename"] == "test.log" for r in rows)
     assert state.get_log("test.log", tail=1) == "line2\n"
+
+
+def test_joblib_backend_sklearn(ray_start_regular):
+    """joblib backend parity (ray.util.joblib): Parallel batches run as
+    tasks; sklearn GridSearchCV works through it."""
+    from joblib import Parallel, delayed, parallel_backend
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=2)(delayed(lambda x: x * x)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+    from sklearn.datasets import make_classification
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    X, y = make_classification(n_samples=120, random_state=0)
+    with parallel_backend("ray_tpu"):
+        gs = GridSearchCV(LogisticRegression(max_iter=200), {"C": [0.1, 1.0]}, cv=2)
+        gs.fit(X, y)
+    assert gs.best_score_ > 0.7
