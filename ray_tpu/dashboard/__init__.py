@@ -107,6 +107,27 @@ def start_dashboard(port: int = 8765) -> int:
             except Exception as e:  # noqa: BLE001
                 self._reply(500, json.dumps({"error": str(e)}).encode(), "application/json")
 
+        def do_PUT(self):
+            # declarative serve deploy (parity: the REST API the reference's
+            # `serve deploy` talks to: PUT /api/serve/applications/)
+            import ray_tpu  # noqa: F401
+
+            try:
+                if self.path.rstrip("/") == "/api/serve/applications":
+                    from ray_tpu import serve as serve_lib
+
+                    length = int(self.headers.get("Content-Length") or 0)
+                    config = json.loads(self.rfile.read(length))
+                    handles = serve_lib.deploy_config(config)
+                    body = {"deployed": sorted(handles)}
+                    self._reply(200, json.dumps(body).encode(), "application/json")
+                else:
+                    self._reply(404, b'{"error": "not found"}', "application/json")
+            except Exception as e:  # noqa: BLE001
+                self._reply(
+                    500, json.dumps({"error": str(e)}).encode(), "application/json"
+                )
+
         def _reply(self, code: int, blob: bytes, ctype: str):
             self.send_response(code)
             self.send_header("Content-Type", ctype)

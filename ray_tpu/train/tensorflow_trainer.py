@@ -49,7 +49,9 @@ def _setup_tf_config(rendezvous_key: str) -> bool:
         True,
     )
     roster = [None] * world
-    deadline = time.monotonic() + 60
+    # generous: TF imports + worker spawn can take tens of seconds on a
+    # loaded single-core box
+    deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         missing = False
         for r in range(world):

@@ -92,6 +92,23 @@ class _GroupActor:
             return True
         raise ValueError(op)
 
+    def p2p_send(self, key: tuple, value) -> bool:
+        """Deposit a point-to-point payload for one receiver."""
+        with self._lock:
+            self.results[key] = value
+        self._event(key).set()
+        return True
+
+    def p2p_recv(self, key: tuple, timeout: float):
+        ev = self._event(key)
+        if not ev.wait(timeout):
+            raise TimeoutError(f"recv {key} timed out")
+        with self._lock:
+            value = self.results.pop(key)
+            # allow tag reuse: the next send on this key re-sets the event
+            self._events.pop(key, None)
+        return value
+
     def fetch(self, key: tuple):
         return self.results.get(key)
 
@@ -148,6 +165,21 @@ class CollectiveGroup:
 
     def barrier(self) -> None:
         self._run("barrier", True)
+
+    # -- point-to-point (parity: ray.util.collective send/recv,
+    # collective.py:531) ---------------------------------------------------
+
+    def send(self, tensor: np.ndarray, dst_rank: int, tag: int = 0) -> None:
+        key = ("p2p", self.rank, dst_rank, tag)
+        ray_tpu.get(
+            self._actor.p2p_send.remote(key, np.asarray(tensor)), timeout=300
+        )
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 300.0) -> np.ndarray:
+        key = ("p2p", src_rank, self.rank, tag)
+        return ray_tpu.get(
+            self._actor.p2p_recv.remote(key, timeout), timeout=timeout + 10
+        )
 
 
 def init_collective_group(world_size: int, rank: int, group_name: str = "default") -> CollectiveGroup:

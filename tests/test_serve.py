@@ -379,3 +379,43 @@ def test_user_config_reconfigure(serve_cluster):
     third = handle3.remote().result(timeout_s=60)
     assert third["threshold"] == 9
     serve.delete("ucfg")
+
+
+def test_rest_deploy_endpoint(serve_cluster, tmp_path):
+    """PUT /api/serve/applications deploys a declarative config (parity: the
+    reference's serve REST API)."""
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    _repo_root_on_path()
+    port = start_dashboard(port=0)
+    try:
+        config = {
+            "applications": [
+                {
+                    "name": "restapp",
+                    "import_path": "examples.serve_config_app:app",
+                    "deployments": [{"name": "Doubler", "num_replicas": 1}],
+                }
+            ]
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/serve/applications",
+            data=json.dumps(config).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        body = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert body["deployed"] == ["restapp"]
+        from ray_tpu.serve import get_app_handle
+
+        assert get_app_handle("restapp").remote(3).result(timeout_s=60) == 7
+        # GET /api/serve reflects it
+        st = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/api/serve", timeout=30).read()
+        )
+        assert "restapp" in st
+        serve.delete("restapp")
+    finally:
+        stop_dashboard()

@@ -232,3 +232,28 @@ def test_joblib_backend_sklearn(ray_start_regular):
         gs = GridSearchCV(LogisticRegression(max_iter=200), {"C": [0.1, 1.0]}, cv=2)
         gs.fit(X, y)
     assert gs.best_score_ > 0.7
+
+
+def test_collective_send_recv(ray_start_regular):
+    """p2p send/recv parity (ray.util.collective send/recv)."""
+    import numpy as np
+
+    from ray_tpu.util.collective import init_collective_group
+
+    @ray_tpu.remote
+    def rank0():
+        g = init_collective_group(2, 0, "p2p_test")
+        g.send(np.arange(4.0), dst_rank=1, tag=1)
+        got = g.recv(src_rank=1, tag=2, timeout=60)
+        return float(got.sum())
+
+    @ray_tpu.remote
+    def rank1():
+        g = init_collective_group(2, 1, "p2p_test")
+        got = g.recv(src_rank=0, tag=1, timeout=60)
+        g.send(got * 10, dst_rank=0, tag=2)
+        return float(got.sum())
+
+    a, b = ray_tpu.get([rank0.remote(), rank1.remote()], timeout=120)
+    assert b == 6.0      # received 0+1+2+3
+    assert a == 60.0     # received the echo *10
