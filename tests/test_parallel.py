@@ -121,12 +121,6 @@ def test_forward_parallel_vs_sequential_block(cpu_mesh_devices):
     assert np.all(np.isfinite(np.asarray(out, dtype=np.float32)))
 
 
-def test_graft_entry_dryrun(cpu_mesh_devices):
-    import __graft_entry__
-
-    __graft_entry__.dryrun_multichip(8)
-
-
 def test_graft_entry_single(cpu_mesh_devices):
     import __graft_entry__
 
