@@ -394,6 +394,13 @@ class WorkerRuntime:
             # worker loop (parity: RuntimeEnvSetupError)
             if spec.runtime_env:
                 saved_env = self._apply_runtime_env(spec)
+                if spec.task_type == TaskType.ACTOR_CREATION:
+                    # a dedicated actor worker keeps its runtime env for the
+                    # actor's whole lifetime (parity: runtime envs are
+                    # per-process, python/ray/_private/runtime_env/plugin.py);
+                    # restoring after __init__ would strip env_vars from
+                    # every subsequent method call
+                    saved_env = {}
             if spec.task_type == TaskType.ACTOR_CREATION:
                 cls = cloudpickle.loads(spec.function)
                 args, kwargs = self._resolve_args(spec)

@@ -24,6 +24,7 @@ from ray_tpu.parallel.sharding import (
     replicated,
     with_sharding,
 )
+from ray_tpu.parallel import distributed
 
 __all__ = [
     "AXIS_DATA",
@@ -39,4 +40,5 @@ __all__ = [
     "with_sharding",
     "logical_to_mesh_spec",
     "infer_param_sharding",
+    "distributed",
 ]

@@ -130,8 +130,11 @@ def mesh_from_pod_type(pod_type: str, config: Optional[MeshConfig] = None) -> Me
     if want and len(devices) != want:
         raise ValueError(
             f"pod type {pod_type} has {want} chips but {len(devices)} devices "
-            f"are visible (multi-host meshes need jax.distributed initialized "
-            f"on every slice host)"
+            f"are visible. Multi-host slices need jax.distributed initialized "
+            f"on every slice host first: use ScalingConfig("
+            f"use_jax_distributed=True) in JaxTrainer, or call "
+            f"ray_tpu.parallel.distributed.initialize(coord, n_procs, rank) "
+            f"directly — afterwards jax.devices() is the global set."
         )
     return create_mesh(config or MeshConfig(data=-1), devices=devices)
 

@@ -42,11 +42,41 @@ class TrainStepBundle:
     batch_shard: NamedSharding
     config: Any
 
+    init_seed_fn: Optional[Callable[[int], Any]] = None
+
+    def init_state(self, seed: int = 0):
+        """Initialize the sharded train state from an integer seed.
+
+        Multi-host safe: the PRNG key is derived *inside* the jitted program
+        from the static seed, so there are no host-local array inputs — every
+        process traces the identical program and XLA materializes each
+        parameter shard on its owner. Prefer this over ``init_fn(PRNGKey)``
+        when the mesh spans processes.
+        """
+        if self.init_seed_fn is not None:
+            return self.init_seed_fn(seed)
+        return self.init_fn(jax.random.PRNGKey(seed))
+
     def shard_batch(self, tokens, targets):
         return (
-            jax.device_put(tokens, self.batch_shard),
-            jax.device_put(targets, self.batch_shard),
+            put_global(tokens, self.batch_shard),
+            put_global(targets, self.batch_shard),
         )
+
+
+def put_global(host_array, sharding: NamedSharding):
+    """Place a host array under ``sharding``, including meshes that span
+    processes (multi-host SPMD): every process passes the same *global* value
+    and only its addressable shards are materialized. Single-host shardings
+    take the fast batched ``device_put`` path."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(host_array, sharding)
+    import numpy as np
+
+    host_array = np.asarray(host_array)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx]
+    )
 
 
 def build_lm_train_step(
@@ -107,6 +137,11 @@ def build_lm_train_step(
     # program), step infers in_shardings from the committed state + batch
     init_jit = jax.jit(init)
     step_jit = jax.jit(step, donate_argnums=(0,))
+    # seed-static variant: no array inputs, so it is valid on meshes that
+    # span processes (a host-local PRNGKey array would not be)
+    init_seed_jit = jax.jit(
+        lambda seed: init(jax.random.PRNGKey(seed)), static_argnums=0
+    )
 
     return TrainStepBundle(
         mesh=mesh,
@@ -115,4 +150,5 @@ def build_lm_train_step(
         param_shardings=p_shard,
         batch_shard=b_shard,
         config=cfg,
+        init_seed_fn=init_seed_jit,
     )

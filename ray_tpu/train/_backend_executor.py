@@ -99,6 +99,7 @@ class BackendExecutor:
                 resources={
                     k: v for k, v in res.items() if k not in ("CPU", "TPU")
                 },
+                runtime_env=self.scaling.worker_runtime_env,
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self.pg, placement_group_bundle_index=rank
                 ),

@@ -22,6 +22,15 @@ class ScalingConfig:
     # TPU slice topology, e.g. "v5litepod-16": one worker per slice host,
     # gang-scheduled onto an ICI-connected slice
     topology: Optional[str] = None
+    # Multi-host SPMD: each worker process joins a jax.distributed
+    # coordination service (rendezvous over the cluster KV) so jax.devices()
+    # becomes the global device set and one jitted step spans all hosts.
+    # The TPU-native replacement for the reference's NCCL process-group
+    # setup (python/ray/train/torch/config.py:65).
+    use_jax_distributed: bool = False
+    # runtime_env applied to each train worker actor (env_vars etc.) — used
+    # e.g. to force per-worker virtual CPU device counts in tests
+    worker_runtime_env: Optional[Dict] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -3,9 +3,12 @@
 Parity: ``DataParallelTrainer`` (``python/ray/train/data_parallel_trainer.py:25``)
 + ``TorchTrainer`` fit path (SURVEY.md §3.4). Differences by design:
 
-* no process-group rendezvous — the train loop builds a mesh and jits
-  (the reference's ``_setup_torch_process_group``, ``torch/config.py:65``,
-  has no TPU analogue: collectives are in-program over ICI);
+* within one host no process-group rendezvous is needed — the train loop
+  builds a mesh and jits; collectives are in-program over ICI. For a mesh
+  *spanning* hosts, ``ScalingConfig(use_jax_distributed=True)`` makes each
+  worker join a ``jax.distributed`` coordination service (rendezvous over
+  the cluster KV) before the user loop runs — the TPU-native replacement
+  for ``_setup_torch_process_group`` (``torch/config.py:65``);
 * ``ScalingConfig(topology=...)`` turns into a slice-aware placement group;
 * checkpoints are orbax pytrees behind the same dir-of-files ``Checkpoint``.
 """
@@ -14,12 +17,58 @@ from __future__ import annotations
 
 import os
 import time
+import uuid
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.train._backend_executor import BackendExecutor
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train._config import RunConfig, ScalingConfig
 from ray_tpu.train._result import Result
+
+
+def _setup_jax_distributed(rendezvous_key: str) -> bool:
+    """Join the jax.distributed coordination service (backend ``on_start``).
+
+    Rank 0 publishes ``ip:port`` through the cluster KV; every worker calls
+    ``jax.distributed.initialize`` against it. Afterwards ``jax.devices()``
+    is the global device set across the worker group.
+    """
+    from ray_tpu._private.worker import get_runtime
+    from ray_tpu.parallel import distributed as dist
+    from ray_tpu.train._session import get_context
+    from ray_tpu.train.jax_utils import ensure_platform
+    from ray_tpu.train.torch_trainer import _node_ip
+
+    ctx = get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    if world <= 1:
+        return False
+    ensure_platform()
+    rt = get_runtime()
+    coord = dist.rendezvous_via_kv(
+        rt, rendezvous_key, rank, world, node_ip=_node_ip()
+    )
+    dist.initialize(coord, num_processes=world, process_id=rank)
+    return True
+
+
+def _teardown_jax_distributed(rendezvous_key: str) -> None:
+    from ray_tpu._private.worker import get_runtime
+    from ray_tpu.parallel import distributed as dist
+    from ray_tpu.train._session import get_context
+
+    try:
+        # best-effort: rank 0 finishing first tears down the coordination
+        # service, so a slower rank's shutdown may raise — that must never
+        # overwrite a successful training result
+        dist.shutdown()
+    except Exception:
+        pass
+    try:
+        if get_context().get_world_rank() == 0:
+            dist.release_rendezvous(get_runtime(), rendezvous_key)
+    except Exception:
+        pass
 
 
 class JaxTrainer:
@@ -39,6 +88,32 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        if self.scaling_config.use_jax_distributed:
+            self.train_loop = self._wrap_distributed(train_loop_per_worker)
+
+    @staticmethod
+    def _wrap_distributed(user_fn: Callable) -> Callable:
+        base_key = f"jaxdist_{uuid.uuid4().hex[:12]}"
+
+        def wrapped(config=None):
+            import inspect
+
+            # fit() injects a per-attempt suffix so a retry never rendezvous
+            # against the dead coordinator a failed attempt left in the KV
+            if isinstance(config, dict):
+                key = f"{base_key}_{config.pop('__jaxdist_attempt__', 0)}"
+            else:
+                key = base_key
+            joined = _setup_jax_distributed(key)
+            try:
+                if config is not None and len(inspect.signature(user_fn).parameters):
+                    return user_fn(config)
+                return user_fn()
+            finally:
+                if joined:
+                    _teardown_jax_distributed(key)
+
+        return wrapped
 
     def fit(self) -> Result:
         name = self.run_config.name or f"JaxTrainer_{time.strftime('%Y%m%d_%H%M%S')}"
@@ -75,7 +150,12 @@ class JaxTrainer:
             try:
                 executor.start()
                 latest = checkpoints[-1][1] if checkpoints else self.resume_from_checkpoint
-                executor.run(train_fn, config, latest_ckpt=latest, report_callback=on_report)
+                run_config = config
+                if self.scaling_config.use_jax_distributed:
+                    # per-attempt rendezvous key suffix (see _wrap_distributed)
+                    run_config = dict(config or {})
+                    run_config["__jaxdist_attempt__"] = attempt
+                executor.run(train_fn, run_config, latest_ckpt=latest, report_callback=on_report)
                 error = None
                 break
             except Exception as e:  # noqa: BLE001

@@ -39,6 +39,17 @@ def test_dryrun_multichip_hermetic_fresh_process():
     assert "dryrun_multichip ok" in proc.stdout
 
 
+def test_dryrun_multichip_multiprocess():
+    # multi-host SPMD shape on virtual devices: 2 processes x 4 cpu devices
+    # joined via jax.distributed = one 8-device global mesh
+    proc = _run(
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(8, n_processes=2)"
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "processes=2" in proc.stdout
+
+
 def test_dryrun_multichip_after_default_backend_initialized():
     # Even if the caller initialized the default (possibly hardware) backend
     # first, the dry run must still complete on 8 virtual CPU devices.

@@ -1,0 +1,160 @@
+"""Multi-host SPMD training: JaxTrainer workers on separate daemon nodes
+joining one ``jax.distributed`` coordination service.
+
+Parity: the reference's multi-worker process-group path
+(``python/ray/train/torch/config.py:65`` via
+``_internal/backend_executor.py:129``), redesigned TPU-first: after the
+KV rendezvous, the *mesh spans the worker processes* and one jitted train
+step runs over all of them (SURVEY.md §7 step 5, the "aha" milestone).
+Virtual multi-host: 2 worker processes x 4 forced CPU devices = one
+8-device global mesh, per SURVEY.md §4(e).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig, report
+
+N_STEPS = 3
+SEQ = 64
+BATCH = 8
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        d_ff=256,
+        max_seq_len=SEQ,
+        parallel_block=True,
+        use_swiglu=False,
+        remat=False,
+        dtype=jnp.float32,
+    )
+
+
+def _fixed_batches():
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, 255, (N_STEPS, BATCH, SEQ), dtype=np.int32)
+    tgts = np.roll(toks, -1, axis=2)
+    return toks, tgts
+
+
+def _run_steps(mesh_devices_expected: int):
+    """Build the tiny flagship over an fsdp mesh on all visible devices and
+    run N_STEPS on fixed data; returns the per-step losses."""
+    import jax
+
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.spmd import build_lm_train_step
+
+    devices = jax.devices()
+    assert len(devices) == mesh_devices_expected, (
+        f"expected global mesh of {mesh_devices_expected}, got {len(devices)}"
+    )
+    mesh = create_mesh(MeshConfig(fsdp=mesh_devices_expected), devices=devices)
+    bundle = build_lm_train_step(_tiny_cfg(), mesh, learning_rate=1e-2)
+    state = bundle.init_state(seed=0)
+    toks, tgts = _fixed_batches()
+    losses = []
+    for i in range(N_STEPS):
+        tok, tgt = bundle.shard_batch(toks[i], tgts[i])
+        state, metrics = bundle.step_fn(state, tok, tgt)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+@pytest.fixture
+def two_node_cluster():
+    # head has no CPUs: train workers are forced onto the two daemon nodes
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    yield cluster
+    cluster.shutdown()
+
+
+def test_jax_distributed_spans_daemon_nodes(two_node_cluster):
+    """2 worker processes x 4 virtual devices train over ONE 8-device fsdp
+    mesh; losses match a single-process 8-device run of the same program."""
+
+    # self-contained closure: cloudpickle ships it by value (the tests module
+    # is not importable from daemon-node worker processes)
+    def train_loop(config):
+        import numpy as np
+
+        import jax
+        import ray_tpu.train as train
+        from ray_tpu.models.transformer import TransformerConfig
+        from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+        from ray_tpu.parallel.spmd import build_lm_train_step
+
+        n_steps, seq, batch = config["n_steps"], config["seq"], config["batch"]
+        devices = jax.devices()
+        assert len(devices) == 8, f"global mesh should be 8, got {len(devices)}"
+        mesh = create_mesh(MeshConfig(fsdp=8), devices=devices)
+        import jax.numpy as jnp
+
+        # f32 so cross-process (gloo) vs in-process collective reduction
+        # order stays below the comparison tolerance
+        cfg = TransformerConfig(
+            vocab_size=256,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            d_ff=256,
+            max_seq_len=seq,
+            parallel_block=True,
+            use_swiglu=False,
+            remat=False,
+            dtype=jnp.float32,
+        )
+        bundle = build_lm_train_step(cfg, mesh, learning_rate=1e-2)
+        state = bundle.init_state(seed=0)
+        rng = np.random.default_rng(7)
+        toks = rng.integers(0, 255, (n_steps, batch, seq), dtype=np.int32)
+        tgts = np.roll(toks, -1, axis=2)
+        losses = []
+        for i in range(n_steps):
+            tok, tgt = bundle.shard_batch(toks[i], tgts[i])
+            state, metrics = bundle.step_fn(state, tok, tgt)
+            losses.append(float(metrics["loss"]))
+        train.report({"losses": losses})
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"n_steps": N_STEPS, "seq": SEQ, "batch": BATCH},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            use_jax_distributed=True,
+            worker_runtime_env={
+                "env_vars": {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                }
+            },
+        ),
+        run_config=RunConfig(name="jaxdist_test"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    distributed_losses = result.metrics["losses"]
+    assert len(distributed_losses) == N_STEPS
+    assert all(np.isfinite(l) for l in distributed_losses)
+    # training must actually make progress
+    assert distributed_losses[-1] < distributed_losses[0]
+
+    # reference: the identical program on this process's own 8 cpu devices
+    single_losses = _run_steps(mesh_devices_expected=8)
+    np.testing.assert_allclose(
+        distributed_losses, single_losses, rtol=2e-5, atol=1e-6
+    )
