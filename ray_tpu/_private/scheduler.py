@@ -321,6 +321,13 @@ class GcsTables:
         with self._lock:
             return self.kv.pop((ns, key), None) is not None
 
+    def kv_pop(self, ns: str, key: bytes) -> Optional[bytes]:
+        """Atomic get+delete: exactly one caller observes a given value (used
+        by the workflow event mailbox, where get-then-del would let a post
+        racing between the two calls be deleted unseen)."""
+        with self._lock:
+            return self.kv.pop((ns, key), None)
+
     def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
         with self._lock:
             return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
@@ -1717,6 +1724,8 @@ class Scheduler:
             return self.gcs.kv_get(*args)
         if op == "kv_del":
             return self.gcs.kv_del(*args)
+        if op == "kv_pop":
+            return self.gcs.kv_pop(*args)
         if op == "kv_keys":
             return self.gcs.kv_keys(*args)
         if op == "get_actor_by_name":

@@ -326,6 +326,7 @@ class DriverRuntime:
         "kv_put",
         "kv_get",
         "kv_del",
+        "kv_pop",
         "kv_keys",
         "claim_actor_name",
         "get_actor_by_name",

@@ -76,6 +76,30 @@ int main(int argc, char** argv) {
     printf("SKIP call_actor (%s)\n", err.c_str());
   }
 
+  // repeated-container reply: the harness actor's dup() returns [d, d] with
+  // d a non-empty dict, so the pickle stream memoizes d before filling it
+  // and references it via BINGET — both decoded copies must carry the items
+  std::string dup_oid;
+  if (client.CallActor("cpp_demo", "dup", {}, &dup_oid, &err)) {
+    ray_tpu::PyValue dup;
+    if (!client.Get(dup_oid, 60.0, &dup, &err)) {
+      fprintf(stderr, "dup result get failed: %s\n", err.c_str());
+      return 1;
+    }
+    bool ok = dup.items.size() == 2;
+    for (const auto& d : dup.items) {
+      const ray_tpu::PyValue* v = d.DictGet("k");
+      ok = ok && v != nullptr && v->items.size() == 3 && v->items[2].i == 3;
+    }
+    if (!ok) {
+      fprintf(stderr, "memoized container decoded wrong\n");
+      return 1;
+    }
+    printf("OK memo_roundtrip\n");
+  } else {
+    printf("SKIP memo_roundtrip (%s)\n", err.c_str());
+  }
+
   client.Close();
   printf("OK done\n");
   return 0;

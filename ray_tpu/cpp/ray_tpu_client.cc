@@ -21,6 +21,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
@@ -334,6 +335,7 @@ class Unpickler {
           PyValue t;
           t.kind = PyValue::Kind::kTuple;
           t.items.assign(stack_.begin() + m, stack_.end());
+          FlushDropMemoSrcsFrom(m);
           stack_.resize(m);
           Push(std::move(t));
           break;
@@ -349,12 +351,15 @@ class Unpickler {
           auto& list = stack_[m - 1];
           for (size_t i = m; i < stack_.size(); i++)
             list.items.push_back(stack_[i]);
+          FlushDropMemoSrcsFrom(m);
           stack_.resize(m);
+          MarkMemoDirtyAt(m - 1);
           break;
         }
         case 'a': {                                       // APPEND
           PyValue v = Pop();
           stack_.back().items.push_back(std::move(v));
+          MarkMemoDirtyAt(stack_.size() - 1);
           break;
         }
         case '}': {                                       // EMPTY_DICT
@@ -368,22 +373,26 @@ class Unpickler {
           auto& dict = stack_[m - 1];
           for (size_t i = m; i + 1 < stack_.size(); i += 2)
             dict.dict.emplace_back(stack_[i], stack_[i + 1]);
+          FlushDropMemoSrcsFrom(m);
           stack_.resize(m);
+          MarkMemoDirtyAt(m - 1);
           break;
         }
         case 's': {                                       // SETITEM
           PyValue v = Pop();
           PyValue k = Pop();
           stack_.back().dict.emplace_back(std::move(k), std::move(v));
+          MarkMemoDirtyAt(stack_.size() - 1);
           break;
         }
         case 0x94:                                        // MEMOIZE
           memo_.push_back(stack_.back());
+          RecordMemoSrc(memo_.size() - 1);
           break;
         case 'q': memo_put(U8()); break;                  // BINPUT
         case 'r': memo_put(U32()); break;                 // LONG_BINPUT
-        case 'h': Push(memo_.at(U8())); break;            // BINGET
-        case 'j': Push(memo_.at(U32())); break;           // LONG_BINGET
+        case 'h': Push(MemoGet(U8())); break;             // BINGET
+        case 'j': Push(MemoGet(U32())); break;            // LONG_BINGET
         case 0x93: {                                      // STACK_GLOBAL
           PyValue name = Pop();
           PyValue mod = Pop();
@@ -476,6 +485,7 @@ class Unpickler {
   }
   void Push(PyValue v) { stack_.push_back(std::move(v)); }
   PyValue Pop() {
+    FlushDropMemoSrcsFrom(stack_.size() - 1);
     PyValue v = std::move(stack_.back());
     stack_.pop_back();
     return v;
@@ -484,6 +494,7 @@ class Unpickler {
     PyValue t;
     t.kind = PyValue::Kind::kTuple;
     t.items.assign(stack_.end() - n, stack_.end());
+    FlushDropMemoSrcsFrom(stack_.size() - n);
     stack_.resize(stack_.size() - n);
     Push(std::move(t));
   }
@@ -492,9 +503,51 @@ class Unpickler {
     marks_.pop_back();
     return m;
   }
+  // CPython memoizes containers BEFORE filling them (EMPTY_DICT, MEMOIZE,
+  // then SETITEMS). The memo here is by-value, so each memo slot remembers
+  // which stack position it snapshotted; a mutation only marks the slot
+  // dirty (O(1) amortized), and the re-snapshot is taken lazily — on the
+  // next BINGET of the slot, or when the container leaves the stack. This
+  // keeps decode linear: a large list arriving as many APPENDS batches is
+  // copied at most once per actual reuse, not once per batch.
+  // (Self-referential containers remain out of scope for this by-value
+  // model; protocol replies are plain data.)
+  struct MemoSrc {
+    size_t pos;    // stack position snapshotted from
+    size_t slot;   // memo slot
+    bool dirty;    // container mutated since last snapshot
+  };
+  void RecordMemoSrc(size_t slot) {
+    memo_srcs_.push_back(MemoSrc{stack_.size() - 1, slot, false});
+  }
+  // snapshot any dirty slots whose source is about to leave the stack, then
+  // drop their tracking. MUST be called while stack_[pos] is still intact.
+  void FlushDropMemoSrcsFrom(size_t new_size) {
+    memo_srcs_.erase(
+        std::remove_if(memo_srcs_.begin(), memo_srcs_.end(),
+                       [&](const MemoSrc& ms) {
+                         if (ms.pos < new_size) return false;
+                         if (ms.dirty) memo_[ms.slot] = stack_[ms.pos];
+                         return true;
+                       }),
+        memo_srcs_.end());
+  }
+  void MarkMemoDirtyAt(size_t pos) {
+    for (auto& ms : memo_srcs_)
+      if (ms.pos == pos) ms.dirty = true;
+  }
+  const PyValue& MemoGet(size_t idx) {
+    for (auto& ms : memo_srcs_)
+      if (ms.slot == idx && ms.dirty) {
+        memo_[idx] = stack_[ms.pos];
+        ms.dirty = false;
+      }
+    return memo_.at(idx);
+  }
   void memo_put(size_t idx) {
     if (memo_.size() <= idx) memo_.resize(idx + 1);
     memo_[idx] = stack_.back();
+    RecordMemoSrc(idx);
   }
 
   const std::string& d_;
@@ -502,6 +555,9 @@ class Unpickler {
   std::vector<PyValue> stack_;
   std::vector<size_t> marks_;
   std::vector<PyValue> memo_;
+  // live (stack position, memo slot) tracking entries; dropped (with a
+  // final snapshot if dirty) as the stack shrinks past them
+  std::vector<MemoSrc> memo_srcs_;
 };
 
 }  // namespace

@@ -11,6 +11,8 @@ way).
 
 from __future__ import annotations
 
+import hmac
+import hashlib
 import pickle
 from typing import Dict, Optional
 
@@ -18,6 +20,32 @@ import ray_tpu
 
 _GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 SERVICE_METHOD = "/ray_tpu.serve.ServeAPI/Predict"
+_SIG_LEN = 32
+
+
+def _cluster_key() -> bytes:
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().config.cluster_auth_key.encode()
+
+
+def _sign(key: bytes, blob: bytes) -> bytes:
+    return hmac.new(key, blob, hashlib.sha256).digest()
+
+
+def _frame(key: bytes, obj) -> bytes:
+    blob = pickle.dumps(obj)
+    return _sign(key, blob) + blob
+
+
+def _unframe(key: bytes, framed: bytes):
+    """Verify the HMAC prefix before unpickling — pickles execute code, so
+    an unauthenticated local process must never reach ``pickle.loads`` (the
+    same reason every other socket in this codebase does challenge auth)."""
+    sig, blob = framed[:_SIG_LEN], framed[_SIG_LEN:]
+    if len(sig) != _SIG_LEN or not hmac.compare_digest(_sign(key, blob), sig):
+        raise PermissionError("bad or missing cluster auth signature")
+    return pickle.loads(blob)
 
 
 @ray_tpu.remote(max_concurrency=16)
@@ -27,6 +55,7 @@ class GRPCProxy:
         from concurrent import futures
 
         self._handles: Dict[str, object] = {}
+        self._key = _cluster_key()
         proxy = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -38,11 +67,16 @@ class GRPCProxy:
 
                 def unary(request_bytes, context):
                     try:
-                        payload = pickle.loads(request_bytes)
+                        payload = _unframe(proxy._key, request_bytes)
+                    except PermissionError as e:
+                        context.abort(
+                            grpc.StatusCode.UNAUTHENTICATED, str(e)
+                        )
+                    try:
                         result = proxy._call(app, payload)
-                        return pickle.dumps({"result": result})
+                        return _frame(proxy._key, {"result": result})
                     except Exception as e:  # noqa: BLE001
-                        return pickle.dumps({"error": repr(e)})
+                        return _frame(proxy._key, {"error": repr(e)})
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary,
@@ -102,18 +136,21 @@ def start_grpc_proxy(port: int = 0):
 
 def grpc_predict(address: str, payload, *, application: str = "default",
                  timeout_s: float = 60.0):
-    """Client helper: call the Serve gRPC ingress (pickled unary)."""
+    """Client helper: call the Serve gRPC ingress (HMAC-framed pickled
+    unary; the caller must share the cluster auth key)."""
     import grpc
 
+    key = _cluster_key()
     channel = grpc.insecure_channel(address)
     try:
         fn = channel.unary_unary(SERVICE_METHOD)
-        reply = pickle.loads(
+        reply = _unframe(
+            key,
             fn(
-                pickle.dumps(payload),
+                _frame(key, payload),
                 metadata=(("application", application),),
                 timeout=timeout_s,
-            )
+            ),
         )
     finally:
         channel.close()

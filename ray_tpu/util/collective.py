@@ -93,9 +93,12 @@ class _GroupActor:
         raise ValueError(op)
 
     def p2p_send(self, key: tuple, value) -> bool:
-        """Deposit a point-to-point payload for one receiver."""
+        """Deposit a point-to-point payload for one receiver. Payloads queue
+        per key, so two sends on the same (src, dst, tag) before the matching
+        recv both arrive in order (the reference's send/recv never loses a
+        message)."""
         with self._lock:
-            self.results[key] = value
+            self.results.setdefault(key, []).append(value)
         self._event(key).set()
         return True
 
@@ -104,9 +107,12 @@ class _GroupActor:
         if not ev.wait(timeout):
             raise TimeoutError(f"recv {key} timed out")
         with self._lock:
-            value = self.results.pop(key)
-            # allow tag reuse: the next send on this key re-sets the event
-            self._events.pop(key, None)
+            queue = self.results[key]
+            value = queue.pop(0)
+            if not queue:
+                del self.results[key]
+                # allow tag reuse: the next send on this key re-sets the event
+                self._events.pop(key, None)
         return value
 
     def fetch(self, key: tuple):

@@ -48,7 +48,11 @@ class KVEventListener(EventListener):
         rt = get_runtime()
         deadline = time.monotonic() + timeout_s
         while True:
-            raw = rt.rpc("kv_get", "workflow_events", key.encode())
+            # atomic claim: exactly one listener pops a given post, and the
+            # mailbox drains on consume so a *new* workflow on the same key
+            # never swallows a stale event from a previous run. Exactly-once
+            # across resume comes from the step checkpoint, not from the KV.
+            raw = rt.rpc("kv_pop", "workflow_events", key.encode())
             if raw is not None:
                 import pickle
 

@@ -397,6 +397,14 @@ def test_workflow_wait_for_event(ray_start_regular, tmp_path):
     out2 = workflow.resume("wf_event", storage=str(tmp_path))
     assert out2 == out
 
+    # the KV mailbox drains on consume: a brand-new listener on the same key
+    # must NOT see the already-consumed event from the earlier run
+    import pytest as _pytest
+
+    listener = workflow.KVEventListener()
+    with _pytest.raises(TimeoutError):
+        listener.poll_for_event("approval", timeout_s=1.0)
+
 
 def test_workflow_timer_listener(ray_start_regular, tmp_path):
     import time as _time

@@ -34,6 +34,13 @@ def test_cpp_client_end_to_end(cpp_demo_binary):
             def add(self, a, b):
                 return a + b
 
+            def dup(self):
+                # same dict twice: the pickled reply memoizes the container
+                # and references it (BINGET) — regression for the by-value
+                # memo bug where the second copy decoded empty
+                d = {"k": [1, 2, 3]}
+                return [d, d]
+
         actor = Adder.options(name="cpp_demo").remote()
         # make sure the actor is live before the C++ process calls it
         assert ray_tpu.get(actor.add.remote(1, 1), timeout=60) == 2
@@ -50,6 +57,7 @@ def test_cpp_client_end_to_end(cpp_demo_binary):
         assert "OK cluster_resources" in out
         assert "OK put_get" in out
         assert "OK call_actor 42" in out
+        assert "OK memo_roundtrip" in out
         assert "OK done" in out
     finally:
         ray_tpu.shutdown()

@@ -337,6 +337,23 @@ def test_grpc_ingress(serve_cluster):
 
     with _pytest.raises(RuntimeError, match="nope"):
         serve.grpc_predict(f"127.0.0.1:{port}", "x", application="grpcboom")
+    # unauthenticated raw pickle must be rejected before unpickling
+    # (pickle.loads executes code; parity with the HMAC auth on every other
+    # socket in the framework)
+    import pickle
+
+    import grpc
+
+    from ray_tpu.serve._grpc_proxy import SERVICE_METHOD
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        fn = channel.unary_unary(SERVICE_METHOD)
+        with pytest.raises(grpc.RpcError) as excinfo:
+            fn(pickle.dumps("unauthenticated"), timeout=30)
+        assert excinfo.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    finally:
+        channel.close()
     serve.delete("grpcapp")
     serve.delete("grpcboom")
 

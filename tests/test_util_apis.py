@@ -257,3 +257,36 @@ def test_collective_send_recv(ray_start_regular):
     a, b = ray_tpu.get([rank0.remote(), rank1.remote()], timeout=120)
     assert b == 6.0      # received 0+1+2+3
     assert a == 60.0     # received the echo *10
+
+
+def test_collective_send_recv_queues_per_key(ray_start_regular):
+    """Two sends on the same (src, dst, tag) before the matching recv must
+    both arrive, in order — the first payload is never dropped."""
+    import numpy as np
+
+    from ray_tpu.util.collective import init_collective_group
+
+    @ray_tpu.remote
+    def sender():
+        g = init_collective_group(2, 0, "p2p_queue_test")
+        g.send(np.array([1.0]), dst_rank=1, tag=7)
+        g.send(np.array([2.0]), dst_rank=1, tag=7)
+        # wait for the receiver's ack so the group actor stays alive
+        return float(g.recv(src_rank=1, tag=8, timeout=60)[0])
+
+    @ray_tpu.remote
+    def receiver():
+        import time
+
+        g = init_collective_group(2, 1, "p2p_queue_test")
+        time.sleep(1.0)  # let both sends land before the first recv
+        first = float(g.recv(src_rank=0, tag=7, timeout=60)[0])
+        second = float(g.recv(src_rank=0, tag=7, timeout=60)[0])
+        g.send(np.array([9.0]), dst_rank=0, tag=8)
+        return (first, second)
+
+    ack, (first, second) = ray_tpu.get(
+        [sender.remote(), receiver.remote()], timeout=120
+    )
+    assert (first, second) == (1.0, 2.0)
+    assert ack == 9.0
