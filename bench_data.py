@@ -1,0 +1,82 @@
+"""Train-ingest throughput bench: read -> map_batches(batch_size) ->
+iter_batches through the streaming executor.
+
+The shape the Data library exists for (SURVEY.md §3.4 step 5: blocks ->
+iter_batches feed on each train worker). The reference publishes no directly
+comparable single-box number for this pipeline, so ``reference`` is null and
+the metric tracks round-over-round progress.
+
+Run: python bench_data.py [--rows N]
+Prints one JSON line: {"metric", "value", "unit", "reference", "ratio"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--files", type=int, default=8)
+    args = ap.parse_args()
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    tmp = tempfile.mkdtemp(prefix="bench_data_")
+    try:
+        per = args.rows // args.files
+        src = ray_tpu.data.from_numpy(
+            {
+                "x": np.arange(args.rows, dtype=np.float32),
+                "y": np.arange(args.rows, dtype=np.int64) % 7,
+            },
+            num_blocks=args.files,
+        )
+        src.write_parquet(tmp)
+
+        def featurize(batch):
+            return {
+                "x": batch["x"] * 2.0 + 1.0,
+                "y": batch["y"],
+            }
+
+        # warm-up (worker spawn, import costs)
+        warm = ray_tpu.data.read_parquet(tmp).map_batches(featurize)
+        next(iter(warm.iter_batches(batch_size=4096)))
+
+        t0 = time.perf_counter()
+        ds = ray_tpu.data.read_parquet(tmp).map_batches(
+            featurize, batch_size=8192
+        )
+        rows = 0
+        for batch in ds.iter_batches(batch_size=8192):
+            rows += len(batch["x"])
+        dt = time.perf_counter() - t0
+        assert rows == args.rows, (rows, args.rows)
+        print(
+            json.dumps(
+                {
+                    "metric": "data_train_ingest_rows_per_s",
+                    "value": round(rows / dt, 1),
+                    "unit": "rows/s",
+                    "reference": None,
+                    "ratio": None,
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -3,9 +3,12 @@
 Parity: ``python/ray/data/dataset.py`` — lazy logical plan → execution over
 framework tasks with blocks in the object store; ``map_batches``
 (``dataset.py:383``), ``iter_batches`` (``:3668``), ``streaming_split``
-(``:1236``). Execution here is a pipelined pull model: consuming iterators
-launch per-block tasks with a bounded in-flight window (the role of the
-reference's ``StreamingExecutor`` backpressure, ``streaming_executor.py:48``).
+(``:1236``). Execution is an operator pipeline driven by the streaming
+executor (``ray_tpu/data/streaming_executor.py``): every stage — bounded
+read submission, fused task maps, actor pools, rebatching — runs
+concurrently over bounded windows, so stage 2 processes block k while
+stage 1 is still reading block k+n (the role of the reference's
+``StreamingExecutor``, ``streaming_executor.py:48``).
 """
 
 from __future__ import annotations
@@ -59,24 +62,75 @@ def _exec_block(block_or_ref, ops):
 
 
 class Dataset:
-    """A lazy plan: source block refs + a chain of per-block operators."""
+    """A lazy plan: sources (block refs / lazy read tasks) + operator stages
+    executed by the streaming executor."""
 
-    def __init__(self, block_refs: List, ops: Optional[List] = None, owned_actors=None):
+    def __init__(
+        self,
+        block_refs: List,
+        ops: Optional[List] = None,
+        owned_actors=None,
+        stages: Optional[List] = None,
+    ):
+        from ray_tpu.data.streaming_executor import TaskMapStage
+
         self._block_refs = list(block_refs)
-        self._ops = list(ops or [])
+        self._stages: List = list(stages or [])
+        if ops:
+            self._stages.append(TaskMapStage(ops))
         # actor pools whose pending tasks produce our blocks: pinned here so
         # handle-count reaping can't kill them before the blocks materialize
         self._owned_actors = list(owned_actors or [])
+
+    @property
+    def _ops(self) -> Optional[List]:
+        """The fused per-block op chain, when the whole plan is one fused
+        task-map over materialized refs — the fast path remote helpers
+        (_write_block, _block_unique, ...) can apply in a single task.
+        None when the plan has other stage kinds or lazy read sources."""
+        from ray_tpu.data.streaming_executor import ReadTask, TaskMapStage
+
+        if any(isinstance(r, ReadTask) for r in self._block_refs):
+            return None
+        ops: List = []
+        for stage in self._stages:
+            if not isinstance(stage, TaskMapStage):
+                return None
+            ops.extend(stage.ops)
+        return ops
+
+    def _refs_and_ops(self):
+        """(source refs, fused ops) — materializing first when the plan is
+        not a pure fused task-map chain."""
+        ops = self._ops
+        if ops is None:
+            return self.materialize()._block_refs, []
+        return self._block_refs, ops
 
     # -- transformations (lazy) -------------------------------------------
 
     def _with_op(self, kind: str, fn: Callable) -> "Dataset":
         import cloudpickle
 
+        from ray_tpu.data.streaming_executor import TaskMapStage
+
+        op = (kind, cloudpickle.dumps(fn))
+        stages = list(self._stages)
+        if stages and isinstance(stages[-1], TaskMapStage):
+            # fuse into the trailing task-map: the chain runs as ONE task
+            # per block (the reference's operator fusion)
+            stages[-1] = stages[-1].fused([op])
+        else:
+            stages.append(TaskMapStage([op]))
+        return Dataset(
+            self._block_refs, owned_actors=self._owned_actors, stages=stages
+        )
+
+    def _with_stage(self, stage) -> "Dataset":
         return Dataset(
             self._block_refs,
-            self._ops + [(kind, cloudpickle.dumps(fn))],
             owned_actors=self._owned_actors,
+            stages=self._stages + [stage],
         )
 
     def map(self, fn: Callable) -> "Dataset":
@@ -89,8 +143,15 @@ class Dataset:
         batch_size: Optional[int] = None,
         compute=None,
     ) -> "Dataset":
-        # batch_size=None applies fn per block (the common, fastest path)
-        ds = self if batch_size is None else self.repartition_by_rows(batch_size)
+        # batch_size=None applies fn per block (the common, fastest path);
+        # with batch_size the plan gains a streaming rebatch stage first
+        from ray_tpu.data.streaming_executor import RebatchStage
+
+        ds = (
+            self
+            if batch_size is None
+            else self._with_stage(RebatchStage(batch_size))
+        )
         from ray_tpu.data.context import ActorPoolStrategy
 
         if isinstance(compute, ActorPoolStrategy):
@@ -100,41 +161,17 @@ class Dataset:
     def _map_batches_actor_pool(self, fn: Callable, strategy) -> "Dataset":
         """Run fn in a pool of long-lived actors (parity:
         ActorPoolMapOperator): callable classes are constructed once per
-        actor; plain fns just avoid re-pickling per block."""
+        actor; plain fns just avoid re-pickling per block. Lazy: the pool
+        spins up when the pipeline is consumed, and blocks stream through
+        it with a bounded window — upstream stages keep producing while
+        the pool works (no plan-time drain barrier)."""
         import cloudpickle
 
-        fn_blob = cloudpickle.dumps(fn)
+        from ray_tpu.data.streaming_executor import ActorMapStage
 
-        @ray_tpu.remote
-        class _BlockWorker:
-            def __init__(self, blob):
-                import cloudpickle as cp
-
-                obj = cp.loads(blob)
-                # callable class -> instantiate once (expensive setup amortized)
-                self._fn = obj() if isinstance(obj, type) else obj
-
-            def apply(self, block):
-                return normalize_block(self._fn(block))
-
-        from ray_tpu.data.context import DataContext
-
-        workers = [_BlockWorker.remote(fn_blob) for _ in range(strategy.size)]
-        # round-robin over the pool, keeping object refs (blocks never pass
-        # through the driver); submission is windowed so in-flight work stays
-        # bounded (the backpressure contract) even for huge datasets
-        window = max(1, DataContext.get_current().max_inflight_blocks) * len(workers)
-        refs = []
-        inflight = []
-        for i, ref in enumerate(self._iter_exec_block_refs()):
-            out_ref = workers[i % len(workers)].apply.remote(ref)
-            refs.append(out_ref)
-            inflight.append(out_ref)
-            if len(inflight) >= window:
-                ray_tpu.wait(inflight, num_returns=len(inflight) - window + 1)
-                inflight = inflight[-(window - 1) :]
-        # the pool rides on the Dataset so reaping waits for consumption
-        return Dataset(refs, owned_actors=workers)
+        return self._with_stage(
+            ActorMapStage(cloudpickle.dumps(fn), strategy.size)
+        )
 
     def filter(self, fn: Callable) -> "Dataset":
         return self._with_op("filter", fn)
@@ -143,11 +180,15 @@ class Dataset:
         return self._with_op("flat_map", fn)
 
     def union(self, other: "Dataset") -> "Dataset":
-        if self._ops or other._ops:
+        if self._stages or other._stages:
             return Dataset(
-                self.materialize()._block_refs + other.materialize()._block_refs
+                self.materialize()._block_refs + other.materialize()._block_refs,
+                owned_actors=self._owned_actors + other._owned_actors,
             )
-        return Dataset(self._block_refs + other._block_refs)
+        return Dataset(
+            self._block_refs + other._block_refs,
+            owned_actors=self._owned_actors + other._owned_actors,
+        )
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Row-aligned zip: right-side blocks are re-sliced to the left's
@@ -230,10 +271,8 @@ class Dataset:
         """Distinct values of one column: per-block remote uniques, only the
         small distinct sets travel to the driver."""
         seen: set = set()
-        refs = [
-            _block_unique.remote(ref, self._ops, column)
-            for ref in self._block_refs
-        ]
+        src_refs, ops = self._refs_and_ops()
+        refs = [_block_unique.remote(ref, ops, column) for ref in src_refs]
         for vals in ray_tpu.get(refs, timeout=600):
             seen.update(vals)
         return sorted(seen)
@@ -291,27 +330,20 @@ class Dataset:
         mat = self.materialize()
         total = sum(block_num_rows(_fetch(r)) for r in mat._block_refs)
         per = max(1, (total + num_blocks - 1) // num_blocks)
-        return mat.repartition_by_rows(per)
+        # materialized output: repartition is a count-changing barrier op
+        # (num_blocks() must reflect the new partitioning immediately)
+        return mat.repartition_by_rows(per).materialize()
 
     def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
-        """Re-slice the block stream into fixed-size blocks (streaming)."""
-        refs = []
-        pieces: List[Batch] = []
-        buffered = 0
-        for block in self._iter_exec_blocks():
-            off = 0
-            n = block_num_rows(block)
-            while off < n:
-                take = min(rows_per_block - buffered, n - off)
-                pieces.append(slice_block(block, off, off + take))
-                buffered += take
-                off += take
-                if buffered == rows_per_block:
-                    refs.append(ray_tpu.put(concat_blocks(pieces)))
-                    pieces, buffered = [], 0
-        if buffered:
-            refs.append(ray_tpu.put(concat_blocks(pieces)))
-        return Dataset(refs)
+        """Re-slice the block stream into fixed-size blocks. Executes the
+        rebatch (streaming: prefetch window upstream, one output block
+        resident in the driver at a time) so block-count metadata is
+        immediately correct; map_batches(batch_size=...) uses the lazy
+        RebatchStage form instead, which defers the work into the
+        consumer-driven pipeline."""
+        from ray_tpu.data.streaming_executor import RebatchStage
+
+        return self._with_stage(RebatchStage(rows_per_block)).materialize()
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Distributed exchange shuffle (parity: the reference's push-based
@@ -458,27 +490,20 @@ class Dataset:
     # -- execution ---------------------------------------------------------
 
     def _iter_exec_block_refs(self) -> Iterator:
-        """Launch per-block tasks with a bounded in-flight window.
+        """Drive the streaming executor: all stages run concurrently over
+        bounded windows (DataContext.max_inflight_blocks per stage), so a
+        dataset arbitrarily larger than memory streams through a consumer
+        while every pipeline stage stays busy."""
+        from ray_tpu.data.streaming_executor import ReadTask, iter_stage_refs
 
-        The window (DataContext.max_inflight_blocks) is the backpressure
-        mechanism: at most W block-tasks' results are pending at once, so a
-        dataset arbitrarily larger than memory streams through a consumer."""
-        if not self._ops:
+        if not self._stages and not any(
+            isinstance(r, ReadTask) for r in self._block_refs
+        ):
             yield from self._block_refs
             return
-        from ray_tpu.data.context import DataContext
-
-        window = max(1, DataContext.get_current().max_inflight_blocks)
-        pending = []
-        idx = 0
-        while idx < len(self._block_refs) or pending:
-            while idx < len(self._block_refs) and len(pending) < window:
-                pending.append(
-                    _exec_block.remote(self._block_refs[idx], self._ops)
-                )
-                idx += 1
-            if pending:
-                yield pending.pop(0)
+        yield from iter_stage_refs(
+            self._block_refs, self._stages, self._owned_actors
+        )
 
     def _iter_exec_blocks(self) -> Iterator[Batch]:
         for ref in self._iter_exec_block_refs():
@@ -486,7 +511,11 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         """Execute the plan; returns a Dataset of plain block refs."""
-        if not self._ops:
+        from ray_tpu.data.streaming_executor import ReadTask
+
+        if not self._stages and not any(
+            isinstance(r, ReadTask) for r in self._block_refs
+        ):
             return self
         return Dataset(
             list(self._iter_exec_block_refs()), owned_actors=self._owned_actors
@@ -569,10 +598,11 @@ class Dataset:
 
         os.makedirs(path, exist_ok=True)
         blob = cloudpickle.dumps(writer_fn)
+        src_refs, ops = self._refs_and_ops()
         refs = [
-            _write_block.remote(ref, self._ops,
+            _write_block.remote(ref, ops,
                                 os.path.join(path, f"part-{i:05d}{ext}"), blob)
-            for i, ref in enumerate(self._block_refs)
+            for i, ref in enumerate(src_refs)
         ]
         return ray_tpu.get(refs, timeout=600)
 
@@ -618,7 +648,10 @@ class Dataset:
         return len(self._block_refs)
 
     def stats(self) -> str:
-        return f"Dataset(blocks={len(self._block_refs)}, ops={len(self._ops)})"
+        return (
+            f"Dataset(blocks={len(self._block_refs)}, "
+            f"stages={len(self._stages)})"
+        )
 
     def __repr__(self):
         return self.stats()

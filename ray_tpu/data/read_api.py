@@ -140,12 +140,12 @@ def _read_binary_file(path: str):
 
 def read_text(paths) -> Dataset:
     """One block per file of ``{"text": line}`` rows (parity: read_text)."""
-    return Dataset([_read_text_file.remote(p) for p in _expand_paths(paths, ".txt")])
+    return _lazy_read(_read_text_file, _expand_paths(paths, ".txt"))
 
 
 def read_binary_files(paths) -> Dataset:
     """One row per file: ``{"bytes": ..., "path": ...}``."""
-    return Dataset([_read_binary_file.remote(p) for p in _expand_paths(paths, "")])
+    return _lazy_read(_read_binary_file, _expand_paths(paths, ""))
 
 
 def from_arrow(table) -> Dataset:
@@ -155,12 +155,21 @@ def from_arrow(table) -> Dataset:
 
 
 def read_parquet(paths) -> Dataset:
-    return Dataset([_read_parquet_file.remote(p) for p in _expand_paths(paths, ".parquet")])
+    return _lazy_read(_read_parquet_file, _expand_paths(paths, ".parquet"))
 
 
 def read_csv(paths) -> Dataset:
-    return Dataset([_read_csv_file.remote(p) for p in _expand_paths(paths, ".csv")])
+    return _lazy_read(_read_csv_file, _expand_paths(paths, ".csv"))
 
 
 def read_json(paths) -> Dataset:
-    return Dataset([_read_json_file.remote(p) for p in _expand_paths(paths, ".json")])
+    return _lazy_read(_read_json_file, _expand_paths(paths, ".json"))
+
+
+def _lazy_read(remote_fn, paths: List[str]) -> Dataset:
+    """Source blocks as lazy ReadTasks: the streaming executor submits them
+    with a bounded window instead of flooding the cluster with one task per
+    file up front (parity: the reference's read-op backpressure)."""
+    from ray_tpu.data.streaming_executor import ReadTask
+
+    return Dataset([ReadTask(remote_fn, (p,)) for p in paths])

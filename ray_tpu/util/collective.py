@@ -36,13 +36,16 @@ class _GroupActor:
         self._events: Dict[tuple, Any] = {}
 
     def _event(self, key):
+        with self._lock:
+            return self._event_locked(key)
+
+    def _event_locked(self, key):
         import threading
 
-        with self._lock:
-            ev = self._events.get(key)
-            if ev is None:
-                ev = self._events[key] = threading.Event()
-            return ev
+        ev = self._events.get(key)
+        if ev is None:
+            ev = self._events[key] = threading.Event()
+        return ev
 
     def contribute_and_wait(self, key: tuple, rank: int, value, timeout: float):
         """Deposit a contribution and block until the collective completes
@@ -68,7 +71,9 @@ class _GroupActor:
             if len(entry) == self.world_size:
                 self.results[key] = self._finish(key, entry)
                 del self.contribs[key]
-                self._event(key).set()
+                # _event_locked: plain _event() re-takes the non-reentrant
+                # lock and would deadlock here
+                self._event_locked(key).set()
         return True
 
     def _finish(self, key, entry):
@@ -99,7 +104,10 @@ class _GroupActor:
         message)."""
         with self._lock:
             self.results.setdefault(key, []).append(value)
-        self._event(key).set()
+            # set inside the critical section: a delayed set() after the
+            # final recv drained the key would otherwise leave a set event
+            # with no queued payload (KeyError on the next recv)
+            self._event_locked(key).set()
         return True
 
     def p2p_recv(self, key: tuple, timeout: float):

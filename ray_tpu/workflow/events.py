@@ -50,8 +50,12 @@ class KVEventListener(EventListener):
         while True:
             # atomic claim: exactly one listener pops a given post, and the
             # mailbox drains on consume so a *new* workflow on the same key
-            # never swallows a stale event from a previous run. Exactly-once
-            # across resume comes from the step checkpoint, not from the KV.
+            # never swallows a stale event from a previous run. Delivery is
+            # therefore at-most-once per post: once the step checkpoint is
+            # written, resume replays from it and never re-waits; a crash in
+            # the narrow window between this pop and that checkpoint loses
+            # the post (the reference's HTTP event provider holds posts in
+            # actor memory and has the same window).
             raw = rt.rpc("kv_pop", "workflow_events", key.encode())
             if raw is not None:
                 import pickle
