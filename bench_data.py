@@ -33,7 +33,6 @@ def main() -> None:
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     tmp = tempfile.mkdtemp(prefix="bench_data_")
     try:
-        per = args.rows // args.files
         src = ray_tpu.data.from_numpy(
             {
                 "x": np.arange(args.rows, dtype=np.float32),

@@ -112,6 +112,15 @@ class Node:
         self.scheduler.nodes[self.head_node_id] = head
         self.scheduler.start()
 
+        # the cluster auth key must exist BEFORE the worker config snapshot:
+        # head-node workers authenticate peer sockets (cross-node channels,
+        # object transfer) against daemon-node workers, whose config carries
+        # the key from daemon registration — generating it lazily in the
+        # head server left early-spawned head workers with an empty key
+        if not config.cluster_auth_key:
+            import secrets
+
+            config.cluster_auth_key = secrets.token_hex(16)
         self._config_blob = pickle.dumps(config)
         self._ctx = _get_ctx()
         self.head_server = None  # started on demand (start_head_server)

@@ -265,6 +265,11 @@ class ActorState:
     num_handles: int = 1
     detached: bool = False
     max_task_retries: int = 0
+    # method calls submitted and not yet finished/failed; an out-of-scope
+    # actor is reaped only when this drains (reference semantics: the GCS
+    # terminates an out-of-scope actor after its submitted tasks finish)
+    outstanding: int = 0
+    pending_kill: bool = False
 
 
 @dataclass
@@ -912,7 +917,14 @@ class Scheduler:
                     and not st.detached
                     and st.state != "DEAD"
                 ):
-                    self._kill_actor(actor_id, no_restart=True)
+                    if st.outstanding > 0:
+                        # let submitted calls finish first (the completion
+                        # path performs the deferred kill)
+                        st.pending_kill = True
+                    else:
+                        self._kill_actor(actor_id, no_restart=True)
+                elif st.num_handles > 0:
+                    st.pending_kill = False
         elif kind == "create_pg":
             self._dispatch_dirty = True
             self._create_pg(cmd[1])
@@ -1010,6 +1022,7 @@ class Scheduler:
                 return
             # method calls inherit the actor's per-task retry budget
             rec.retries_left = actor.max_task_retries
+            actor.outstanding += 1
         # dependency check
         deps = self._unresolved_deps(spec)
         if deps:
@@ -1318,6 +1331,8 @@ class Scheduler:
             rec.state = "FINISHED"
             rec.end_time = time.monotonic()
             self._record_event(rec.spec, "FINISHED")
+            if spec is not None and spec.task_type == TaskType.ACTOR_TASK:
+                self._actor_task_settled(spec.actor_id)
         # commit each return
         if spec is not None:
             for i, entry in enumerate(results):
@@ -1460,6 +1475,23 @@ class Scheduler:
             self._commit_result(oid, ("error", blob))
         if rec.spec.task_type != TaskType.ACTOR_CREATION:
             self._unpin(rec.spec.arg_ref_ids())
+        if rec.spec.task_type == TaskType.ACTOR_TASK:
+            self._actor_task_settled(rec.spec.actor_id)
+
+    def _actor_task_settled(self, actor_id) -> None:
+        """One outstanding method call finished or failed; perform the
+        deferred out-of-scope kill once the last one drains."""
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return
+        actor.outstanding = max(0, actor.outstanding - 1)
+        if (
+            actor.pending_kill
+            and actor.outstanding == 0
+            and actor.state != "DEAD"
+        ):
+            actor.pending_kill = False
+            self._kill_actor(actor_id, no_restart=True)
 
     # ---- failure handling ------------------------------------------------
 

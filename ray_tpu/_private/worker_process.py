@@ -527,6 +527,9 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     worker_id = WorkerID(worker_id_bin)
     store = create_store_client(shm_dir, fallback_dir, config.object_store_memory)
     rt = WorkerRuntime(conn, worker_id, store, config)
+    # node identity for same-node checks (e.g. compiled-DAG channel
+    # placement): workers on one node share this shm dir
+    rt.shm_dir = shm_dir
     worker_mod._set_worker_runtime(rt)
 
     if config.log_to_driver:

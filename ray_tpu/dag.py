@@ -25,15 +25,20 @@ class DAGNode:
         return _execute(self, input_args, input_kwargs, {})
 
     def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024):
-        """Compile for repeated execution. Linear actor pipelines lower to
-        mutable shared-memory channels — each stage runs a resident loop
-        reading its input channel and writing the next, with no per-hop RPC
-        or store allocation (the aDAG fast path,
-        ``compiled_dag_node.py:391`` + ``shared_memory_channel.py:88``).
-        Non-linear graphs keep the pre-planned actor-call path."""
+        """Compile for repeated execution (parity:
+        ``compiled_dag_node.py:391``). Actor-method graphs — linear chains,
+        branches, diamonds, multi-output — lower to resident stage loops
+        connected by channels: mutable shared-memory channels between
+        same-node stages (``shared_memory_channel.py:88`` analogue), and
+        authenticated one-slot socket channels for cross-node edges (the
+        reference's cross-node mutable-object forwarding). Graphs that are
+        not pure actor-method DAGs keep the pre-planned actor-call path."""
         chain = _linear_actor_chain(self)
         if chain is not None:
             return ChannelCompiledDAG(chain, buffer_size_bytes)
+        plan = _general_actor_graph(self)
+        if plan is not None:
+            return GeneralCompiledDAG(plan, buffer_size_bytes)
         return CompiledDAG(self)
 
 
@@ -107,6 +112,14 @@ class ClassMethodNode(DAGNode):
         self.kwargs = kwargs
 
 
+class MultiOutputNode(DAGNode):
+    """Marks several DAG leaves as the outputs of one execution (parity:
+    ``ray.dag.MultiOutputNode``); ``execute()``/compiled results are lists."""
+
+    def __init__(self, outputs):
+        self.outputs = list(outputs)
+
+
 def _execute(node, input_args, input_kwargs, memo: Dict[int, Any]):
     """Post-order walk; returns an ObjectRef (or plain value for inputs)."""
     if id(node) in memo:
@@ -141,6 +154,8 @@ def _execute(node, input_args, input_kwargs, memo: Dict[int, Any]):
         args = [rec(a) for a in node.args]
         kwargs = {k: rec(v) for k, v in node.kwargs.items()}
         result = getattr(node.handle, node.method).remote(*args, **kwargs)
+    elif isinstance(node, MultiOutputNode):
+        result = [rec(o) for o in node.outputs]
     else:
         raise TypeError(f"unknown DAG node {type(node)}")
     memo[id(node)] = result
@@ -247,6 +262,35 @@ class _DagError:
         self.message = message
 
 
+class _SeqBufferedResults:
+    """FIFO result protocol shared by the channel-compiled DAGs: results
+    arrive on the output channel(s) in execution order; out-of-order
+    consumption buffers other executions' values per sequence number.
+    Subclasses implement ``_read_one(timeout)``."""
+
+    def _init_seq_state(self):
+        self._closed = False
+        self._next_seq = 0
+        self._next_read = 0
+        self._buffered: Dict[int, Any] = {}
+
+    def _result_for(self, seq: int, timeout: float):
+        if seq in self._buffered:
+            return self._buffered.pop(seq)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while self._next_read <= seq:
+            remaining = max(0.0, deadline - _time.monotonic())
+            value = self._read_one(remaining)
+            got = self._next_read
+            self._next_read += 1
+            if got == seq:
+                return value
+            self._buffered[got] = value
+        return self._buffered.pop(seq)
+
+
 class CompiledDAGRef:
     """Result handle of one compiled execution (parity: ``CompiledDAGRef``).
 
@@ -262,12 +306,17 @@ class CompiledDAGRef:
         value = self._dag._result_for(
             self._seq, self._timeout if timeout is None else timeout
         )
+        err = None
         if isinstance(value, _DagError):
-            raise RuntimeError(f"compiled DAG stage failed: {value.message}")
+            err = value
+        elif isinstance(value, list):
+            err = next((v for v in value if isinstance(v, _DagError)), None)
+        if err is not None:
+            raise RuntimeError(f"compiled DAG stage failed: {err.message}")
         return value
 
 
-class ChannelCompiledDAG:
+class ChannelCompiledDAG(_SeqBufferedResults):
     """Linear actor pipeline lowered onto mutable shm channels."""
 
     def __init__(self, stages, capacity: int):
@@ -304,10 +353,7 @@ class ChannelCompiledDAG:
                     self._paths[i], self._paths[i + 1], method, capacity
                 )
             )
-        self._closed = False
-        self._next_seq = 0
-        self._next_read = 0
-        self._buffered: Dict[int, Any] = {}
+        self._init_seq_state()
 
     def execute(self, value, timeout: float = 60.0) -> CompiledDAGRef:
         if self._closed:
@@ -317,23 +363,8 @@ class ChannelCompiledDAG:
         self._next_seq += 1
         return ref
 
-    def _result_for(self, seq: int, timeout: float):
-        """Read results in FIFO channel order, buffering others, until this
-        execution's value arrives."""
-        if seq in self._buffered:
-            return self._buffered.pop(seq)
-        import time as _time
-
-        deadline = _time.monotonic() + timeout
-        while self._next_read <= seq:
-            remaining = max(0.0, deadline - _time.monotonic())
-            value = self._channels[-1].read(timeout=remaining)
-            got = self._next_read
-            self._next_read += 1
-            if got == seq:
-                return value
-            self._buffered[got] = value
-        return self._buffered.pop(seq)
+    def _read_one(self, timeout: float):
+        return self._channels[-1].read(timeout=timeout)
 
     def teardown(self):
         if self._closed:
@@ -356,10 +387,437 @@ class ChannelCompiledDAG:
             except OSError:
                 pass
 
+    def __del__(self):
+        # a dropped DAG must not leak resident stage actors (their loops
+        # never finish on their own, so out-of-scope reaping can't fire)
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _general_actor_graph(output: DAGNode):
+    """Validate + plan an arbitrary actor-method DAG for channel lowering.
+
+    Supported nodes: BoundClassMethodNode (constant kwargs; args may mix
+    constants with DAG edges), InputNode / InputAttributeNode sources, and a
+    MultiOutputNode root. Returns a plan dict or None (caller falls back to
+    the pre-planned actor-call path). Parity: the reference compiles exactly
+    these graphs in ``compiled_dag_node.py:391``.
+    """
+    roots = output.outputs if isinstance(output, MultiOutputNode) else [output]
+    if not roots or not all(isinstance(r, BoundClassMethodNode) for r in roots):
+        return None
+
+    method_nodes: List[BoundClassMethodNode] = []  # topo (producers first)
+    seen: Dict[int, bool] = {}
+
+    def visit(node) -> bool:
+        if isinstance(node, (InputNode, InputAttributeNode)):
+            if isinstance(node, InputAttributeNode):
+                node = node.parent
+            # channel executions carry ONE input value; a multi-positional
+            # InputNode(index>0) would silently get the wrong argument here,
+            # so those graphs keep the interpreted path
+            if not isinstance(node, InputNode) or node.index != 0:
+                return False
+            return True
+        if not isinstance(node, BoundClassMethodNode):
+            return False
+        if id(node) in seen:
+            return seen[id(node)]
+        seen[id(node)] = True  # provisional (cycles are impossible in DAGs)
+        if any(isinstance(v, DAGNode) for v in node.kwargs.values()):
+            seen[id(node)] = False
+            return False
+        if not all(
+            visit(a) for a in node.args if isinstance(a, DAGNode)
+        ):
+            seen[id(node)] = False
+            return False
+        # every stage needs at least one channel input: an all-constant
+        # method would loop eagerly, decoupled from execute() pacing
+        if not any(isinstance(a, DAGNode) for a in node.args):
+            seen[id(node)] = False
+            return False
+        # class construction args must be constants (one instance per
+        # class_node, built once at compile time)
+        cn = node.class_node
+        if any(isinstance(a, DAGNode) for a in cn.args) or any(
+            isinstance(v, DAGNode) for v in cn.kwargs.values()
+        ):
+            seen[id(node)] = False
+            return False
+        method_nodes.append(node)
+        return True
+
+    if not all(visit(r) for r in roots):
+        return None
+    if not method_nodes:
+        return None
+    return {"roots": roots, "method_nodes": method_nodes}
+
+
+class _EdgeHole:
+    """Compile-time marker for a channel-fed argument position. A dedicated
+    class (not an in-band tuple) so user constants can never collide."""
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+@ray_tpu.remote
+class _GeneralStage:
+    """Resident stage hosting ONE user-class instance and one channel loop
+    per bound method node (threads via max_concurrency)."""
+
+    def __init__(self, cls_blob: bytes, args, kwargs):
+        import cloudpickle
+        import threading
+
+        cls = cloudpickle.loads(cls_blob)
+        self._inst = cls(*args, **kwargs)
+        self._writers: Dict[str, Any] = {}
+        # several method loops share one instance; user method bodies run
+        # one at a time, like any other actor (interpreted semantics)
+        self._inst_lock = threading.Lock()
+
+    def node_shm(self):
+        from ray_tpu.experimental.channel import node_shm_dir
+
+        return node_shm_dir()
+
+    def prepare(self, out_edges, capacity: int):
+        """Create writer endpoints for this stage's output edges.
+        ``out_edges`` = [(edge_id, kind)]; returns {edge_id: reader_spec}."""
+        from ray_tpu._private.worker import get_runtime
+        from ray_tpu.experimental.channel import create_writer, node_shm_dir
+
+        cfg = get_runtime().config
+        key = (cfg.cluster_auth_key or "local").encode()
+        specs = {}
+        for edge_id, kind in out_edges:
+            w, spec = create_writer(
+                kind, edge_id, key, capacity,
+                shm_dir=node_shm_dir(), host=cfg.cluster_host,
+            )
+            self._writers[edge_id] = w
+            specs[edge_id] = spec
+        return specs
+
+    def run_method_loop(
+        self,
+        method: str,
+        arg_template: List,  # constants, with _EdgeHole(i) holes
+        kwargs: Dict,
+        in_specs: List,      # reader specs, one per hole, in hole order
+        out_edge_ids: List[str],
+        capacity: int,
+    ):
+        from ray_tpu._private.worker import get_runtime
+        from ray_tpu.experimental.channel import (
+            ChannelClosedError,
+            open_reader,
+        )
+
+        cfg = get_runtime().config
+        key = (cfg.cluster_auth_key or "local").encode()
+        readers = [open_reader(s, key, capacity) for s in in_specs]
+        writers = [self._writers[eid] for eid in out_edge_ids]
+        fn = getattr(self._inst, method)
+        while True:
+            try:
+                vals = [r.read(timeout=None) for r in readers]
+            except ChannelClosedError:
+                for w in writers:
+                    w.close()
+                return
+            err = next((v for v in vals if isinstance(v, _DagError)), None)
+            if err is not None:
+                payload = err  # upstream failure: forward it downstream
+            else:
+                args = [
+                    vals[a.index] if isinstance(a, _EdgeHole) else a
+                    for a in arg_template
+                ]
+                try:
+                    with self._inst_lock:
+                        payload = fn(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001
+                    import traceback
+
+                    payload = _DagError(f"{e!r}\n{traceback.format_exc()}")
+            try:
+                for w in writers:
+                    w.write(payload, timeout=None)
+            except ChannelClosedError:
+                return
+
+
+class GeneralCompiledDAG(_SeqBufferedResults):
+    """Arbitrary actor-method DAG lowered onto channels: shm between
+    same-node stages, authenticated sockets across nodes. One resident
+    actor per ClassNode; one loop thread per bound method."""
+
+    def __init__(self, plan: Dict, capacity: int):
+        import uuid
+
+        import cloudpickle
+
+        from ray_tpu._private.worker import get_runtime
+        from ray_tpu.experimental.channel import (
+            create_writer,
+            node_shm_dir,
+            open_reader,
+        )
+
+        cfg = get_runtime().config
+        self._auth = (cfg.cluster_auth_key or "local").encode()
+        self._capacity = capacity
+        roots = plan["roots"]
+        method_nodes = plan["method_nodes"]
+        tag = uuid.uuid4().hex[:8]
+
+        # one resident actor per ClassNode (methods on one class_node share
+        # the instance; each method loop needs its own thread)
+        loops_per_class: Dict[int, int] = {}
+        for m in method_nodes:
+            loops_per_class[id(m.class_node)] = (
+                loops_per_class.get(id(m.class_node), 0) + 1
+            )
+        self._actors: Dict[int, Any] = {}
+        for m in method_nodes:
+            cid = id(m.class_node)
+            if cid not in self._actors:
+                cn = m.class_node
+                user_opts = {
+                    k: cn.actor_cls._options[k]
+                    for k in cn.actor_cls._explicit
+                    if k in ("num_cpus", "num_tpus", "resources",
+                             "scheduling_strategy")
+                }
+                self._actors[cid] = _GeneralStage.options(
+                    max_concurrency=loops_per_class[cid] + 1, **user_opts
+                ).remote(
+                    cloudpickle.dumps(cn.actor_cls._cls), cn.args, cn.kwargs
+                )
+
+        # locate every endpoint (same shm dir == same node == shm channel)
+        shm_of = {
+            cid: shm
+            for cid, shm in zip(
+                self._actors,
+                ray_tpu.get(
+                    [a.node_shm.remote() for a in self._actors.values()],
+                    timeout=120,
+                ),
+            )
+        }
+        driver_shm = node_shm_dir()
+
+        def loc(end) -> Optional[str]:
+            return driver_shm if end == "driver" else shm_of[end]
+
+        # edges: producer -> (consumer, arg position). Input edges carry an
+        # optional attribute key resolved driver-side at write time.
+        edges: List[Dict] = []
+        in_holes: Dict[int, List] = {id(m): [] for m in method_nodes}
+        for m in method_nodes:
+            for a in m.args:
+                if isinstance(a, InputNode):
+                    src, edge_key = "driver", None
+                elif isinstance(a, InputAttributeNode):
+                    src, edge_key = "driver", a.key
+                elif isinstance(a, BoundClassMethodNode):
+                    src, edge_key = id(a.class_node), None
+                else:
+                    continue
+                eid = f"{tag}_{len(edges)}"
+                edge = {
+                    "id": eid,
+                    "src": src,
+                    "src_node": a if src != "driver" else None,
+                    "dst": id(m.class_node),
+                    "key": edge_key,
+                }
+                edges.append(edge)
+                in_holes[id(m)].append(edge)
+        root_edges: List[Dict] = []
+        for r in roots:
+            eid = f"{tag}_{len(edges) + len(root_edges)}r"
+            root_edges.append(
+                {"id": eid, "src": id(r.class_node), "src_node": r,
+                 "dst": "driver", "key": None}
+            )
+
+        def kind_of(edge) -> str:
+            a, b = loc(edge["src"]), loc(edge["dst"])
+            return "shm" if a is not None and a == b else "sock"
+
+        # writer creation: group stage-produced edges by producing method
+        # node (its loop owns the writer ends)
+        produced: Dict[int, List[Dict]] = {}
+        for e in edges + root_edges:
+            if e["src"] == "driver":
+                continue
+            produced.setdefault(id(e["src_node"]), []).append(e)
+        specs: Dict[str, Any] = {}
+        for m in method_nodes:
+            mine = produced.get(id(m), [])
+            if mine:
+                got = ray_tpu.get(
+                    self._actors[id(m.class_node)].prepare.remote(
+                        [(e["id"], kind_of(e)) for e in mine], capacity
+                    ),
+                    timeout=120,
+                )
+                specs.update(got)
+        # driver-produced input edges
+        self._input_writers: List = []
+        for e in edges:
+            if e["src"] != "driver":
+                continue
+            w, spec = create_writer(
+                kind_of(e), e["id"], self._auth, capacity,
+                shm_dir=driver_shm, host=cfg.cluster_host,
+            )
+            self._input_writers.append((w, e["key"]))
+            specs[e["id"]] = spec
+
+        # start one loop per method node
+        self._loops = []
+        for m in method_nodes:
+            holes = in_holes[id(m)]
+            template: List = []
+            hole_i = 0
+            for a in m.args:
+                if isinstance(
+                    a, (InputNode, InputAttributeNode, BoundClassMethodNode)
+                ):
+                    template.append(_EdgeHole(hole_i))
+                    hole_i += 1
+                else:
+                    template.append(a)
+            self._loops.append(
+                self._actors[id(m.class_node)].run_method_loop.remote(
+                    m.method,
+                    template,
+                    dict(m.kwargs),
+                    [specs[e["id"]] for e in holes],
+                    [e["id"] for e in produced.get(id(m), [])],
+                    capacity,
+                )
+            )
+        # driver-side readers for the root edges — opened LAZILY on the
+        # first result read: a socket reader's auth handshake only completes
+        # when the writing stage accepts (at its first write, i.e. after an
+        # execute()), so opening here would deadlock compile for any
+        # cross-node output stage
+        self._out_specs = [specs[e["id"]] for e in root_edges]
+        self._out_readers: Optional[List] = None
+        self._multi = len(self._out_specs) > 1
+        # every shm edge path, for unlink at teardown (stage-created shm
+        # files live in this node's shm dir only when the stage is local,
+        # so unlink is best-effort per path)
+        self._shm_paths = [
+            spec[1] for spec in specs.values() if spec[0] == "shm"
+        ]
+        self._broken = False
+        self._init_seq_state()
+
+    def execute(self, value, timeout: float = 60.0) -> CompiledDAGRef:
+        if self._closed:
+            raise RuntimeError("compiled DAG is torn down")
+        if self._broken:
+            raise RuntimeError(
+                "compiled DAG is in an inconsistent state after a partial "
+                "write/read timeout; teardown() and recompile"
+            )
+        for i, (w, key) in enumerate(self._input_writers):
+            try:
+                w.write(value if key is None else value[key], timeout=timeout)
+            except Exception:
+                if i > 0:
+                    # some inputs carry this execution and some don't: the
+                    # stages are now out of step — refuse further use
+                    self._broken = True
+                raise
+        ref = CompiledDAGRef(self, self._next_seq, timeout)
+        self._next_seq += 1
+        return ref
+
+    def _read_one(self, timeout: float):
+        import time as _time
+
+        if self._out_readers is None:
+            from ray_tpu.experimental.channel import open_reader
+
+            self._out_readers = [
+                open_reader(s, self._auth, self._capacity)
+                for s in self._out_specs
+            ]
+        deadline = _time.monotonic() + timeout
+        vals = []
+        for i, r in enumerate(self._out_readers):
+            try:
+                vals.append(
+                    r.read(timeout=max(0.0, deadline - _time.monotonic()))
+                )
+            except Exception:
+                if i > 0:
+                    # earlier outputs of this execution were consumed; the
+                    # channels are desynchronized — refuse further use
+                    self._broken = True
+                raise
+        return vals if self._multi else vals[0]
+
+    def _result_for(self, seq: int, timeout: float):
+        if self._broken:
+            raise RuntimeError(
+                "compiled DAG is in an inconsistent state after a partial "
+                "write/read timeout; teardown() and recompile"
+            )
+        return super()._result_for(seq, timeout)
+
+    def teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for w, _ in self._input_writers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        for a in self._actors.values():
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        for r in self._out_readers or []:
+            try:
+                r.close()
+            except Exception:
+                pass
+        import os as _os
+
+        for p in self._shm_paths:
+            try:
+                _os.unlink(p)
+            except OSError:
+                pass
+
+    def __del__(self):
+        # a dropped DAG must not leak resident stage actors (their loops
+        # never finish on their own, so out-of-scope reaping can't fire)
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
 
 def _children(node) -> List[DAGNode]:
     out = []
-    for attr in ("args", "kwargs", "class_node", "parent"):
+    for attr in ("args", "kwargs", "class_node", "parent", "outputs"):
         v = getattr(node, attr, None)
         if isinstance(v, DAGNode):
             out.append(v)

@@ -330,9 +330,7 @@ class Dataset:
         mat = self.materialize()
         total = sum(block_num_rows(_fetch(r)) for r in mat._block_refs)
         per = max(1, (total + num_blocks - 1) // num_blocks)
-        # materialized output: repartition is a count-changing barrier op
-        # (num_blocks() must reflect the new partitioning immediately)
-        return mat.repartition_by_rows(per).materialize()
+        return mat.repartition_by_rows(per)
 
     def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
         """Re-slice the block stream into fixed-size blocks. Executes the
@@ -645,6 +643,14 @@ class Dataset:
         return {}
 
     def num_blocks(self) -> int:
+        """Block count of the plan's OUTPUT. For lazy plans with
+        count-changing stages (rebatch) this requires executing the plan —
+        metadata calls on lazy pipelines are rare; prefer asking a
+        materialized dataset."""
+        from ray_tpu.data.streaming_executor import RebatchStage
+
+        if any(isinstance(s, RebatchStage) for s in self._stages):
+            return len(self.materialize()._block_refs)
         return len(self._block_refs)
 
     def stats(self) -> str:

@@ -12,8 +12,13 @@ seqlock header — writer bumps ``version`` to odd, copies the payload, bumps
 to even; readers wait for a fresh even version and then validate it was
 stable across their copy. Readers track the last version consumed so each
 ``read`` returns a *new* write (reference semantics: one read per write per
-reader). Channels are intra-node (the reference forwards cross-node via
-gRPC; here cross-node DAG edges fall back to the object store path).
+reader).
+
+Cross-node edges use :class:`SocketChannelWriter` / :class:`SocketChannelReader`
+— an authenticated point-to-point socket with the same one-slot
+acquire-release semantics (writer blocks until the reader acks the previous
+payload), playing the role of the reference's cross-node mutable-object
+forwarding (``experimental_mutable_object_provider.h`` gRPC path).
 """
 
 from __future__ import annotations
@@ -127,3 +132,178 @@ class Channel:
 
     def __reduce__(self):
         return (Channel, (self.path, self.capacity, False))
+
+
+# -- cross-node channels -----------------------------------------------------
+
+_FRAME_DATA = b"D"
+_FRAME_CLOSE = b"C"
+_FRAME_ACK = b"A"
+
+
+class SocketChannelWriter:
+    """Writer endpoint of a cross-node single-reader channel.
+
+    One listener per edge (the reader dials this address), HMAC-challenge
+    authenticated like every other socket in the framework. One-slot
+    semantics: ``write`` blocks until the reader has acked the previous
+    payload, so a slow consumer backpressures the producer exactly like the
+    shm seqlock channel."""
+
+    def __init__(self, auth_key: bytes, host: str = "127.0.0.1"):
+        from multiprocessing.connection import Listener
+
+        # bind all interfaces; advertise an address the reader's host can
+        # dial (binding the head's cluster_host would fail on daemon hosts)
+        self._listener = Listener(("0.0.0.0", 0), authkey=auth_key)
+        port = tuple(self._listener.address)[1]
+        self.address = (_advertised_host(host), port)
+        self._conn = None
+        self._awaiting_ack = False
+        self._serde = serialization.get_context()
+        self._closed = False
+
+    def _ensure_conn(self, timeout: Optional[float]):
+        if self._conn is not None:
+            return
+        # honor the write timeout during the initial accept too — a reader
+        # that never dials (stage failed to start) must not hang the writer
+        sock = getattr(getattr(self._listener, "_listener", None), "_socket", None)
+        if sock is not None and timeout is not None:
+            sock.settimeout(timeout)
+        try:
+            self._conn = self._listener.accept()
+        except (TimeoutError, OSError) as e:
+            if isinstance(e, OSError) and not isinstance(e, TimeoutError):
+                raise
+            raise TimeoutError(
+                f"socket channel accept timed out ({self.address})"
+            ) from e
+        finally:
+            if sock is not None:
+                sock.settimeout(None)
+        self._listener.close()
+
+    def write(self, value: Any, timeout: Optional[float] = 60.0) -> None:
+        if self._closed:
+            raise ChannelClosedError(str(self.address))
+        try:
+            self._ensure_conn(timeout)
+            if self._awaiting_ack:
+                if not self._conn.poll(timeout):
+                    raise TimeoutError(
+                        f"socket channel write timed out ({self.address})"
+                    )
+                ack = self._conn.recv_bytes()
+                if ack != _FRAME_ACK:
+                    raise ChannelClosedError(str(self.address))
+                self._awaiting_ack = False
+            blob = self._serde.serialize_to_bytes(value)
+            self._conn.send_bytes(_FRAME_DATA + blob)
+            self._awaiting_ack = True
+        except (EOFError, OSError, BrokenPipeError) as e:
+            self._closed = True
+            raise ChannelClosedError(str(self.address)) from e
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._ensure_conn(timeout=1.0)
+            self._conn.send_bytes(_FRAME_CLOSE)
+        except Exception:
+            pass
+        for c in (self._conn, self._listener):
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:
+                pass
+
+
+class SocketChannelReader:
+    """Reader endpoint: dials the writer's address; read() returns one
+    payload per write and acks it (releasing the writer's slot)."""
+
+    def __init__(self, address, auth_key: bytes):
+        from multiprocessing.connection import Client
+
+        self._conn = Client(tuple(address), authkey=auth_key)
+        self._serde = serialization.get_context()
+        self._closed = False
+
+    def read(self, timeout: Optional[float] = 10.0) -> Any:
+        if self._closed:
+            raise ChannelClosedError("socket channel closed")
+        try:
+            if not self._conn.poll(timeout):
+                raise TimeoutError("socket channel read timed out")
+            frame = self._conn.recv_bytes()
+            if frame[:1] == _FRAME_CLOSE:
+                self._closed = True
+                raise ChannelClosedError("socket channel closed by writer")
+            value = self._serde.deserialize_from(memoryview(frame)[1:])
+            self._conn.send_bytes(_FRAME_ACK)
+            return value
+        except (EOFError, OSError, BrokenPipeError) as e:
+            self._closed = True
+            raise ChannelClosedError("socket channel peer died") from e
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def _advertised_host(cluster_host: str) -> str:
+    """The address peers should dial to reach a listener on THIS host.
+    Loopback clusters stay on loopback; otherwise use this host's outbound
+    IP (the writer may live on any node, not the head)."""
+    if cluster_host in ("", "127.0.0.1", "localhost", "0.0.0.0"):
+        return "127.0.0.1"
+    import socket as _socket
+
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        s.connect((cluster_host, 9))  # no packets sent; just picks a route
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def node_shm_dir() -> Optional[str]:
+    """This process's node-local shm dir — processes that share it can use
+    shm channels; otherwise edges go over socket channels."""
+    from ray_tpu._private.worker import get_runtime
+
+    rt = get_runtime()
+    if hasattr(rt, "node"):  # driver
+        return rt.node.shm_dir
+    return getattr(rt, "shm_dir", None)
+
+
+def create_writer(kind: str, edge_id: str, auth_key: bytes, capacity: int,
+                  shm_dir: Optional[str] = None, host: str = "127.0.0.1"):
+    """Create the writer endpoint of an edge; returns (endpoint, spec). The
+    spec travels to the reader, which opens it with open_reader."""
+    if kind == "shm":
+        path = os.path.join(shm_dir or "/tmp", "channels", edge_id)
+        return Channel(path, capacity, create=True), ("shm", path)
+    if kind == "sock":
+        w = SocketChannelWriter(auth_key, host)
+        return w, ("sock", w.address)
+    raise ValueError(kind)
+
+
+def open_reader(spec, auth_key: bytes, capacity: int):
+    kind, arg = spec
+    if kind == "shm":
+        return Channel(arg, capacity, create=False)
+    if kind == "sock":
+        return SocketChannelReader(arg, auth_key)
+    raise ValueError(kind)
