@@ -43,8 +43,12 @@ def emit(metric, value, unit, reference=None):
 
 def bench_nodes(cluster, n_nodes: int) -> None:
     t0 = time.perf_counter()
-    for _ in range(n_nodes):
-        cluster.add_node(num_cpus=1, wait=False)
+    for i in range(n_nodes):
+        # the first 8 nodes carry a broadcast-reader marker so the transfer
+        # bench can force exactly one remote reader per node (head-local
+        # reads are zero-copy shm maps and would measure nothing)
+        res = {"bcast": 1.0} if i < 8 else None
+        cluster.add_node(num_cpus=1, resources=res, wait=False)
     cluster.wait_for_nodes(timeout=600)
     dt = time.perf_counter() - t0
     alive = sum(1 for n in ray_tpu.nodes() if n["alive"])
@@ -101,7 +105,9 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
     """
     blob = ray_tpu.put(np.ones(mib * 1024 * 1024 // 8, dtype=np.float64))
 
-    @ray_tpu.remote(num_cpus=1)
+    # one reader pinned per daemon node (the bcast marker): every read is a
+    # genuine cross-process transfer of the full object
+    @ray_tpu.remote(num_cpus=0, resources={"bcast": 1.0})
     def reader(x):
         return float(x[0]) + x.nbytes
 
@@ -127,6 +133,11 @@ def main() -> None:
     ap.add_argument("--actors", type=int, default=1000)
     ap.add_argument("--broadcast-mib", type=int, default=256)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--only",
+        choices=["nodes", "broadcast", "tasks", "actors"],
+        help="run one phase (nodes are always set up first)",
+    )
     args = ap.parse_args()
     if args.quick:
         args.nodes, args.tasks, args.actors = 8, 5_000, 100
@@ -134,10 +145,19 @@ def main() -> None:
 
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     try:
-        bench_nodes(cluster, args.nodes)
-        bench_queue_depth(args.tasks)
-        bench_actor_fleet(args.actors)
-        bench_broadcast(min(args.nodes, 8), args.broadcast_mib)
+        # only the broadcast-only mode shrinks the fleet (it reads from at
+        # most 8 nodes anyway); task/actor phases keep the requested size so
+        # their numbers are comparable with full runs
+        n_nodes = min(args.nodes, 8) if args.only == "broadcast" else args.nodes
+        bench_nodes(cluster, n_nodes)
+        # broadcast before the churn-heavy phases: reaping thousands of
+        # worker processes would otherwise contaminate its timing
+        if args.only in (None, "broadcast"):
+            bench_broadcast(min(n_nodes, 8), args.broadcast_mib)
+        if args.only in (None, "tasks"):
+            bench_queue_depth(args.tasks)
+        if args.only in (None, "actors"):
+            bench_actor_fleet(args.actors)
     finally:
         cluster.shutdown()
 

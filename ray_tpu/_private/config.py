@@ -61,7 +61,17 @@ class Config:
     health_check_timeout_s: float = 30.0
     # --- multi-host cluster ---
     cluster_host: str = "127.0.0.1"  # head listener bind address
+    cluster_port: int = 0  # head listener port (0 = ephemeral); a restarted
+    # head rebinds the previous port so daemons can re-attach
     cluster_auth_key: str = ""  # shared secret; generated per session if empty
+    # head restart continuity: on init, look for the newest crashed session's
+    # GCS snapshot and restore it (tables, names, detached actors, head
+    # address) automatically. Parity: the reference GCS rebuilds from Redis
+    # on restart (redis_store_client.h:33, gcs_init_data.h).
+    auto_restore: bool = False
+    # how long a node daemon keeps retrying to re-attach after losing the
+    # head connection before giving up and exiting
+    daemon_reconnect_timeout_s: float = 60.0
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
     # --- events / metrics ---

@@ -36,7 +36,28 @@ class HeadServer:
         if not config.cluster_auth_key:
             config.cluster_auth_key = secrets.token_hex(16)
         self.auth_key = config.cluster_auth_key.encode()
-        self._listener = Listener((config.cluster_host, 0), authkey=self.auth_key)
+        # cluster_port != 0 on head restart: rebind the crashed head's port
+        # so surviving daemons (which keep dialing it) can re-attach
+        # backlog: a joining fleet (50+ daemons at once) must not overflow
+        # the accept queue — the mp.connection default of 1 wedges joiners
+        try:
+            self._listener = Listener(
+                (config.cluster_host, config.cluster_port or 0),
+                backlog=128,
+                authkey=self.auth_key,
+            )
+        except OSError:
+            if not config.cluster_port:
+                raise
+            logger.warning(
+                "could not rebind head port %d (in use?); falling back to an "
+                "ephemeral port — surviving daemons dialing the old address "
+                "will NOT find this head",
+                config.cluster_port,
+            )
+            self._listener = Listener(
+                (config.cluster_host, 0), backlog=128, authkey=self.auth_key
+            )
         self.address = self._listener.address
         # object server over the head's local store (daemons pull driver puts
         # and head-computed results from here)

@@ -216,6 +216,13 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        # clean-shutdown marker: auto-restore (head restart continuity) only
+        # resurrects sessions whose head CRASHED; a deliberate shutdown must
+        # not be replayed by the next head on this machine
+        try:
+            open(os.path.join(self.session_dir, "clean_shutdown"), "w").close()
+        except OSError:
+            pass
         if self.head_server is not None:
             self.head_server.close()
         self.scheduler.shutdown()

@@ -33,7 +33,9 @@ class ObjectServer:
 
     def __init__(self, store, host: str, auth_key: bytes):
         self._store = store
-        self._listener = Listener((host, 0), authkey=auth_key)
+        # backlog sized for a whole fleet pulling a broadcast object at once
+        # (mp.connection's default of 1 drops concurrent dials)
+        self._listener = Listener((host, 0), backlog=128, authkey=auth_key)
         self._stop = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="object-server", daemon=True

@@ -55,3 +55,20 @@ def _emit(span: dict) -> None:
             rt._send(("cmd", ("profile_event", span)))
     except Exception:  # dead pipe during shutdown
         pass
+
+
+def format_thread_stacks() -> str:
+    """All live threads' stacks in this process (the in-process stand-in for
+    the reference's py-spy reporter-agent dumps,
+    python/ray/dashboard/modules/reporter/reporter_agent.py:314 — py-spy is
+    not shipped in this offline image)."""
+    import sys
+    import threading
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out)

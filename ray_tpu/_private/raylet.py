@@ -20,6 +20,7 @@ import os
 import pickle
 import threading
 import time
+import multiprocessing as mp
 from multiprocessing import connection as mpc
 from multiprocessing.connection import Client
 from typing import Dict
@@ -44,13 +45,16 @@ class NodeDaemon:
     ):
         self.node_id = NodeID.from_random()
         self.auth_key = auth_key
-        self.conn = Client(tuple(head_addr), authkey=auth_key)
+        self._head_addr = tuple(head_addr)
+        self.conn = Client(self._head_addr, authkey=auth_key)
         self._send_lock = threading.Lock()
 
         total: Dict[str, float] = {"CPU": float(num_cpus)}
         if num_tpus:
             total["TPU"] = float(num_tpus)
         total.update({k: float(v) for k, v in (resources or {}).items()})
+        self._total_resources = dict(total)
+        self._labels = dict(labels or {})
 
         # local store dirs (one per daemon: a real separate node plane even
         # when colocated on one machine for tests)
@@ -69,28 +73,10 @@ class NodeDaemon:
         self.store = None
         self.object_server = ObjectServer(lambda: self.store, host, auth_key)
 
-        self._send(
-            (
-                "register_node",
-                {
-                    "node_id": self.node_id.binary(),
-                    "resources": total,
-                    "labels": labels or {},
-                    "object_addr": self.object_server.address,
-                    "pid": os.getpid(),
-                },
-            )
-        )
-        reply = self.conn.recv()
-        assert reply[0] == "registered", reply
-        self.session_name = reply[1]["session_name"]
-        self.config = pickle.loads(reply[1]["config_blob"])
-        self._config_blob = reply[1]["config_blob"]
+        self._register()
         self.store = create_store_client(
             self.shm_dir, self.fallback_dir, self.config.object_store_memory
         )
-
-        import multiprocessing as mp
 
         method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
         self._ctx = mp.get_context(method)
@@ -98,6 +84,74 @@ class NodeDaemon:
         self.workers: Dict[WorkerID, tuple] = {}
         self._pipe_to_wid: Dict[object, WorkerID] = {}
         self._stop = False
+
+    def _register(self, conn=None, timeout: float = 30.0):
+        """Announce this node to the (possibly restarted) head.
+
+        When ``conn`` is given (reconnect), registration happens on it
+        BEFORE it becomes ``self.conn`` — the handshake's first message must
+        be register_node, and the heartbeat thread keeps writing to the old
+        (dead) conn in the meantime."""
+        conn = conn if conn is not None else self.conn
+        conn.send(
+            (
+                "register_node",
+                {
+                    "node_id": self.node_id.binary(),
+                    "resources": dict(self._total_resources),
+                    "labels": dict(self._labels),
+                    "object_addr": self.object_server.address,
+                    "pid": os.getpid(),
+                },
+            )
+        )
+        if not conn.poll(timeout):
+            raise OSError("head did not answer registration in time")
+        reply = conn.recv()
+        assert reply[0] == "registered", reply
+        self.session_name = reply[1]["session_name"]
+        self.config = pickle.loads(reply[1]["config_blob"])
+        self._config_blob = reply[1]["config_blob"]
+
+    def _reconnect(self) -> bool:
+        """Head connection lost: keep dialing the head address and re-attach
+        when a (restarted) head answers. Local workers are killed first —
+        their owners died with the old head, and restored detached actors
+        are recreated fresh by the new one. Returns False on timeout."""
+        logger.info("head connection lost; attempting re-attach")
+        for wid in list(self.workers):
+            entry = self.workers.pop(wid, None)
+            if entry is not None and entry[0] is not None:
+                try:
+                    entry[0].terminate()
+                except Exception:
+                    pass
+        self._pipe_to_wid.clear()
+        deadline = time.monotonic() + float(
+            getattr(self.config, "daemon_reconnect_timeout_s", 60.0)
+        )
+        delay = 0.5
+        while time.monotonic() < deadline:
+            try:
+                conn = Client(self._head_addr, authkey=self.auth_key)
+                # register on the fresh conn FIRST: installing it before the
+                # handshake would let the heartbeat thread race a beat in as
+                # the first message, which the head rejects
+                self._register(conn)
+                with self._send_lock:
+                    try:
+                        self.conn.close()
+                    except OSError:
+                        pass
+                    self.conn = conn
+                logger.info("re-attached to head at %s", self._head_addr)
+                return True
+            except (OSError, EOFError, ConnectionError, AssertionError,
+                    mp.AuthenticationError):
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+        logger.info("re-attach timed out; exiting")
+        return False
 
     def _send(self, msg):
         with self._send_lock:
@@ -121,7 +175,9 @@ class NodeDaemon:
                 try:
                     self._send(("heartbeat", time.monotonic()))
                 except (OSError, EOFError):
-                    return
+                    # connection down — the main loop handles re-attach;
+                    # keep this thread alive to beat on the new conn
+                    pass
             time.sleep(HEARTBEAT_PERIOD_S)
 
     def run(self):
@@ -151,8 +207,10 @@ class NodeDaemon:
                 if not self._handle_head_msg(msg):
                     return False
         except (EOFError, OSError):
-            logger.info("head connection lost; exiting")
-            return False
+            # head died (crash or restart): try to re-attach instead of
+            # exiting — continuity across head restarts (parity: raylets
+            # reconnecting to a restarted GCS)
+            return self._reconnect()
         return True
 
     def _handle_head_msg(self, msg) -> bool:
@@ -187,6 +245,13 @@ class NodeDaemon:
                 if self.store.contains(oid):
                     self.store.delete(oid)
             except Exception:
+                pass
+        elif kind == "dump_stacks":
+            from ray_tpu._private.profiling import format_thread_stacks
+
+            try:
+                self._send(("stacks", msg[1], format_thread_stacks()))
+            except (OSError, EOFError):
                 pass
         elif kind == "exit":
             return False

@@ -24,6 +24,7 @@ import logging
 import os
 import pickle
 import queue
+import heapq
 import random
 import threading
 import time
@@ -411,6 +412,11 @@ class Scheduler:
         self._daemon_conns: Dict[Any, NodeID] = {}
         # per-daemon send lock (fetch threads + loop share the socket)
         self._daemon_send_locks: Dict[Any, threading.Lock] = {}
+        # req_id -> (event, box) for in-flight node stack-dump requests
+        self._stack_waiters: Dict[str, Tuple] = {}
+        # per-dispatch-pass node-candidate cache (None outside a pass)
+        self._pick_cache: Optional[Dict] = None
+        self._last_health_scan = time.monotonic()
         # object location directory: oid -> set of node ids with a sealed
         # copy (parity: OwnershipBasedObjectDirectory,
         # ownership_based_object_directory.h:37)
@@ -560,6 +566,12 @@ class Scheduler:
             node = self.nodes.get(nid) if nid is not None else None
             if node is not None:
                 node.last_heartbeat = time.monotonic()
+        elif kind == "stacks":
+            _, req_id, text = msg
+            waiter = self._stack_waiters.get(req_id)
+            if waiter is not None:
+                waiter[1]["text"] = text
+                waiter[0].set()
         else:
             logger.warning("unknown daemon message: %r", kind)
 
@@ -1105,15 +1117,33 @@ class Scheduler:
         # gcs_health_check_manager.h:39)
         if self._daemon_conns:
             now = time.monotonic()
-            for conn, nid in list(self._daemon_conns.items()):
-                node = self.nodes.get(nid)
-                if (
-                    node is not None
-                    and node.last_heartbeat
-                    and now - node.last_heartbeat > self.config.health_check_timeout_s
-                ):
-                    logger.warning("node %s missed heartbeats", nid.hex()[:8])
-                    self._on_daemon_death(conn)
+            # if WE haven't scanned recently, the loop (or its socket reads)
+            # was saturated — daemon silence is indistinguishable from our
+            # own deafness, so grant one grace round instead of declaring a
+            # whole fleet dead after a head-side stall
+            head_stalled = (
+                now - self._last_health_scan
+                > self.config.health_check_timeout_s / 2
+            )
+            self._last_health_scan = now
+            if not head_stalled:
+                for conn, nid in list(self._daemon_conns.items()):
+                    node = self.nodes.get(nid)
+                    if (
+                        node is not None
+                        and node.last_heartbeat
+                        and now - node.last_heartbeat
+                        > self.config.health_check_timeout_s
+                    ):
+                        logger.warning(
+                            "node %s missed heartbeats", nid.hex()[:8]
+                        )
+                        self._on_daemon_death(conn)
+            else:
+                for nid in self._daemon_conns.values():
+                    node = self.nodes.get(nid)
+                    if node is not None and node.last_heartbeat:
+                        node.last_heartbeat = now
         if self._transit_pins:
             now = time.monotonic()
             expired = []
@@ -1158,35 +1188,49 @@ class Scheduler:
             self._last_full_dispatch = now_d
         deferred = []
         consecutive_fails = 0
-        while self._pending:
-            task_id = self._pending.popleft()
-            rec = self.tasks.get(task_id)
-            if rec is None or rec.state not in ("PENDING",):
-                continue
-            placed = self._try_dispatch(rec)
-            if not placed:
-                deferred.append(task_id)
-                consecutive_fails += 1
-                if fail_cap is not None and consecutive_fails >= fail_cap:
-                    break
-            else:
-                consecutive_fails = 0
+        self._pick_cache = {}
+        try:
+            while self._pending:
+                task_id = self._pending.popleft()
+                rec = self.tasks.get(task_id)
+                if rec is None or rec.state not in ("PENDING",):
+                    continue
+                placed = self._try_dispatch(rec)
+                if not placed:
+                    deferred.append(task_id)
+                    consecutive_fails += 1
+                    if fail_cap is not None and consecutive_fails >= fail_cap:
+                        break
+                else:
+                    consecutive_fails = 0
+        finally:
+            self._pick_cache = None
         self._pending.extendleft(reversed(deferred))
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
         """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
         demand = spec.resources
         strat = spec.scheduling_strategy
-        alive = [n for n in self.nodes.values() if n.alive]
+        cache = self._pick_cache
+        if cache is not None:
+            alive = cache.get("__alive__")
+            if alive is None:
+                alive = cache["__alive__"] = [
+                    n for n in self.nodes.values() if n.alive
+                ]
+        else:
+            alive = [n for n in self.nodes.values() if n.alive]
         if strat.kind == "NODE_AFFINITY":
             for n in alive:
                 if n.node_id.hex() == strat.node_id:
-                    if n.can_run(demand):
+                    # n.alive re-checked: the cached pass-local alive list
+                    # can contain a node that died mid-pass
+                    if n.alive and n.can_run(demand):
                         return n
                     return None if not strat.soft else self._pick_node_default(demand, alive)
             return None if not strat.soft else self._pick_node_default(demand, alive)
         if strat.kind == "SPREAD":
-            runnable = [n for n in alive if n.can_run(demand)]
+            runnable = [n for n in alive if n.alive and n.can_run(demand)]
             if not runnable:
                 return None
             return min(runnable, key=lambda n: n.utilization())
@@ -1194,14 +1238,46 @@ class Scheduler:
 
     def _pick_node_default(self, demand, alive) -> Optional[NodeState]:
         local = self._node.head_node_id
-        runnable = [n for n in alive if n.can_run(demand)]
+        local_node = self.nodes.get(local)
+        if (
+            local_node is not None
+            and local_node.alive
+            and local_node.can_run(demand)
+            and local_node.utilization() < 0.9
+        ):
+            return local_node
+        cache = self._pick_cache
+        if cache is not None:
+            # per-dispatch-pass candidate cache: a deep homogeneous queue
+            # otherwise pays O(nodes log nodes) *per task* re-sorting an
+            # unchanged fleet (the 50-node submit-rate collapse); within one
+            # pass capacity only shrinks, so stale entries just pop off.
+            # Selection stays top-k random (not first-fit) so concurrent
+            # tasks spread instead of bin-packing one node.
+            key = ("__cand__",) + tuple(sorted(demand.items()))
+            cand = cache.get(key)
+            if cand is None:
+                cand = cache[key] = sorted(
+                    (n for n in alive if n.alive and n.can_run(demand)),
+                    key=lambda n: n.utilization(),
+                )
+            while cand:
+                k = max(
+                    1, int(len(cand) * self.config.scheduler_top_k_fraction)
+                )
+                i = random.randrange(min(k, len(cand)))
+                n = cand[i]
+                # re-validate at use: the node may have died or filled up
+                # since the list was built earlier in this pass
+                if n.alive and n.can_run(demand):
+                    return n
+                cand.pop(i)
+            return None
+        runnable = [n for n in alive if n.alive and n.can_run(demand)]
         if not runnable:
             return None
-        for n in runnable:
-            if n.node_id == local and n.utilization() < 0.9:
-                return n
         k = max(1, int(len(runnable) * self.config.scheduler_top_k_fraction))
-        top = sorted(runnable, key=lambda n: n.utilization())[:k]
+        top = heapq.nsmallest(k, runnable, key=lambda n: n.utilization())
         return random.choice(top)
 
     def _try_dispatch(self, rec: TaskRecord) -> bool:
@@ -1983,6 +2059,36 @@ class Scheduler:
                     except (OSError, EOFError):
                         pass
 
+    def request_node_stacks(self, timeout: float = 5.0) -> Dict[str, str]:
+        """Per-daemon thread-stack dumps (dashboard /api/stacks; the role of
+        the reference's py-spy reporter agents). Called from an HTTP thread:
+        sends ride the per-conn locks, replies land on the scheduler loop.
+        """
+        import uuid as _uuid
+
+        waiters = []
+        for conn, nid in list(self._daemon_conns.items()):
+            req_id = _uuid.uuid4().hex
+            ev = threading.Event()
+            box: Dict[str, str] = {}
+            self._stack_waiters[req_id] = (ev, box)
+            try:
+                with self._daemon_send_locks[conn]:
+                    conn.send(("dump_stacks", req_id))
+            except (OSError, EOFError, KeyError):
+                self._stack_waiters.pop(req_id, None)
+                continue
+            waiters.append((nid, req_id, ev, box))
+        out: Dict[str, str] = {}
+        deadline = time.monotonic() + timeout
+        for nid, req_id, ev, box in waiters:
+            ok = ev.wait(max(0.0, deadline - time.monotonic()))
+            self._stack_waiters.pop(req_id, None)
+            out[f"node-{nid.hex()[:12]}"] = (
+                box.get("text", "") if ok else "<no reply within timeout>"
+            )
+        return out
+
     def _write_gcs_snapshot(self):
         """Durable control-plane state: KV, name registry, and the creation
         specs of detached actors (so a restarted head can restart them).
@@ -1997,9 +2103,21 @@ class Scheduler:
             ):
                 detached.append(pickle.dumps(st.creation_spec))
         snap["detached_actor_specs"] = detached
+        # head-restart continuity: a successor head needs the old listener
+        # address (daemons keep dialing it) and the auth key; the pid lets
+        # auto-restore skip sessions whose head is still alive
+        head_srv = getattr(self._node, "head_server", None)
+        snap["cluster"] = {
+            "auth_key": self.config.cluster_auth_key,
+            "host": self.config.cluster_host,
+            "port": head_srv.address[1] if head_srv is not None else 0,
+            "head_pid": os.getpid(),
+        }
         path = os.path.join(self._node.session_dir, "gcs_snapshot.pkl")
         tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
+        # contains the cluster secret: owner-only
+        fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as fh:
             fh.write(pickle.dumps(snap))
         os.replace(tmp, path)
 

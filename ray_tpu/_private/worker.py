@@ -424,16 +424,74 @@ def init(
             log_to_driver=log_to_driver,
             **(_system_config or {}),
         )
+        snap_path = _restore_from
+        if snap_path and os.path.isdir(snap_path):
+            snap_path = os.path.join(snap_path, "gcs_snapshot.pkl")
+        if snap_path is None and cfg.auto_restore:
+            snap_path = _find_crashed_session_snapshot(cfg.session_dir_root)
+        restart_head = False
+        if snap_path:
+            # adopt the crashed head's identity BEFORE the node exists: the
+            # auth key must be in the worker config snapshot, and the head
+            # server must rebind the old port for daemons to re-attach
+            # (parity: GCS restart rebuilding from Redis, gcs_init_data.h)
+            import pickle as _pickle
+
+            with open(snap_path, "rb") as fh:
+                cluster = _pickle.loads(fh.read()).get("cluster") or {}
+            if cluster.get("auth_key"):
+                cfg.cluster_auth_key = cluster["auth_key"]
+                cfg.cluster_host = cluster.get("host", cfg.cluster_host)
+                cfg.cluster_port = int(cluster.get("port") or 0)
+                restart_head = bool(cfg.cluster_port)
         node = Node(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources, labels=labels)
-        if _restore_from:
-            # control-plane restart: rebuild GCS tables + detached actors
-            # from the previous session's snapshot (parity: gcs_init_data.h)
-            snap_path = _restore_from
-            if os.path.isdir(snap_path):
-                snap_path = os.path.join(snap_path, "gcs_snapshot.pkl")
+        if snap_path:
+            if restart_head:
+                node.start_head_server()
             node.scheduler.restore_gcs_snapshot(snap_path)
+            # mark the crashed session consumed so a later auto-restore
+            # doesn't resurrect week-old state a second time
+            try:
+                marker = os.path.join(
+                    os.path.dirname(snap_path), "clean_shutdown"
+                )
+                with open(marker, "w") as fh:
+                    fh.write(f"restored by {node.session_dir}\n")
+            except OSError:
+                pass
         _driver = DriverRuntime(node)
         return _driver
+
+
+def _find_crashed_session_snapshot(session_root: str) -> Optional[str]:
+    """Newest session snapshot whose head crashed: no clean-shutdown marker
+    and the recorded head pid is gone."""
+    import glob as _glob
+    import pickle as _pickle
+
+    candidates = sorted(
+        _glob.glob(os.path.join(session_root, "*", "gcs_snapshot.pkl")),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    for path in candidates:
+        sdir = os.path.dirname(path)
+        if os.path.exists(os.path.join(sdir, "clean_shutdown")):
+            continue
+        try:
+            with open(path, "rb") as fh:
+                cluster = _pickle.loads(fh.read()).get("cluster") or {}
+        except Exception:
+            continue
+        pid = cluster.get("head_pid")
+        if pid:
+            try:
+                os.kill(int(pid), 0)
+                continue  # that head is still alive — not ours to resurrect
+            except OSError:
+                pass
+        return path
+    return None
 
 
 def shutdown() -> None:

@@ -2,13 +2,18 @@
 
 Parity: ``python/ray/dashboard`` (head process serving cluster state over
 HTTP; SURVEY.md §2.2). The reference ships an aiohttp + React SPA; here a
-stdlib HTTP server in the driver serves the same data as JSON:
+stdlib HTTP server in the driver serves a dependency-free single-page UI
+(``dashboard/ui.py``) over the same data as JSON:
 
+  /                     single-page UI (tabs over every endpoint below)
+  /overview             minimal static HTML overview
   /api/cluster_status   resources + nodes
   /api/tasks            task table            /api/actors     actor table
   /api/objects          object store          /api/jobs       job table
+  /api/stacks           thread stacks of driver + every node daemon
+                        (the reporter-agent py-spy role)
+  /api/profiler/start|stop   jax.profiler XPlane device traces
   /metrics              Prometheus exposition
-  /                     minimal HTML overview
 """
 
 from __future__ import annotations
@@ -90,6 +95,18 @@ def start_dashboard(port: int = 8765) -> int:
 
                     jax.profiler.stop_trace()
                     body = {"status": "stopped"}
+                elif self.path == "/api/stacks":
+                    # live thread stacks: driver + every node daemon (the
+                    # reporter-agent py-spy role, reporter_agent.py:314)
+                    from ray_tpu._private.profiling import format_thread_stacks
+                    from ray_tpu._private.worker import get_driver
+
+                    body = {"driver": format_thread_stacks()}
+                    drv = get_driver()
+                    if drv is not None and hasattr(drv, "node"):
+                        body.update(
+                            drv.node.scheduler.request_node_stacks()
+                        )
                 elif self.path == "/metrics":
                     from ray_tpu.util.metrics import prometheus_text
 
@@ -97,6 +114,11 @@ def start_dashboard(port: int = 8765) -> int:
                     self._reply(200, blob, "text/plain; version=0.0.4")
                     return
                 elif self.path == "/":
+                    from ray_tpu.dashboard.ui import PAGE
+
+                    self._reply(200, PAGE.encode(), "text/html")
+                    return
+                elif self.path == "/overview":
                     blob = _overview_html().encode()
                     self._reply(200, blob, "text/html")
                     return

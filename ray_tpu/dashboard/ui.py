@@ -1,0 +1,158 @@
+"""Dashboard single-page UI.
+
+Parity role: the reference's React SPA (``python/ray/dashboard/client/``,
+194 TS files) — scoped to a dependency-free static page (this environment is
+zero-egress: no CDN, no build step) that polls the JSON endpoints the
+dashboard already serves and renders the same panes: cluster, nodes, tasks,
+actors, objects, placement groups, serve, jobs, logs, event stats, stacks.
+"""
+
+PAGE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { --bg:#10141a; --panel:#1a212b; --line:#2a3442; --fg:#d7dee8;
+          --dim:#8b98a8; --acc:#4fa3ff; --ok:#38c172; --bad:#e3504f; }
+  * { box-sizing:border-box; }
+  body { margin:0; font:13px/1.45 ui-monospace,Consolas,monospace;
+         background:var(--bg); color:var(--fg); }
+  header { display:flex; align-items:center; gap:16px; padding:10px 16px;
+           border-bottom:1px solid var(--line); }
+  header h1 { font-size:15px; margin:0; color:var(--acc); }
+  header .meta { color:var(--dim); }
+  nav { display:flex; gap:2px; padding:6px 12px; border-bottom:1px solid var(--line);
+        flex-wrap:wrap; }
+  nav button { background:none; border:1px solid transparent; color:var(--dim);
+               padding:4px 10px; cursor:pointer; font:inherit; border-radius:4px; }
+  nav button.active { color:var(--fg); border-color:var(--line);
+                      background:var(--panel); }
+  main { padding:12px 16px; }
+  table { border-collapse:collapse; width:100%; margin:8px 0 20px; }
+  th, td { text-align:left; padding:4px 10px; border-bottom:1px solid var(--line);
+           white-space:nowrap; overflow:hidden; text-overflow:ellipsis;
+           max-width:420px; }
+  th { color:var(--dim); font-weight:normal; position:sticky; top:0;
+       background:var(--bg); }
+  .ok { color:var(--ok); } .bad { color:var(--bad); }
+  .bar { display:inline-block; height:9px; background:var(--acc);
+         border-radius:2px; vertical-align:middle; }
+  .barbg { display:inline-block; width:120px; height:9px; background:var(--panel);
+           border-radius:2px; vertical-align:middle; margin-right:6px; }
+  pre { background:var(--panel); padding:10px; border-radius:4px;
+        overflow:auto; max-height:70vh; }
+  h2 { font-size:13px; color:var(--dim); text-transform:uppercase;
+       letter-spacing:.08em; margin:14px 0 2px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="meta" id="updated"></span>
+  <span class="meta" id="err" class="bad"></span>
+</header>
+<nav id="nav"></nav>
+<main id="main">loading…</main>
+<script>
+const TABS = ["overview","tasks","actors","objects","placement_groups",
+              "serve","jobs","logs","event_stats","stacks"];
+let tab = location.hash.slice(1) || "overview";
+const $ = (id) => document.getElementById(id);
+
+function nav() {
+  $("nav").innerHTML = TABS.map(t =>
+    `<button class="${t===tab?'active':''}" onclick="go('${t}')">${t}</button>`
+  ).join("");
+}
+function go(t) { tab = t; location.hash = t; nav(); refresh(); }
+
+async function j(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path + " -> " + r.status);
+  return r.json();
+}
+function esc(s) { return String(s).replace(/&/g,"&amp;").replace(/</g,"&lt;"); }
+function table(rows, cols) {
+  if (!rows || !rows.length) return "<p class='meta'>none</p>";
+  cols = cols || Object.keys(rows[0]);
+  return "<table><tr>" + cols.map(c=>`<th>${c}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => {
+      let v = r[c];
+      if (v !== null && typeof v === "object") v = JSON.stringify(v);
+      let cls = "";
+      if (c === "state" || c === "status" || c === "alive")
+        cls = /ALIVE|FINISHED|RUNNING|true|SUCCEEDED|HEALTHY/i.test(String(v)) ? "ok"
+            : /DEAD|FAILED|false|UNHEALTHY/i.test(String(v)) ? "bad" : "";
+      return `<td class="${cls}">${esc(v===undefined?"":v)}</td>`;
+    }).join("") + "</tr>").join("") + "</table>";
+}
+function bars(total, avail) {
+  return "<table>" + Object.keys(total).sort().map(k => {
+    const used = total[k] - (avail[k] || 0);
+    const pct = total[k] ? Math.round(100*used/total[k]) : 0;
+    return `<tr><td>${esc(k)}</td>
+      <td><span class="barbg"><span class="bar" style="width:${Math.round(pct*1.2)}px"></span></span>
+      ${used.toFixed(1)} / ${total[k].toFixed(1)} used</td></tr>`;
+  }).join("") + "</table>";
+}
+
+const RENDER = {
+  async overview() {
+    const s = await j("/api/cluster_status");
+    const nodes = s.nodes || [];
+    return "<h2>resources</h2>" + bars(s.total || {}, s.available || {}) +
+      `<h2>nodes (${nodes.length})</h2>` +
+      table(nodes, ["node_id","alive","total","available","labels"]);
+  },
+  async tasks() {
+    const rows = await j("/api/tasks");
+    const by = {};
+    rows.forEach(r => { by[r.state] = (by[r.state]||0)+1; });
+    return "<h2>by state</h2><p>" +
+      Object.entries(by).map(([k,v])=>`${k}: ${v}`).join(" · ") + "</p>" +
+      "<h2>latest</h2>" + table(rows.slice(-200).reverse());
+  },
+  async actors() { return table(await j("/api/actors")); },
+  async objects() {
+    const rows = await j("/api/objects");
+    const total = rows.reduce((a,r)=>a+(r.size_bytes||0), 0);
+    return `<p>${rows.length} objects, ${(total/1e6).toFixed(1)} MB</p>` +
+      table(rows.slice(0,300));
+  },
+  async placement_groups() { return table(await j("/api/placement_groups")); },
+  async serve() {
+    const s = await j("/api/serve");
+    return "<pre>" + esc(JSON.stringify(s, null, 2)) + "</pre>";
+  },
+  async jobs() { return table(await j("/api/jobs")); },
+  async logs() { return table(await j("/api/logs")); },
+  async event_stats() {
+    const s = await j("/api/event_stats");
+    return "<pre>" + esc(JSON.stringify(s, null, 2)) + "</pre>";
+  },
+  async stacks() {
+    const s = await j("/api/stacks");
+    return Object.entries(s).map(([proc, txt]) =>
+      `<h2>${esc(proc)}</h2><pre>${esc(txt)}</pre>`).join("");
+  },
+};
+
+let timer = null;
+async function refresh() {
+  try {
+    $("main").innerHTML = await RENDER[tab]();
+    $("updated").textContent = "updated " + new Date().toLocaleTimeString();
+    $("err").textContent = "";
+  } catch (e) {
+    $("err").textContent = String(e);
+  }
+  clearTimeout(timer);
+  timer = setTimeout(refresh, tab === "stacks" ? 10000 : 2000);
+}
+nav();
+refresh();
+</script>
+</body>
+</html>
+"""

@@ -133,6 +133,18 @@ def test_dashboard_endpoints(ray_start_regular):
         assert any(t["name"] == "f" for t in tasks)
         html = urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=30).read()
         assert b"ray_tpu" in html
+        # the single-page UI with its tab renderers
+        assert b"placement_groups" in html and b"RENDER" in html
+        overview = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/overview", timeout=30
+        ).read()
+        assert b"Resources" in overview
+        stacks = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/stacks", timeout=30
+            ).read()
+        )
+        assert "driver" in stacks and "thread" in stacks["driver"]
         metrics = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=30
         ).read()

@@ -1,0 +1,207 @@
+"""Head restart continuity: SIGKILL the head, restart it, cluster resumes.
+
+Parity: the reference's GCS rebuilds all tables from Redis on restart and
+raylets re-attach (``src/ray/gcs/store_client/redis_store_client.h:33``,
+``gcs_init_data.h``). Here the snapshot in the session dir plays Redis's
+role: a restarted head (``auto_restore``) adopts the crashed head's auth
+key + listener port, restores the KV/name tables, recreates detached
+actors, and surviving node daemons re-attach on their own.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEAD1 = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+
+rt = ray_tpu.init(num_cpus=1)
+addr = rt.node.start_head_server()
+print("ADDR " + json.dumps(
+    {{"addr": list(addr), "auth": rt.config.cluster_auth_key,
+      "session": rt.node.session_dir}}), flush=True)
+
+# wait for the daemon node to join
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if any(n["alive"] and "dnode" in n["total"] for n in ray_tpu.nodes()):
+        break
+    time.sleep(0.2)
+else:
+    raise TimeoutError("daemon never joined")
+
+@ray_tpu.remote(num_cpus=0)
+class Keeper:
+    def __init__(self):
+        self.tag = "alive"
+
+    def ping(self):
+        return self.tag
+
+k = Keeper.options(name="keeper", lifetime="detached").remote()
+assert ray_tpu.get(k.ping.remote(), timeout=60) == "alive"
+print("ACTOR_UP", flush=True)
+
+# wait until the periodic snapshot includes the detached actor
+snap = os.path.join(rt.node.session_dir, "gcs_snapshot.pkl")
+start = os.path.getmtime(snap) if os.path.exists(snap) else 0
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if os.path.exists(snap) and os.path.getmtime(snap) > start:
+        break
+    time.sleep(0.5)
+print("SNAPSHOTTED", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+HEAD2 = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["RAY_TPU_AUTO_RESTORE"] = "1"
+import ray_tpu
+
+rt = ray_tpu.init(num_cpus=1)
+# restored head must be listening on the crashed head's port already
+assert rt.node.head_server is not None, "auto-restore did not restart the head server"
+addr = rt.node.head_server.address
+print("ADDR2 " + json.dumps(list(addr)), flush=True)
+assert list(addr) == {old_addr!r}, (addr, {old_addr!r})
+
+# the surviving daemon re-attaches by itself
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if any(n["alive"] and "dnode" in n["total"] for n in ray_tpu.nodes()):
+        break
+    time.sleep(0.5)
+else:
+    raise TimeoutError("daemon did not re-attach")
+print("DAEMON_BACK", flush=True)
+
+# the detached actor is back under its name (recreated by restore)
+deadline = time.monotonic() + 60
+keeper = None
+while time.monotonic() < deadline:
+    try:
+        keeper = ray_tpu.get_actor("keeper")
+        break
+    except ValueError:
+        time.sleep(0.5)
+assert keeper is not None, "detached actor not restored"
+assert ray_tpu.get(keeper.ping.remote(), timeout=60) == "alive"
+print("ACTOR_BACK", flush=True)
+
+# new work lands on the re-attached daemon
+@ray_tpu.remote(resources={{"dnode": 0.5}})
+def on_daemon():
+    return os.getpid()
+
+pid = ray_tpu.get(on_daemon.remote(), timeout=120)
+assert pid > 0
+print("OK", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def _wait_line(proc, marker, timeout):
+    deadline = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process died waiting for {marker!r}: {''.join(lines)[-3000:]}"
+                )
+            time.sleep(0.05)
+            continue
+        lines.append(line)
+        if marker in line:
+            return line
+    raise AssertionError(f"timed out waiting for {marker!r}: {''.join(lines)[-3000:]}")
+
+
+def test_head_sigkill_restart_cluster_resumes(tmp_path):
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR_ROOT"] = str(tmp_path / "sessions")
+    env.pop("RAY_TPU_AUTO_RESTORE", None)
+
+    head1 = subprocess.Popen(
+        [sys.executable, "-u", "-c", HEAD1.format(repo=REPO)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    daemon = None
+    head2 = None
+    try:
+        info = json.loads(_wait_line(head1, "ADDR ", 120).split("ADDR ", 1)[1])
+        host, port = info["addr"]
+
+        denv = dict(env)
+        denv["RAY_TPU_AUTH"] = info["auth"]
+        daemon = subprocess.Popen(
+            [
+                sys.executable,
+                "-u",
+                "-m",
+                "ray_tpu._private.raylet",
+                "--address",
+                f"{host}:{port}",
+                "--num-cpus",
+                "1",
+                "--resources",
+                '{"dnode": 1.0}',
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=denv,
+            cwd=REPO,
+        )
+        _wait_line(head1, "ACTOR_UP", 180)
+        _wait_line(head1, "SNAPSHOTTED", 60)
+
+        # crash the head ungracefully (no clean-shutdown marker)
+        os.kill(head1.pid, signal.SIGKILL)
+        head1.wait(timeout=30)
+
+        assert daemon.poll() is None, "daemon died with the head"
+
+        head2 = subprocess.Popen(
+            [
+                sys.executable,
+                "-u",
+                "-c",
+                HEAD2.format(repo=REPO, old_addr=[host, port]),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        _wait_line(head2, "DAEMON_BACK", 180)
+        _wait_line(head2, "ACTOR_BACK", 120)
+        _wait_line(head2, "OK", 180)
+        head2.wait(timeout=60)
+        assert head2.returncode == 0
+    finally:
+        for p in (head1, daemon, head2):
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in (head1, daemon, head2):
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass

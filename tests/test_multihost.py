@@ -361,3 +361,16 @@ def test_node_churn_under_load(real_cluster):
         nodes.append(real_cluster.add_node(num_cpus=2, resources={"churn": 4}))
     out = ray_tpu.get(refs, timeout=180)
     assert sorted(out) == list(range(60))
+
+
+def test_daemon_stack_dump(real_cluster):
+    """Per-daemon thread-stack dumps (dashboard /api/stacks plumbing; the
+    reporter-agent py-spy role, reporter_agent.py:314)."""
+    from ray_tpu._private.worker import get_driver
+
+    real_cluster.add_node(num_cpus=1)
+    real_cluster.wait_for_nodes()
+    stacks = get_driver().node.scheduler.request_node_stacks(timeout=30)
+    assert len(stacks) == 1
+    text = next(iter(stacks.values()))
+    assert "thread" in text and "MainThread" in text
