@@ -34,11 +34,11 @@ class Config:
     # --- object store ---
     object_store_memory: int = 2 * 1024**3  # bytes of shm for the store arena
     max_direct_call_object_size: int = 100 * 1024  # inline small returns (ref: ray_config_def.h)
-    # how long a pickled ObjectRef's handoff pin keeps its object alive while
-    # in transit to the consumer (see ObjectRef.__reduce__): long enough for
-    # submission->deserialization under load, short enough that dropped
-    # objects don't linger
-    transit_ref_ttl_s: float = 10.0
+    # transit pins are released by the consumer's deserialization ACK (see
+    # ObjectRef.__reduce__ / scheduler._apply_ref_op) — this backstop only
+    # collects pins whose serialized blob was dropped without ever being
+    # deserialized. It is a leak bound, not a correctness window.
+    transit_pin_backstop_s: float = 3600.0
     object_spilling_threshold: float = 0.8  # fraction of store full before spilling
     spill_directory: str = ""  # default: <session>/spill
     # --- scheduler ---

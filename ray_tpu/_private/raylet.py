@@ -131,8 +131,14 @@ class NodeDaemon:
             getattr(self.config, "daemon_reconnect_timeout_s", 60.0)
         )
         delay = 0.5
+        import socket as _socket
+
         while time.monotonic() < deadline:
             try:
+                # bounded reachability probe first: Client() has no connect
+                # timeout, and a blackholed head would stall one attempt for
+                # the OS default (~2 min), blowing the reconnect budget
+                _socket.create_connection(self._head_addr, timeout=5).close()
                 conn = Client(self._head_addr, authkey=self.auth_key)
                 # register on the fresh conn FIRST: installing it before the
                 # handshake would let the heartbeat thread race a beat in as

@@ -24,7 +24,6 @@ import logging
 import os
 import pickle
 import queue
-import heapq
 import random
 import threading
 import time
@@ -394,6 +393,12 @@ class Scheduler:
         self._starting_count: Dict[NodeID, int] = collections.defaultdict(int)
         # object ref counts (owner-side): oid -> count; deletion when 0
         self._ref_counts: Dict[ObjectID, int] = collections.defaultdict(int)
+        # token -> oid for unreleased transit pins (acknowledged handoff)
+        self._transit_tokens: Dict[bytes, ObjectID] = {}
+        # releases that arrived before their pin (scheduler-bypassing paths)
+        self._early_released: set = set()
+        # per-worker borrow attribution: released on worker death
+        self._holder_refs: Dict[Any, Dict[ObjectID, int]] = {}
         # FIFO of (expiry, oid) transit pins; deadlines are monotone because
         # the TTL is constant, so expiry only ever pops from the left
         self._transit_pins: collections.deque = collections.deque()
@@ -672,7 +677,9 @@ class Scheduler:
                 except Exception:
                     pass
         elif kind == "cmd":
-            self._handle_cmd(msg[1])
+            # holder: ref borrows from this worker are attributed to it so
+            # a crashed borrower's refs get released, not leaked
+            self._handle_cmd(msg[1], holder=wid)
         elif kind == "rpc":
             _, req_id, op, args = msg
             if op == "ensure_local" and len(args) == 1:
@@ -858,7 +865,7 @@ class Scheduler:
 
     # ---- command handling ------------------------------------------------
 
-    def _handle_cmd(self, cmd: Tuple):
+    def _handle_cmd(self, cmd: Tuple, holder=None):
         kind = cmd[0]
         if kind == "submit":
             self._on_submit(cmd[1])
@@ -902,6 +909,17 @@ class Scheduler:
         elif kind == "register_daemon":
             self._dispatch_dirty = True
             _, conn, ns = cmd
+            # re-registration under the same node_id (daemon re-attach after
+            # a transient break): evict the old conn mapping first, or the
+            # head's later EOF on it would mark the FRESH node dead
+            for old_conn, nid in list(self._daemon_conns.items()):
+                if nid == ns.node_id and old_conn is not conn:
+                    self._daemon_conns.pop(old_conn, None)
+                    self._daemon_send_locks.pop(old_conn, None)
+                    try:
+                        old_conn.close()
+                    except OSError:
+                        pass
             self.nodes[ns.node_id] = ns
             self._daemon_conns[conn] = ns.node_id
             self._daemon_send_locks[conn] = threading.Lock()
@@ -945,21 +963,21 @@ class Scheduler:
             self._remove_pg(cmd[1])
         elif kind == "add_ref":
             for oid in cmd[1]:
-                self._apply_ref_op(1, oid)
+                self._apply_ref_op(1, oid, holder=holder)
         elif kind == "ref_batch":
-            # ordered batch of driver-side ref ops (1 = add, -1 = remove,
-            # 2 = transit pin); order within the batch matters
-            for op, oid in cmd[1]:
-                self._apply_ref_op(op, oid)
-        elif kind == "transit_ref":
-            # pickled-ref handoff pin: keeps the object alive while a
-            # serialized ObjectRef travels to its consumer, auto-expiring
-            # because a blob may be deserialized any number of times (see
-            # ObjectRef.__reduce__)
-            for oid in cmd[1]:
-                self._apply_ref_op(2, oid)
+            # ordered batch of ref ops: (1, oid) add, (-1, oid) remove,
+            # (2, oid, token) transit pin, (3, oid, token) transit release;
+            # order within the batch matters
+            for entry in cmd[1]:
+                self._apply_ref_op(
+                    entry[0],
+                    entry[1],
+                    holder=holder,
+                    token=entry[2] if len(entry) > 2 else None,
+                )
         elif kind == "remove_ref":
-            self._unpin(cmd[1])
+            for oid in cmd[1]:
+                self._apply_ref_op(-1, oid, holder=holder)
         elif kind == "cancel":
             self._cancel_task(cmd[1], force=cmd[2])
         elif kind == "local_rpc":
@@ -1148,7 +1166,16 @@ class Scheduler:
             now = time.monotonic()
             expired = []
             while self._transit_pins and self._transit_pins[0][0] < now:
-                expired.append(self._transit_pins.popleft()[1])
+                token = self._transit_pins.popleft()[1]
+                self._early_released.discard(token)
+                oid = self._transit_tokens.pop(token, None)
+                if oid is not None:
+                    # blob serialized but never deserialized anywhere within
+                    # the backstop window: collect the leak
+                    logger.warning(
+                        "transit pin backstop expired for %s", oid.hex()[:16]
+                    )
+                    expired.append(oid)
             if expired:
                 self._unpin(expired)
         if self._placeholder_deadlines:
@@ -1246,39 +1273,32 @@ class Scheduler:
             and local_node.utilization() < 0.9
         ):
             return local_node
+        # per-dispatch-pass candidate cache: a deep homogeneous queue
+        # otherwise pays O(nodes log nodes) *per task* re-sorting an
+        # unchanged fleet (the 50-node submit-rate collapse); within one
+        # pass capacity only shrinks, so stale entries just pop off.
+        # Selection stays top-k random (not first-fit) so concurrent tasks
+        # spread instead of bin-packing one node.
         cache = self._pick_cache
-        if cache is not None:
-            # per-dispatch-pass candidate cache: a deep homogeneous queue
-            # otherwise pays O(nodes log nodes) *per task* re-sorting an
-            # unchanged fleet (the 50-node submit-rate collapse); within one
-            # pass capacity only shrinks, so stale entries just pop off.
-            # Selection stays top-k random (not first-fit) so concurrent
-            # tasks spread instead of bin-packing one node.
-            key = ("__cand__",) + tuple(sorted(demand.items()))
-            cand = cache.get(key)
-            if cand is None:
-                cand = cache[key] = sorted(
-                    (n for n in alive if n.alive and n.can_run(demand)),
-                    key=lambda n: n.utilization(),
-                )
-            while cand:
-                k = max(
-                    1, int(len(cand) * self.config.scheduler_top_k_fraction)
-                )
-                i = random.randrange(min(k, len(cand)))
-                n = cand[i]
-                # re-validate at use: the node may have died or filled up
-                # since the list was built earlier in this pass
-                if n.alive and n.can_run(demand):
-                    return n
-                cand.pop(i)
-            return None
-        runnable = [n for n in alive if n.alive and n.can_run(demand)]
-        if not runnable:
-            return None
-        k = max(1, int(len(runnable) * self.config.scheduler_top_k_fraction))
-        top = heapq.nsmallest(k, runnable, key=lambda n: n.utilization())
-        return random.choice(top)
+        key = ("__cand__",) + tuple(sorted(demand.items()))
+        cand = cache.get(key) if cache is not None else None
+        if cand is None:
+            cand = sorted(
+                (n for n in alive if n.alive and n.can_run(demand)),
+                key=lambda n: n.utilization(),
+            )
+            if cache is not None:
+                cache[key] = cand
+        while cand:
+            k = max(1, int(len(cand) * self.config.scheduler_top_k_fraction))
+            i = random.randrange(min(k, len(cand)))
+            n = cand[i]
+            # re-validate at use: the node may have died or filled up
+            # since the list was built earlier in this pass
+            if n.alive and n.can_run(demand):
+                return n
+            cand.pop(i)
+        return None
 
     def _try_dispatch(self, rec: TaskRecord) -> bool:
         spec = rec.spec
@@ -1583,6 +1603,13 @@ class Scheduler:
         except OSError:
             pass
         self._release_resources(w)
+        # release the dead borrower's registered refs (parity: the owner
+        # noticing borrower death in the reference's borrower protocol) —
+        # without this every borrow held by a crashed worker leaks forever
+        held = self._holder_refs.pop(wid, None)
+        if held:
+            doomed = [oid for oid, cnt in held.items() for _ in range(cnt)]
+            self._unpin(doomed)
         try:
             self._idle_by_node[w.node_id].remove(wid)
         except ValueError:
@@ -2028,18 +2055,72 @@ class Scheduler:
 
     # ---- misc ------------------------------------------------------------
 
-    def _apply_ref_op(self, op: int, oid: ObjectID) -> None:
-        """One ref-count mutation: 1 = add, -1 = remove, 2 = TTL transit pin.
-        The single body behind add_ref / remove_ref / transit_ref / ref_batch
-        so pin semantics can't diverge between the single and batched paths."""
+    def _apply_ref_op(
+        self, op: int, oid: ObjectID, holder=None, token: bytes = None
+    ) -> None:
+        """One ref-count mutation. The single body behind add_ref /
+        remove_ref / transit pins / ref_batch so semantics can't diverge
+        between the single and batched paths.
+
+        ops: 1 = add borrow, -1 = remove borrow, 2 = transit pin (token),
+        3 = transit release (token).
+
+        Acknowledged handoff (parity: the borrower protocol of
+        ``reference_count.h:61``): serializing a ref takes a token pin (2);
+        the FIRST deserialization registers its own borrow and then releases
+        the token (3) — ordered after its add on the same channel, so the
+        count never dips mid-handoff. No TTL cliff: a blob parked in a queue
+        for minutes stays pinned until consumed. A release can outrun its
+        pin on paths that bypass the scheduler (compiled-DAG channels);
+        ``_early_released`` makes the pair commute. The hour-scale backstop
+        only collects pins whose blob was dropped unconsumed (a leak bound,
+        not a correctness mechanism).
+
+        ``holder`` attributes borrows to a worker so a crashed borrower's
+        refs are released by ``_on_worker_death`` instead of leaking.
+        """
         if op == -1:
+            if holder is not None:
+                held = self._holder_refs.get(holder)
+                if held is not None:
+                    held[oid] -= 1
+                    if held[oid] <= 0:
+                        del held[oid]
+                    if not held:
+                        del self._holder_refs[holder]
             self._unpin([oid])
             return
-        self._ref_counts[oid] += 1
+        if op == 1:
+            self._ref_counts[oid] += 1
+            if holder is not None:
+                held = self._holder_refs.setdefault(holder, {})
+                held[oid] = held.get(oid, 0) + 1
+            return
         if op == 2:
+            if token in self._early_released:
+                self._early_released.discard(token)
+                return
+            self._ref_counts[oid] += 1
+            self._transit_tokens[token] = oid
             self._transit_pins.append(
-                (time.monotonic() + self.config.transit_ref_ttl_s, oid)
+                (
+                    time.monotonic() + self.config.transit_pin_backstop_s,
+                    token,
+                )
             )
+            return
+        if op == 3:
+            if self._transit_tokens.pop(token, None) is not None:
+                self._unpin([oid])
+            else:
+                self._early_released.add(token)
+                self._transit_pins.append(
+                    (
+                        time.monotonic()
+                        + self.config.transit_pin_backstop_s,
+                        token,
+                    )
+                )
 
     def _maybe_free(self, oid: ObjectID):
         self.memory_store.evict(oid)
@@ -2121,16 +2202,18 @@ class Scheduler:
             fh.write(pickle.dumps(snap))
         os.replace(tmp, path)
 
-    def restore_gcs_snapshot(self, path: str) -> int:
+    def restore_gcs_snapshot(self, path: str, snap: Optional[dict] = None) -> int:
         """Load tables from a snapshot and resubmit detached actors.
 
         The reference's GCS restart keeps live actor processes (workers
         outlive the GCS); here head-owned workers die with the head, so
         detached actors are *recreated* (fresh __init__) under their names.
-        Returns the number of actors restarted.
+        Returns the number of actors restarted. ``snap`` skips re-reading
+        the file when the caller already deserialized it.
         """
-        with open(path, "rb") as fh:
-            snap = pickle.loads(fh.read())
+        if snap is None:
+            with open(path, "rb") as fh:
+                snap = pickle.loads(fh.read())
         specs = [pickle.loads(b) for b in snap.pop("detached_actor_specs", [])]
         # name claims only survive for the detached actors being recreated
         # (their resubmitted specs re-claim them); names of actors that died

@@ -85,22 +85,25 @@ class ObjectRef:
         # the borrower sets of reference_count.h:61): the object stays alive
         # while any process holds a live handle, not just the driver.
         #
-        # The sender also takes a time-limited TRANSIT pin here. Without it, a
-        # worker that puts an object and returns the ref could GC its local
-        # handle (count -> 0 => free) before the consumer's borrow
-        # registration arrives; the pin rides the sender's ordered channel
-        # before its own decrement, so the count never touches zero
-        # mid-handoff. The pin expires scheduler-side (rather than being
-        # released by the receiver) because one pickled blob may be
-        # deserialized any number of times — receiver-side release would
-        # over-decrement on the second deserialization.
+        # Acknowledged handoff: the sender takes a TOKEN transit pin here.
+        # Without it, a worker that puts an object and returns the ref could
+        # GC its local handle (count -> 0 => free) before the consumer's
+        # borrow registration arrives. The pin is released by the FIRST
+        # deserialization's ack (its own borrow is posted first on the same
+        # ordered channel, so the count never dips) — NOT by a clock: a blob
+        # parked in a queue or slow channel for minutes stays pinned until
+        # consumed. Later deserializations of the same blob re-post the same
+        # token; the scheduler ignores already-released tokens, matching
+        # reference semantics (a ref re-materialized after every live handle
+        # died may be dead).
         rt = _worker_runtime if _worker_runtime is not None else _driver
+        token = os.urandom(12)
         if rt is not None and not getattr(rt, "closed", False):
             try:
-                rt.transit_refs([self._id])
+                rt.transit_pin([(self._id, token)])
             except Exception:
                 pass
-        return (_deserialize_ref, (self._id,))
+        return (_deserialize_ref_tok, (self._id, token))
 
     def __del__(self):
         if not self._owned:
@@ -140,6 +143,21 @@ def _deserialize_ref(oid: ObjectID) -> "ObjectRef":
     (worker or driver); an unconnected process gets an inert handle."""
     connected = _worker_runtime is not None or _driver is not None
     return ObjectRef(oid, _owned=connected)
+
+
+def _deserialize_ref_tok(oid: ObjectID, token: bytes) -> "ObjectRef":
+    """Counted borrow + transit-pin ack: the borrow registration posts first
+    (ObjectRef.__init__), the token release after, on the same ordered
+    channel — the object is continuously covered through the handoff."""
+    connected = _worker_runtime is not None or _driver is not None
+    ref = ObjectRef(oid, _owned=connected)
+    if connected:
+        rt = _worker_runtime if _worker_runtime is not None else _driver
+        try:
+            rt.transit_release([(oid, token)])
+        except Exception:
+            pass
+    return ref
 
 
 def _deserialize_ref_transit(oid: ObjectID) -> "ObjectRef":
@@ -212,8 +230,11 @@ class DriverRuntime:
     def remove_refs(self, oids):
         self.scheduler.post(("ref_batch", [(-1, oid) for oid in oids]))
 
-    def transit_refs(self, oids):
-        self.scheduler.post(("ref_batch", [(2, oid) for oid in oids]))
+    def transit_pin(self, pairs):
+        self.scheduler.post(("ref_batch", [(2, oid, tok) for oid, tok in pairs]))
+
+    def transit_release(self, pairs):
+        self.scheduler.post(("ref_batch", [(3, oid, tok) for oid, tok in pairs]))
 
 
     # -- object plane ------------------------------------------------------
@@ -430,6 +451,7 @@ def init(
         if snap_path is None and cfg.auto_restore:
             snap_path = _find_crashed_session_snapshot(cfg.session_dir_root)
         restart_head = False
+        snap = None
         if snap_path:
             # adopt the crashed head's identity BEFORE the node exists: the
             # auth key must be in the worker config snapshot, and the head
@@ -438,7 +460,8 @@ def init(
             import pickle as _pickle
 
             with open(snap_path, "rb") as fh:
-                cluster = _pickle.loads(fh.read()).get("cluster") or {}
+                snap = _pickle.loads(fh.read())
+            cluster = snap.get("cluster") or {}
             if cluster.get("auth_key"):
                 cfg.cluster_auth_key = cluster["auth_key"]
                 cfg.cluster_host = cluster.get("host", cfg.cluster_host)
@@ -448,7 +471,7 @@ def init(
         if snap_path:
             if restart_head:
                 node.start_head_server()
-            node.scheduler.restore_gcs_snapshot(snap_path)
+            node.scheduler.restore_gcs_snapshot(snap_path, snap=snap)
             # mark the crashed session consumed so a later auto-restore
             # doesn't resurrect week-old state a second time
             try:

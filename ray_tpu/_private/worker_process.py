@@ -286,8 +286,15 @@ class WorkerRuntime:
     def add_refs(self, oids):
         self._send(("cmd", ("add_ref", list(oids))))
 
-    def transit_refs(self, oids):
-        self._send(("cmd", ("transit_ref", list(oids))))
+    def transit_pin(self, pairs):
+        self._send(
+            ("cmd", ("ref_batch", [(2, oid, tok) for oid, tok in pairs]))
+        )
+
+    def transit_release(self, pairs):
+        self._send(
+            ("cmd", ("ref_batch", [(3, oid, tok) for oid, tok in pairs]))
+        )
 
     def remove_refs(self, oids):
         self._send(("cmd", ("remove_ref", list(oids))))

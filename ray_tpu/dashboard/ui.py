@@ -50,7 +50,7 @@ PAGE = """<!DOCTYPE html>
 <header>
   <h1>ray_tpu</h1>
   <span class="meta" id="updated"></span>
-  <span class="meta" id="err" class="bad"></span>
+  <span class="meta bad" id="err"></span>
 </header>
 <nav id="nav"></nav>
 <main id="main">loading…</main>

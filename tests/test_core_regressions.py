@@ -319,7 +319,8 @@ def test_object_freed_after_all_borrowers_drop(ray_start_regular):
     ray_tpu.get(h.drop.remote(), timeout=60)
     del ref
     gc.collect()
-    # the transit pin (transit_ref_ttl_s) must expire before the free lands
+    # every holder is gone and the transit pin was acked at deserialization,
+    # so the free lands promptly (no TTL to wait out)
     deadline = time.monotonic() + 45
     while time.monotonic() < deadline:
         if all(o["object_id"] != oid_hex for o in state.list_objects()):
@@ -343,6 +344,129 @@ def test_borrowed_ref_survives_transit_pin_expiry(ray_start_regular):
         return ray_tpu.put(np.full(30_000, 7.0))
 
     inner = ray_tpu.get(producer.remote(), timeout=60)
-    ttl = get_driver().config.transit_ref_ttl_s
-    time.sleep(ttl + 2.0)  # idle across the pin expiry without any get/put
+    # idle longer than the old 10 s TTL cliff: with acknowledged handoff the
+    # borrow was registered at deserialization, so no clock can free it
+    assert get_driver().config.transit_pin_backstop_s > 60
+    time.sleep(12.0)
     assert float(ray_tpu.get(inner, timeout=30).sum()) == 7.0 * 30_000
+
+
+def test_ref_parked_in_blob_past_old_ttl(ray_start_regular):
+    """Adversarial handoff: a serialized ref blob parked for longer than the
+    old 10 s TTL cliff, with the sender's handle long gone, must still
+    deserialize to a live object (acknowledged handoff has no clock)."""
+    import gc
+    import time
+
+    import cloudpickle
+    import numpy as np
+
+    import ray_tpu
+
+    ref = ray_tpu.put(np.full(20_000, 3.0))
+    blob = cloudpickle.dumps(ref)  # takes the token transit pin
+    del ref
+    gc.collect()
+    time.sleep(12.0)  # park past the old cliff; nothing else holds the object
+    ref2 = cloudpickle.loads(blob)  # borrow + ack
+    assert float(ray_tpu.get(ref2, timeout=30).sum()) == 3.0 * 20_000
+
+
+def test_borrower_death_releases_refs(ray_start_regular):
+    """A borrower whose worker dies mid-borrow must not leak its borrow: the
+    scheduler releases dead holders' refs, so the object frees once every
+    live handle is gone (the reference owner notices borrower death)."""
+    import gc
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.worker import get_driver
+
+    @ray_tpu.remote
+    class Borrower:
+        def __init__(self):
+            self.held = None
+
+        def hold(self, box):
+            self.held = box["ref"]  # registers this worker as a borrower
+            return True
+
+    ref = ray_tpu.put(np.arange(30_000, dtype=np.float64))
+    oid = ref.id()
+    b = Borrower.remote()
+    assert ray_tpu.get(b.hold.remote({"ref": ref}), timeout=60)
+    ray_tpu.kill(b)  # borrower dies holding the borrow
+    del b
+    del ref
+    gc.collect()
+    sched = get_driver().node.scheduler
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sched._ref_counts.get(oid, 0) <= 0:
+            break
+        time.sleep(0.2)
+    assert sched._ref_counts.get(oid, 0) <= 0, (
+        f"borrow leaked: count={sched._ref_counts.get(oid)}"
+    )
+
+
+def test_nested_borrow_chain(ray_start_regular):
+    """A ref nested inside containers through two task hops (each re-pickling
+    it) survives each handoff and resolves at the end."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def wrap(box):
+        import time
+
+        time.sleep(0.5)
+        return {"inner": box["ref"], "hop": box.get("hop", 0) + 1}
+
+    ref = ray_tpu.put(np.full(10_000, 5.0))
+    hop1 = ray_tpu.get(wrap.remote({"ref": ref}), timeout=60)
+    del ref
+    import gc
+
+    gc.collect()
+    hop2 = ray_tpu.get(wrap.remote({"ref": hop1["inner"], "hop": hop1["hop"]}), timeout=60)
+    del hop1
+    gc.collect()
+    assert hop2["hop"] == 2
+    assert float(ray_tpu.get(hop2["inner"], timeout=30).sum()) == 5.0 * 10_000
+
+
+def test_generator_refs_borrowed_cross_actor(ray_start_regular):
+    """Streaming-generator return refs handed to another actor resolve there
+    (generator refs flow through the same borrower protocol)."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(3):
+            yield np.full(5_000, float(i))
+
+    @ray_tpu.remote
+    class Consumer:
+        def consume(self, box):
+            import time
+
+            time.sleep(0.3)
+            # the nested ref is a genuine borrow (top-level args would be
+            # auto-resolved before the method runs)
+            return float(ray_tpu.get(box["r"], timeout=30).sum())
+
+    c = Consumer.remote()
+    totals = []
+    for item_ref in gen.remote():
+        totals.append(c.consume.remote({"r": item_ref}))
+        del item_ref
+    import gc
+
+    gc.collect()
+    assert ray_tpu.get(totals, timeout=120) == [0.0, 5_000.0, 10_000.0]
