@@ -1,0 +1,114 @@
+"""Serve data-plane throughput bench.
+
+Measures (a) requests/s through a DeploymentHandle (the in-cluster RPC
+path: handle -> pow-2 with probed queue depths -> replica actor) and
+(b) requests/s through the HTTP proxy ingress, on a trivial deployment.
+
+The reference publishes no single-box RPS for an equivalent shape, so
+``reference`` is null; the metric tracks round-over-round progress on the
+1-core box (the data plane is actor RPC through the scheduler, so the
+control-plane rate is the ceiling).
+
+Run: python bench_serve.py [--seconds N] [--clients N] [--replicas N]
+Prints one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def drive(fn, clients: int, seconds: float) -> float:
+    """Run fn() in a closed loop on N client threads; returns calls/s."""
+    stop = time.monotonic() + seconds
+    counts = [0] * clients
+
+    def loop(i):
+        while time.monotonic() < stop:
+            fn()
+            counts[i] += 1
+
+    threads = [
+        threading.Thread(target=loop, args=(i,)) for i in range(clients)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(counts) / (time.monotonic() - t0)
+
+
+def emit(metric, value, unit):
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 1),
+                "unit": unit,
+                "reference": None,
+                "ratio": None,
+            }
+        ),
+        flush=True,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args()
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+    @serve.deployment(num_replicas=args.replicas)
+    class Echo:
+        def __call__(self, x=None):
+            return {"echo": x}
+
+    serve.run(Echo.bind(), name="bench", route_prefix="/bench")
+    handle = serve.get_app_handle("bench")
+    assert handle.remote({"w": 1}).result(timeout_s=60) == {"echo": {"w": 1}}
+
+    # warm: spin up workers/replica paths
+    drive(lambda: handle.remote(1).result(timeout_s=60), args.clients, 2.0)
+    rps = drive(
+        lambda: handle.remote(1).result(timeout_s=60),
+        args.clients,
+        args.seconds,
+    )
+    emit("serve_handle_rps", rps, "req/s")
+
+    import urllib.request
+
+    from ray_tpu.serve._proxy import DEFAULT_PORT
+
+    url = f"http://127.0.0.1:{DEFAULT_PORT}/bench"
+
+    def http_call():
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                url, data=b"1", headers={"Content-Type": "application/json"}
+            ),
+            timeout=60,
+        ) as resp:
+            resp.read()
+
+    http_call()
+    rps_http = drive(http_call, args.clients, args.seconds)
+    emit("serve_http_rps", rps_http, "req/s")
+
+    serve.delete("bench")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -397,6 +397,7 @@ class Scheduler:
         self._transit_tokens: Dict[bytes, ObjectID] = {}
         # releases that arrived before their pin (scheduler-bypassing paths)
         self._early_released: set = set()
+        self._early_release_expiry: collections.deque = collections.deque()
         # per-worker borrow attribution: released on worker death
         self._holder_refs: Dict[Any, Dict[ObjectID, int]] = {}
         # FIFO of (expiry, oid) transit pins; deadlines are monotone because
@@ -964,6 +965,11 @@ class Scheduler:
         elif kind == "add_ref":
             for oid in cmd[1]:
                 self._apply_ref_op(1, oid, holder=holder)
+        elif kind == "pin_args":
+            # scheduler-released in-flight pins: never holder-attributed
+            # (see WorkerRuntime.submit)
+            for oid in cmd[1]:
+                self._apply_ref_op(1, oid)
         elif kind == "ref_batch":
             # ordered batch of ref ops: (1, oid) add, (-1, oid) remove,
             # (2, oid, token) transit pin, (3, oid, token) transit release;
@@ -1162,12 +1168,11 @@ class Scheduler:
                     node = self.nodes.get(nid)
                     if node is not None and node.last_heartbeat:
                         node.last_heartbeat = now
-        if self._transit_pins:
+        if self._transit_pins or self._early_release_expiry:
             now = time.monotonic()
             expired = []
             while self._transit_pins and self._transit_pins[0][0] < now:
                 token = self._transit_pins.popleft()[1]
-                self._early_released.discard(token)
                 oid = self._transit_tokens.pop(token, None)
                 if oid is not None:
                     # blob serialized but never deserialized anywhere within
@@ -1176,6 +1181,13 @@ class Scheduler:
                         "transit pin backstop expired for %s", oid.hex()[:16]
                     )
                     expired.append(oid)
+            while (
+                self._early_release_expiry
+                and self._early_release_expiry[0][0] < now
+            ):
+                self._early_released.discard(
+                    self._early_release_expiry.popleft()[1]
+                )
             if expired:
                 self._unpin(expired)
         if self._placeholder_deadlines:
@@ -2112,15 +2124,29 @@ class Scheduler:
         if op == 3:
             if self._transit_tokens.pop(token, None) is not None:
                 self._unpin([oid])
+                self._maybe_compact_transit_pins()
             else:
+                # seconds-scale expiry: an early release only needs to
+                # outlive the pin racing in behind it, and the common case
+                # (repeat deserialization of an already-acked blob) would
+                # otherwise grow this set at handoff rate for the full
+                # backstop hour
                 self._early_released.add(token)
-                self._transit_pins.append(
-                    (
-                        time.monotonic()
-                        + self.config.transit_pin_backstop_s,
-                        token,
-                    )
+                # separate deque: its 60 s deadlines would break the pin
+                # deque's monotone-deadline sweep
+                self._early_release_expiry.append(
+                    (time.monotonic() + 60.0, token)
                 )
+
+    def _maybe_compact_transit_pins(self) -> None:
+        """Released pins leave dead (expiry, token) entries in the deque
+        until their backstop; rebuild occasionally so sustained handoff
+        traffic stays O(live pins), not O(rate x backstop)."""
+        live = len(self._transit_tokens)
+        if len(self._transit_pins) > 4 * live + 1024:
+            self._transit_pins = collections.deque(
+                e for e in self._transit_pins if e[1] in self._transit_tokens
+            )
 
     def _maybe_free(self, oid: ObjectID):
         self.memory_store.evict(oid)

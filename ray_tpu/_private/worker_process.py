@@ -253,7 +253,11 @@ class WorkerRuntime:
     def submit(self, spec: TaskSpec):
         arg_refs = spec.arg_ref_ids()
         if arg_refs:
-            self._send(("cmd", ("add_ref", arg_refs)))
+            # in-flight arg pins: released by the SCHEDULER at task
+            # completion, so they must stay unattributed — attributing them
+            # to this worker would make worker death release them a second
+            # time and free objects other holders still reference
+            self._send(("cmd", ("pin_args", arg_refs)))
         self._send(("submit", spec))
 
     def rpc(self, op: str, *args):

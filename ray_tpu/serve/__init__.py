@@ -20,6 +20,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve._grpc_proxy import grpc_predict, start_grpc_proxy
+from ray_tpu.serve._proxy import start_node_proxies
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.schema import (
     build,
@@ -48,6 +49,7 @@ __all__ = [
     "dump_config",
     "grpc_predict",
     "start_grpc_proxy",
+    "start_node_proxies",
     "multiplexed",
     "get_multiplexed_model_id",
     "DeploymentHandle",

@@ -25,10 +25,20 @@ class _NoRouteError(Exception):
 
 @ray_tpu.remote(max_concurrency=16)
 class HTTPProxy:
-    def __init__(self, port: int = DEFAULT_PORT):
+    def __init__(self, port: int = DEFAULT_PORT, bind_host: str = "127.0.0.1"):
         self.routes: Dict[str, str] = {}  # route_prefix -> app name
         self._handles: Dict[str, object] = {}
         self.port = port
+        # the address peers should dial: loopback clusters stay loopback;
+        # a proxy pinned to a remote node advertises its node's outbound IP
+        from ray_tpu._private.worker import get_runtime
+        from ray_tpu.experimental.channel import _advertised_host
+
+        self.host = (
+            "127.0.0.1"
+            if bind_host == "127.0.0.1"
+            else _advertised_host(get_runtime().config.cluster_host)
+        )
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,7 +69,8 @@ class HTTPProxy:
             do_GET = _dispatch
             do_POST = _dispatch
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = ThreadingHTTPServer((bind_host, port), Handler)
+        self.port = self._server.server_address[1]  # resolved when port=0
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
 
@@ -86,7 +97,7 @@ class HTTPProxy:
         return True
 
     def address(self) -> Tuple[str, int]:
-        return ("127.0.0.1", self.port)
+        return (self.host, self.port)
 
 
 def ensure_proxy(controller, app_name: str, route_prefix: str, port: int = DEFAULT_PORT):
@@ -101,4 +112,58 @@ def ensure_proxy(controller, app_name: str, route_prefix: str, port: int = DEFAU
             proxy = ray_tpu.get_actor(_PROXY_NAME)
     handle = get_app_handle(app_name)
     ray_tpu.get(proxy.add_route.remote(route_prefix, app_name, handle), timeout=60)
+    try:
+        ray_tpu.get(
+            controller.register_route.remote(route_prefix, app_name), timeout=60
+        )
+    except Exception:
+        pass
     return proxy
+
+
+def start_node_proxies() -> Dict[str, Tuple[str, int]]:
+    """One HTTP ingress per alive node (parity: the reference's ProxyState
+    keeping a proxy actor on every node, ``_private/proxy_state.py``): each
+    proxy is pinned to its node and serves every registered route through
+    its own handles (pow-2 + probed queue depths). Returns
+    ``{node_id_hex: (host, port)}``; ports are ephemeral per node."""
+    from ray_tpu.serve.api import _get_or_create_controller, get_app_handle
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    controller = _get_or_create_controller()
+    routes = ray_tpu.get(controller.get_routes.remote(), timeout=60)
+    # one handle fetch per app (not per node x route); skip apps deleted
+    # since their route was registered
+    handles = {}
+    for app in set(routes.values()):
+        try:
+            handles[app] = get_app_handle(app)
+        except ValueError:
+            pass
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ray_tpu.nodes():
+        if not node["alive"]:
+            continue
+        nid = node["node_id"]
+        name = f"{_PROXY_NAME}:{nid[:12]}"
+        try:
+            proxy = ray_tpu.get_actor(name)
+        except ValueError:
+            try:
+                proxy = HTTPProxy.options(
+                    name=name,
+                    num_cpus=0,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=nid, soft=False
+                    ),
+                ).remote(0, bind_host="0.0.0.0")  # ephemeral port per node
+            except ValueError:
+                proxy = ray_tpu.get_actor(name)
+        for prefix, app in routes.items():
+            if app in handles:
+                ray_tpu.get(
+                    proxy.add_route.remote(prefix, app, handles[app]),
+                    timeout=60,
+                )
+        out[nid] = tuple(ray_tpu.get(proxy.address.remote(), timeout=60))
+    return out

@@ -101,6 +101,8 @@ class ServeController:
 
         # app -> deployment name -> {spec, replicas: [handles]}
         self.apps: Dict[str, Dict[str, dict]] = {}
+        # route_prefix -> app name (pushed to every proxy, incl. per-node)
+        self.routes: Dict[str, str] = {}
         self._stop = False
         # guards self.apps mutations against the reconciler thread (this actor
         # is threaded, so handlers run concurrently)
@@ -220,7 +222,17 @@ class ServeController:
         d = app.get(deployment_name)
         if d is None:
             return None
-        return (deployment_name, d["replicas"])
+        # depths: controller-probed queue lengths (parity: the replica
+        # queue-len probes of pow_2_scheduler.py:49, amortized through the
+        # reconcile loop instead of per-request RPCs)
+        return (deployment_name, d["replicas"], d.get("depths"))
+
+    def register_route(self, route_prefix: str, app_name: str) -> bool:
+        self.routes[route_prefix] = app_name
+        return True
+
+    def get_routes(self) -> Dict[str, str]:
+        return dict(self.routes)
 
     def status(self):
         return {
@@ -237,6 +249,25 @@ class ServeController:
     def delete_application(self, app_name: str):
         with self._lock:
             app = self.apps.pop(app_name, None)
+            doomed_routes = [
+                p for p, a in self.routes.items() if a == app_name
+            ]
+            for p in doomed_routes:
+                del self.routes[p]
+        # best-effort: stop live proxies from serving the stale routes
+        if doomed_routes:
+            from ray_tpu.serve._proxy import _PROXY_NAME
+
+            names = [_PROXY_NAME] + [
+                f"{_PROXY_NAME}:{n['node_id'][:12]}" for n in ray_tpu.nodes()
+            ]
+            for name in names:
+                try:
+                    proxy = ray_tpu.get_actor(name)
+                    for p in doomed_routes:
+                        proxy.remove_route.remote(p)
+                except ValueError:
+                    pass
         if app:
             self._teardown(app)
         return True
@@ -247,18 +278,12 @@ class ServeController:
             self.delete_application(app)
         return True
 
-    def _autoscale(self, d: dict, alive):
+    def _autoscale(self, d: dict, alive, depths):
         """Queue-depth autoscaling (parity: serve autoscaling_policy.py):
         desired = clamp(ceil(total_ongoing / target), min, max), where
         total_ongoing is the replicas' queued+running depth."""
         cfg = d["spec"].get("autoscaling_config")
-        if not cfg or not alive:
-            return alive
-        try:
-            depths = ray_tpu.get(
-                [r.num_ongoing.remote() for r in alive], timeout=10
-            )
-        except Exception:
+        if not cfg or not alive or depths is None:
             return alive
         total = sum(depths)
         target = float(cfg.get("target_ongoing_requests", 2.0))
@@ -324,7 +349,25 @@ class ServeController:
                         alive.append(r)
                     except Exception:
                         pass
-                alive = self._autoscale(d, alive)
+                # probe queue depths once per pass: feeds both autoscaling
+                # and the handles' probed pow-2 routing (via get_handle_info)
+                depths = None
+                try:
+                    depths = ray_tpu.get(
+                        [r.num_ongoing.remote() for r in alive], timeout=10
+                    )
+                except Exception:
+                    pass
+                # keyed by replica id: stays correct across drains/refreshes
+                d["depths"] = (
+                    {
+                        r._actor_id.hex(): depth
+                        for r, depth in zip(alive, depths)
+                    }
+                    if depths is not None
+                    else None
+                )
+                alive = self._autoscale(d, alive, depths)
                 want = d["spec"]["num_replicas"]
                 fresh = []
                 if len(alive) < want:
@@ -422,7 +465,7 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     info = ray_tpu.get(controller.get_handle_info.remote(name), timeout=60)
     if info is None:
         raise ValueError(f"no serve application named '{name}'")
-    dep_name, replicas = info
+    dep_name, replicas = info[0], info[1]
     return DeploymentHandle(dep_name, name, replicas)
 
 
@@ -433,7 +476,7 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
     )
     if info is None:
         raise ValueError(f"no deployment '{deployment_name}' in app '{app_name}'")
-    dep_name, replicas = info
+    dep_name, replicas = info[0], info[1]
     return DeploymentHandle(dep_name, app_name, replicas)
 
 

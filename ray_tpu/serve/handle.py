@@ -97,6 +97,10 @@ class DeploymentHandle:
         self.app_name = app_name
         self._replicas = list(replicas)
         self._outstanding: Dict[int, int] = {i: 0 for i in range(len(replicas))}
+        # controller-probed queue depths by replica id (staleness <= the
+        # reconcile period): lets pow-2 see load from OTHER handles too,
+        # parity with the replica probes of pow_2_scheduler.py:49
+        self._probed_depths: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._stream = stream
         self._model_id = multiplexed_model_id
@@ -130,6 +134,9 @@ class DeploymentHandle:
                 cur_ids = [r._actor_id for r in self._replicas]
                 if new_ids != cur_ids:
                     self._update_replicas(info[1])
+                if len(info) > 2 and info[2]:
+                    with self._lock:
+                        self._probed_depths = dict(info[2])
         except Exception:
             pass
 
@@ -150,7 +157,16 @@ class DeploymentHandle:
                 idx = 0
             else:
                 i, j = random.sample(range(n), 2)
-                idx = i if self._outstanding[i] <= self._outstanding[j] else j
+
+                def score(k: int) -> int:
+                    # local in-flight plus the controller-probed global queue
+                    # depth (load from other handles/proxies)
+                    rid = self._replicas[k]._actor_id.hex()
+                    return self._outstanding.get(k, 0) + self._probed_depths.get(
+                        rid, 0
+                    )
+
+                idx = i if score(i) <= score(j) else j
             if model_id:
                 self._model_affinity[model_id] = idx
             return idx

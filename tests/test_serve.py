@@ -436,3 +436,63 @@ def test_rest_deploy_endpoint(serve_cluster, tmp_path):
         serve.delete("restapp")
     finally:
         stop_dashboard()
+
+
+def test_per_node_proxies():
+    """One HTTP ingress per alive node (parity: ProxyState's proxy-per-node),
+    each serving the registered routes via its own handles."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, x):
+                return {"echo": x}
+
+        serve.run(Echo.bind(), name="pnp", route_prefix="/pnp")
+        proxies = serve.start_node_proxies()
+        assert len(proxies) >= 2  # head + daemon node
+        for nid, (host, port) in proxies.items():
+            req = urllib.request.Request(
+                f"http://{host}:{port}/pnp",
+                data=_json.dumps(5).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = _json.loads(resp.read())
+            assert body["result"] == {"echo": 5}, (nid, body)
+        serve.delete("pnp")
+    finally:
+        cluster.shutdown()
+
+
+def test_probed_queue_depths_reach_handles(serve_cluster):
+    """The controller's reconcile loop probes replica queue depths and
+    handles fold them into pow-2 scoring (pow_2_scheduler.py:49 parity)."""
+    import time as _time
+
+    @serve.deployment(num_replicas=2)
+    class Slowish:
+        def __call__(self, x):
+            return x
+
+    serve.run(Slowish.bind(), name="probed")
+    handle = serve.get_app_handle("probed")
+    assert handle.remote(1).result(timeout_s=60) == 1
+    # wait past a reconcile pass, then force a refresh and check depths came
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        handle._last_refresh = 0.0
+        handle.remote(2).result(timeout_s=60)
+        if handle._probed_depths:
+            break
+        _time.sleep(0.5)
+    assert handle._probed_depths, "controller depths never reached the handle"
+    serve.delete("probed")
