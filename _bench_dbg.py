@@ -1,0 +1,198 @@
+"""Core runtime microbenchmarks, mirrored from the reference's harness.
+
+Parity: ``python/ray/_private/ray_perf.py:93`` — same workload shapes, same
+metric names where applicable, so numbers are directly comparable with
+BASELINE.md's core table (reference values from
+``release/release_logs/2.9.3/microbenchmark.json``, m4.16xlarge/64 vCPU).
+
+Run: python bench_core.py [--quick]
+Prints one JSON line per metric: {"metric", "value", "unit", "reference", "ratio"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+# reference numbers (BASELINE.md core table)
+REFERENCE = {
+    "single_client_get_calls": 10_182.0,
+    "single_client_put_calls": 5_545.0,
+    "single_client_put_gigabytes": 20.9,
+    "single_client_tasks_sync": 1_007.0,
+    "single_client_tasks_async": 8_444.0,
+    "actor_calls_1_1_sync": 2_033.0,
+    "actor_calls_1_1_async": 8_886.0,
+    "actor_calls_n_n_async": 27_667.0,
+}
+
+
+def timeit(name, fn, multiplier=1, duration=2.0, warmup=0.25):
+    """ray_perf-style: run fn repeatedly for ~duration, report ops/s."""
+    start = time.perf_counter()
+    while time.perf_counter() - start < warmup:
+        fn()
+    count = 0
+    start = time.perf_counter()
+    while True:
+        fn()
+        count += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= duration:
+            break
+    return name, count * multiplier / elapsed
+
+
+def report(name, value, unit="ops/s"):
+    ref = REFERENCE.get(name)
+    row = {
+        "metric": name,
+        "value": round(value, 1),
+        "unit": unit,
+        "reference": ref,
+        "ratio": round(value / ref, 3) if ref else None,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+def _noop_arg(x):
+    return None
+
+
+@ray_tpu.remote
+class _Actor:
+    def noop(self):
+        return None
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="shorter windows")
+    parser.add_argument("--num-cpus", type=int, default=8)
+    args = parser.parse_args()
+    duration = 0.6 if args.quick else 2.0
+
+    ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+    rows = []
+
+    # warm the worker pool so spawn latency isn't measured
+    ray_tpu.get([_noop.remote() for _ in range(20)], timeout=60)
+
+    # --- puts / gets (plasma path: value large enough to hit the store) ---
+    small = np.zeros(16 * 1024 // 8)  # 16 KiB, forced out of inline path? no:
+    # inline limit is 100 KiB; use 200 KiB so puts exercise the shm store
+    arr = np.zeros(200 * 1024 // 8)
+
+    name, v = timeit(
+        "single_client_put_calls", lambda: ray_tpu.put(arr), duration=duration
+    )
+    rows.append(report(name, v))
+
+    ref = ray_tpu.put(arr)
+    name, v = timeit(
+        "single_client_get_calls",
+        lambda: ray_tpu.get(ref, timeout=60),
+        duration=duration,
+    )
+    rows.append(report(name, v))
+
+    big = np.zeros(1024 * 1024 * 128 // 8)  # 128 MiB of float64
+    gib = big.nbytes / 1024**3
+
+    def put_big():
+        r = ray_tpu.put(big)
+        del r
+
+    name, v = timeit(
+        "single_client_put_gigabytes", put_big, multiplier=gib, duration=duration
+    )
+    rows.append(report(name, v, unit="GiB/s"))
+
+    # --- tasks ---
+    name, v = timeit(
+        "single_client_tasks_sync",
+        lambda: ray_tpu.get(_noop.remote(), timeout=60),
+        duration=duration,
+    )
+    rows.append(report(name, v))
+    if v < 200:
+        from ray_tpu._private.profiling import format_thread_stacks
+        from ray_tpu._private.worker import get_driver
+        import time as _t
+        print("=== SLOW SYNC DETECTED; stacks:", flush=True)
+        _t0 = _t.perf_counter()
+        ray_tpu.get(_noop.remote(), timeout=60)
+        print(f"(one more sync: {(_t.perf_counter()-_t0)*1000:.1f} ms)", flush=True)
+        print(format_thread_stacks(), flush=True)
+        print("EVENT STATS:", get_driver().rpc("event_stats"), flush=True)
+
+    def tasks_async():
+        ray_tpu.get([_noop.remote() for _ in range(100)], timeout=120)
+
+    name, v = timeit(
+        "single_client_tasks_async", tasks_async, multiplier=100, duration=duration
+    )
+    rows.append(report(name, v))
+
+    # --- actor calls ---
+    a = _Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    name, v = timeit(
+        "actor_calls_1_1_sync",
+        lambda: ray_tpu.get(a.noop.remote(), timeout=60),
+        duration=duration,
+    )
+    rows.append(report(name, v))
+
+    def actor_async():
+        ray_tpu.get([a.noop.remote() for _ in range(100)], timeout=120)
+
+    name, v = timeit(
+        "actor_calls_1_1_async", actor_async, multiplier=100, duration=duration
+    )
+    rows.append(report(name, v))
+
+    n = 4
+    actors = [_Actor.remote() for _ in range(n)]
+    ray_tpu.get([b.noop.remote() for b in actors], timeout=60)
+
+    def actors_nn():
+        refs = []
+        for b in actors:
+            refs.extend(b.noop.remote() for _ in range(25))
+        ray_tpu.get(refs, timeout=120)
+
+    name, v = timeit(
+        "actor_calls_n_n_async", actors_nn, multiplier=25 * n, duration=duration
+    )
+    rows.append(report(name, v))
+
+    geo = 1.0
+    cnt = 0
+    for r in rows:
+        if r["ratio"]:
+            geo *= r["ratio"]
+            cnt += 1
+    summary = {
+        "metric": "core_microbench_geomean_vs_reference",
+        "value": round(geo ** (1 / cnt), 3) if cnt else None,
+        "unit": "x",
+    }
+    print(json.dumps(summary), flush=True)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

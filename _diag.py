@@ -1,0 +1,54 @@
+def main():
+    import time
+    import numpy as np
+    import ray_tpu
+    from ray_tpu.util import state as st
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(20)], timeout=60)
+    arr = np.zeros(200 * 1024 // 8)
+
+    def phase_puts(dur):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur:
+            ray_tpu.put(arr)
+
+    def phase_getcalls(dur):
+        ref = ray_tpu.put(arr)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur:
+            ray_tpu.get(ref, timeout=60)
+
+    big = np.zeros(1024 * 1024 * 128 // 8)
+
+    def phase_bigputs(dur):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur:
+            r = ray_tpu.put(big)
+            del r
+
+    def sync_probe(tag):
+        workers = st.list_workers()
+        states = {}
+        for w in workers:
+            states[w["state"]] = states.get(w["state"], 0) + 1
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < 1.0:
+            ray_tpu.get(f.remote(), timeout=60)
+            n += 1
+        print(f"{tag}: {n}/s  workers={states}", flush=True)
+
+    sync_probe("baseline")
+    phase_puts(2.0); sync_probe("after put_calls(2s)")
+    phase_getcalls(2.0); sync_probe("after get_calls(2s)")
+    phase_bigputs(2.0); sync_probe("after big_puts(2s)")
+    ray_tpu.shutdown()
+
+if __name__ == "__main__":
+    main()
