@@ -381,6 +381,12 @@ int rt_store_delete(void* handle, const uint8_t* id) {
   LockGuard g(&s->hdr->mutex);
   Entry* e = find_slot(s, id, false);
   if (!e || e->state == kTombstone || e->state == kEmpty) return -1;
+  if (e->state == kCreating && pid_alive(e->owner_pid)) {
+    // an unsealed object is deletable only once its creator has died (the
+    // orphan-reclaim path); freeing the block while the creator is alive
+    // would race its in-progress payload write
+    return -1;
+  }
   if (e->pins > 0) {
     e->pending_delete = 1;  // deferred until readers release
     return 0;

@@ -1,0 +1,130 @@
+// Chaos driver for the shm-arena object store, built under TSAN/ASAN
+// (parity: the reference's sanitizer CI configs, .bazelrc asan/tsan).
+//
+// Usage: store_chaos <arena_path> <threads> <iters>
+//
+// The main thread initializes the arena; worker threads then each open their
+// own Store handle over the same mapping (exactly what concurrent worker
+// processes do) and hammer create/seal/get/verify/release/delete, including
+// deliberate id collisions so the exists/tombstone and deferred-delete paths
+// race. Exit code 0 + empty sanitizer report = pass.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <pthread.h>
+#include <unistd.h>
+#include <vector>
+
+#include "rt_store.h"
+
+namespace {
+
+constexpr uint32_t kIdSize = 28;
+
+struct WorkerArgs {
+  const char* path;
+  int tid;
+  int iters;
+  int shared_ids;  // collision space size across threads
+};
+
+void make_id(uint8_t* id, uint64_t key) {
+  memset(id, 0, kIdSize);
+  memcpy(id, &key, sizeof(key));
+  id[kIdSize - 1] = 0x7f;  // non-zero tail so ids never look "empty"
+}
+
+void* worker(void* argp) {
+  WorkerArgs* a = static_cast<WorkerArgs*>(argp);
+  void* h = rt_store_open(a->path, 0, 0, 0);
+  if (!h) {
+    fprintf(stderr, "worker %d: open failed\n", a->tid);
+    return (void*)1;
+  }
+  uint64_t rng = 0x9e3779b97f4a7c15ULL * (a->tid + 1);
+  uint64_t failures = 0;
+  for (int i = 0; i < a->iters; i++) {
+    rng = rng * 6364136223846793005ULL + 1442695040888963407ULL;
+    // half the keys are shared across threads to force collisions
+    uint64_t key = (rng & 1) ? (rng >> 1) % a->shared_ids
+                             : ((uint64_t)a->tid << 32) | i;
+    uint8_t id[kIdSize];
+    make_id(id, key);
+    uint64_t size = 64 + (rng % 4096);
+    int err = 0;
+    uint64_t off = rt_store_create(h, id, size, &err);
+    bool sealed_by_me = false;
+    if (off) {
+      uint8_t* base = static_cast<uint8_t*>(rt_store_base(h));
+      memset(base + off, (int)(key & 0xff), size);
+      if (rt_store_seal(h, id) != 0) failures++;
+      sealed_by_me = true;
+    } else if (err == 0) {
+      failures++;  // create failed with no error code
+    }
+    uint64_t got_size = 0;
+    uint64_t got = rt_store_get(h, id, &got_size);
+    if (got) {
+      uint8_t* base = static_cast<uint8_t*>(rt_store_base(h));
+      // verify first/last byte under the pin, then release
+      if (base[got] != (uint8_t)(key & 0xff) ||
+          base[got + got_size - 1] != (uint8_t)(key & 0xff)) {
+        // a collision-winner from another thread wrote a different key with
+        // the same id only if keys differ — same id => same key => same fill,
+        // so any mismatch is a real torn read
+        failures++;
+      }
+      rt_store_release(h, id);
+    }
+    // delete only objects known sealed: the store forbids (and we must not
+    // attempt) freeing a block another thread is still filling
+    if ((rng >> 8) % 3 == 0 && (sealed_by_me || rt_store_contains(h, id)))
+      rt_store_delete(h, id);
+    if ((rng >> 16) % 64 == 0) {
+      uint8_t vid[kIdSize];
+      if (rt_store_lru_victim(h, vid)) rt_store_delete(h, vid);
+    }
+  }
+  rt_store_close(h);
+  return (void*)(uintptr_t)failures;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <arena_path> <threads> <iters>\n", argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  int nthreads = atoi(argv[2]);
+  int iters = atoi(argv[3]);
+  unlink(path);
+  void* h = rt_store_open(path, 64ull << 20, 8192, 1);
+  if (!h) {
+    fprintf(stderr, "init open failed\n");
+    return 2;
+  }
+  std::vector<pthread_t> tids(nthreads);
+  std::vector<WorkerArgs> args(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    args[t] = WorkerArgs{path, t, iters, 97};
+    pthread_create(&tids[t], nullptr, worker, &args[t]);
+  }
+  uint64_t failures = 0;
+  for (int t = 0; t < nthreads; t++) {
+    void* ret = nullptr;
+    pthread_join(tids[t], &ret);
+    failures += (uintptr_t)ret;
+  }
+  rt_store_close(h);
+  unlink(path);
+  if (failures) {
+    fprintf(stderr, "chaos failures: %llu\n", (unsigned long long)failures);
+    return 1;
+  }
+  printf("ok\n");
+  return 0;
+}

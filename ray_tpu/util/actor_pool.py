@@ -1,66 +1,75 @@
 """ActorPool: map work over a fixed set of actors.
 
-Parity: ``python/ray/util/actor_pool.py``.
+Parity: ``python/ray/util/actor_pool.py`` (API surface only; the
+bookkeeping here is sequence-number based rather than index/future maps).
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Iterable, List
 
 import ray_tpu
 
 
 class ActorPool:
+    """Round-robins ``fn(actor, value)`` calls over a fixed actor fleet.
+
+    Internally each submission gets a monotonically increasing sequence
+    number; ``get_next`` emits results in sequence order while
+    ``get_next_unordered`` emits whichever future lands first.
+    """
+
     def __init__(self, actors: List[Any]):
-        self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits = []
+        self._available = deque(actors)
+        # seq -> future, and future -> (seq, actor) for the reverse hop.
+        self._by_seq: dict = {}
+        self._inflight: dict = {}
+        self._submit_seq = 0
+        self._emit_seq = 0
+        self._backlog: deque = deque()
 
     def submit(self, fn: Callable, value: Any) -> None:
-        if self._idle:
-            actor = self._idle.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+        if not self._available:
+            self._backlog.append((fn, value))
+            return
+        actor = self._available.pop()
+        future = fn(actor, value)
+        seq = self._submit_seq
+        self._submit_seq += 1
+        self._by_seq[seq] = future
+        self._inflight[future] = (seq, actor)
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._by_seq) or bool(self._backlog)
 
     def get_next(self, timeout=None) -> Any:
-        if self._next_return_index not in self._index_to_future:
+        future = self._by_seq.pop(self._emit_seq, None)
+        if future is None:
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
+        self._emit_seq += 1
         value = ray_tpu.get(future, timeout=timeout)
-        self._return_actor(future)
+        self._recycle(future)
         return value
 
     def get_next_unordered(self, timeout=None) -> Any:
-        if not self._future_to_actor:
+        if not self._inflight:
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout
-        )
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         future = ready[0]
-        idx, _ = self._future_to_actor[future]
-        self._index_to_future.pop(idx, None)
+        seq, _actor = self._inflight[future]
+        self._by_seq.pop(seq, None)
         value = ray_tpu.get(future)
-        self._return_actor(future)
+        self._recycle(future)
         return value
 
-    def _return_actor(self, future):
-        _, actor = self._future_to_actor.pop(future)
-        self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+    def _recycle(self, future):
+        _seq, actor = self._inflight.pop(future)
+        self._available.append(actor)
+        if self._backlog:
+            fn, value = self._backlog.popleft()
             self.submit(fn, value)
 
     def map(self, fn: Callable, values: Iterable[Any]):
@@ -72,14 +81,14 @@ class ActorPool:
     def map_unordered(self, fn: Callable, values: Iterable[Any]):
         for v in values:
             self.submit(fn, v)
-        while self._future_to_actor or self._pending_submits:
+        while self._inflight or self._backlog:
             yield self.get_next_unordered()
 
     def has_free(self) -> bool:
-        return bool(self._idle)
+        return bool(self._available)
 
     def pop_idle(self):
-        return self._idle.pop() if self._idle else None
+        return self._available.pop() if self._available else None
 
     def push(self, actor):
-        self._idle.append(actor)
+        self._available.append(actor)

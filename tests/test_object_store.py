@@ -159,3 +159,35 @@ def test_native_store_lru_eviction(tmp_path):
     assert store.contains(oids[-1])  # survived: it was pinned
     store.release(oids[-1])
     store.close()
+
+
+@pytest.mark.parametrize("variant", ["tsan", "asan"])
+def test_store_chaos_sanitized(variant, tmp_path):
+    """Build the store chaos driver under TSAN/ASAN and hammer the arena
+    from 4 threads (parity: reference .bazelrc sanitizer CI configs)."""
+    import subprocess
+
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ray_tpu",
+        "native",
+    )
+    build = subprocess.run(
+        ["make", "-s", variant], cwd=native_dir, capture_output=True, timeout=180
+    )
+    if build.returncode != 0:
+        err = build.stderr.decode()
+        # only a genuinely absent sanitizer runtime is a skip; an ordinary
+        # compile error must fail the test, not mask the coverage
+        if "unsupported option" in err or "ltsan" in err or "lasan" in err:
+            pytest.skip(f"{variant} toolchain unavailable: {err[-200:]}")
+        pytest.fail(f"sanitizer build failed:\n{err[-2000:]}")
+    arena = str(tmp_path / f"chaos_{variant}")
+    run = subprocess.run(
+        [os.path.join(native_dir, f"store_chaos_{variant}"), arena, "4", "2000"],
+        capture_output=True,
+        timeout=300,
+    )
+    assert run.returncode == 0, run.stderr.decode()[-2000:]
+    assert b"WARNING: ThreadSanitizer" not in run.stderr
+    assert b"ERROR: AddressSanitizer" not in run.stderr
