@@ -31,6 +31,9 @@ class RemoteDriverRuntime(WorkerRuntime):
             address = (host, int(port))
         key = auth_key.encode() if isinstance(auth_key, str) else auth_key
         conn = Client(tuple(address), authkey=key)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(conn)
         conn.send(("register_driver", os.getpid()))
         kind, info = conn.recv()
         assert kind == "driver_registered", kind

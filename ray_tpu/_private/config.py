@@ -46,6 +46,11 @@ class Config:
     scheduler_top_k_fraction: float = 0.2  # hybrid policy top-k (ref: hybrid_scheduling_policy.cc:99)
     worker_startup_timeout_s: float = 60.0
     max_pending_lease_requests_per_scheduling_category: int = 10
+    # tasks queued (beyond running capacity) at each node daemon's local
+    # dispatcher, so a completion starts the next task without a head
+    # round-trip (parity: the raylet's local task queue,
+    # local_task_manager.cc:74)
+    lease_backlog_cap: int = 64
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 = num_cpus
     worker_idle_timeout_s: float = 300.0

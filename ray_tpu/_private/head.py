@@ -94,6 +94,9 @@ class HeadServer:
             ).start()
 
     def _handshake(self, conn):
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(conn)
         try:
             msg = conn.recv()
         except (EOFError, OSError):

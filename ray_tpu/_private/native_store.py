@@ -132,6 +132,16 @@ class NativeStoreClient(StorePutMixin):
         else:
             self._fallback.seal(oid)
 
+    def abort(self, oid: ObjectID) -> bool:
+        """Drop an unsealed object this client created (plasma Abort)."""
+        with self._lock:
+            in_arena = self._creating.pop(oid, None)
+        if in_arena is None:
+            return False
+        if in_arena:
+            return self._lib.rt_store_abort(self._h, oid.binary()) == 0
+        return self._fallback.abort(oid)
+
     def contains(self, oid: ObjectID) -> bool:
         if self._lib.rt_store_contains(self._h, oid.binary()):
             return True

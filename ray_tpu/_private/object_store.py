@@ -203,6 +203,30 @@ class ObjectStoreClient(StorePutMixin):
         with self._lock:
             self._maps[oid] = (m, mv, False)
 
+    def abort(self, oid: ObjectID) -> bool:
+        """Drop an object this client created but will never seal (parity:
+        plasma Abort) — a failed transfer must not leave a .building file
+        that blocks every future create of the same deterministic id."""
+        with self._lock:
+            entry = self._maps.get(oid)
+            if entry is None or not entry[2]:
+                return False  # not ours, or already sealed
+            del self._maps[oid]
+        m, mv, _ = entry
+        fallback = int.from_bytes(mv[8:16], "little") == 1
+        try:
+            mv.release()  # our own cached view would otherwise pin the map
+            m.close()
+        except (BufferError, ValueError):
+            # a handed-out create() view is still alive; the unmap defers to
+            # its GC — the file still goes away below
+            pass
+        try:
+            os.unlink(self._path(oid, False, fallback))
+        except FileNotFoundError:
+            pass
+        return True
+
     def contains(self, oid: ObjectID) -> bool:
         return self._find_sealed(oid) is not None
 
@@ -244,14 +268,17 @@ class ObjectStoreClient(StorePutMixin):
         with self._lock:
             entry = self._maps.pop(oid, None)
         if entry is not None:
-            m, mv, _ = entry
+            m, mv, writable = entry
             try:
                 mv.release()
                 m.close()
             except BufferError:
-                # live numpy views still reference it; re-register so it is not lost
+                # live views (slices handed to concurrent readers, numpy
+                # frombuffer) still reference the map. mv itself may already
+                # be released, so re-register a FRESH view — caching the dead
+                # one made the next get() blow up with "released memoryview"
                 with self._lock:
-                    self._maps[oid] = entry
+                    self._maps[oid] = (m, memoryview(m), writable)
 
     def delete(self, oid: ObjectID) -> None:
         self.release(oid)

@@ -12,6 +12,7 @@ client streams the sealed blob in chunks over a socket
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from multiprocessing.connection import Client, Listener
 from typing import Optional
@@ -23,6 +24,29 @@ logger = logging.getLogger(__name__)
 # one chunk per framed message: big enough to amortize framing, small enough
 # to avoid giant single allocations on both sides
 CHUNK_BYTES = 8 * 1024 * 1024
+
+
+def set_nodelay(conn) -> None:
+    """Disable Nagle on an mp.connection TCP socket.
+
+    Every control/object socket in the cluster frames small messages
+    (mp.connection writes a length header then the body); with Nagle on,
+    those interact with delayed ACKs into 40ms stalls per exchange. The
+    reference's gRPC channels set TCP_NODELAY by default; do the same.
+    Unix-domain/pipe connections have no fileno-level TCP and are skipped.
+    """
+    import socket
+
+    try:
+        s = socket.socket(fileno=os.dup(conn.fileno()))
+    except (OSError, ValueError):
+        return
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not a TCP socket
+    finally:
+        s.close()
 
 
 class ObjectServer:
@@ -54,6 +78,7 @@ class ObjectServer:
                 if self._stop:
                     return
                 continue
+            set_nodelay(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -62,7 +87,7 @@ class ObjectServer:
         try:
             while True:
                 msg = conn.recv()
-                if msg[0] != "get":
+                if msg[0] not in ("get", "get_range"):
                     conn.send(("err", "bad request"))
                     continue
                 oid = ObjectID(msg[1])
@@ -79,8 +104,15 @@ class ObjectServer:
                 try:
                     size = mv.nbytes
                     conn.send(("size", size))
-                    for off in range(0, size, CHUNK_BYTES):
-                        conn.send_bytes(mv[off : off + CHUNK_BYTES])
+                    if msg[0] == "get_range":
+                        # one stripe of a multi-stream fetch (parity: chunked
+                        # concurrent transfer, push_manager.h:30)
+                        start = max(0, int(msg[2]))
+                        end = min(size, start + int(msg[3]))
+                    else:
+                        start, end = 0, size
+                    for off in range(start, end, CHUNK_BYTES):
+                        conn.send_bytes(mv[off : min(off + CHUNK_BYTES, end)])
                 finally:
                     store.release(oid)
         except (EOFError, OSError, BrokenPipeError):
@@ -99,25 +131,131 @@ class ObjectServer:
             pass
 
 
-def fetch_object_bytes(addr, oid: ObjectID, auth_key) -> Optional[bytearray]:
-    """Pull one sealed object's flat blob from a peer's object server."""
-    key = auth_key.encode() if isinstance(auth_key, str) else auth_key
+# multi-stream fetch: objects above this size split into up to
+# MAX_FETCH_STREAMS concurrent range requests (each on its own socket);
+# below it a single stream wins (dial cost dominates)
+STRIPE_THRESHOLD = 32 * 1024 * 1024
+MAX_FETCH_STREAMS = 4
+
+
+def _dial(addr, key):
     conn = Client(tuple(addr) if isinstance(addr, (list, tuple)) else addr, authkey=key)
+    set_nodelay(conn)
+    return conn
+
+
+def _recv_range(conn, view, start: int, end: int) -> None:
+    off = start
+    while off < end:
+        off += conn.recv_bytes_into(view[off:end])
+
+
+def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]:
+    """Pull one sealed object from a peer directly into a caller-provided
+    buffer (``make_dest(size) -> memoryview``), striping large objects over
+    several concurrent sockets.
+
+    Writing straight into the destination store's create() buffer removes
+    the staging copy the old bytearray path paid (parity: the reference
+    receives chunks into plasma-allocated buffers,
+    object_buffer_pool.h:41). Returns the object size, or None if missing.
+    """
+    key = auth_key.encode() if isinstance(auth_key, str) else auth_key
+    conn = _dial(addr, key)
     try:
-        conn.send(("get", oid.binary()))
+        conn.send(("get_range", oid.binary(), 0, STRIPE_THRESHOLD))
         head = conn.recv()
         if head[0] != "size":
             return None
         size = head[1]
-        out = bytearray(size)
-        view = memoryview(out)
-        off = 0
-        while off < size:
-            n = conn.recv_bytes_into(view[off:])
-            off += n
-        return out
+        view = make_dest(size)
+        if view is None:
+            return None
+        first_end = min(size, STRIPE_THRESHOLD)
+        _recv_range(conn, view, 0, first_end)
+        rest = size - first_end
+        if rest > 0:
+            streams = min(MAX_FETCH_STREAMS, max(1, rest // STRIPE_THRESHOLD + 1))
+            stripe = -(-rest // streams)  # ceil
+            errors: list = []
+
+            def pull(lo: int, hi: int) -> None:
+                try:
+                    c2 = _dial(addr, key)
+                    try:
+                        c2.send(("get_range", oid.binary(), lo, hi - lo))
+                        h2 = c2.recv()
+                        if h2[0] != "size":
+                            raise OSError("stripe source lost the object")
+                        _recv_range(c2, view, lo, hi)
+                    finally:
+                        c2.close()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = []
+            lo = first_end
+            while lo < size:
+                hi = min(size, lo + stripe)
+                t = threading.Thread(target=pull, args=(lo, hi), daemon=True)
+                t.start()
+                threads.append(t)
+                lo = hi
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        return size
     finally:
         try:
             conn.close()
         except OSError:
             pass
+
+
+def fetch_object_bytes(addr, oid: ObjectID, auth_key) -> Optional[bytearray]:
+    """Pull one sealed object's flat blob from a peer's object server."""
+    out: dict = {}
+
+    def make_dest(size: int):
+        out["buf"] = bytearray(size)
+        return memoryview(out["buf"])
+
+    if fetch_object_into(addr, oid, auth_key, make_dest) is None:
+        return None
+    return out["buf"]
+
+
+def fetch_into_local_store(store, addr, oid: ObjectID, auth_key) -> bool:
+    """Pull ``oid`` from a peer straight into ``store``: stripes land in the
+    create()d buffer (no staging copy), sealed on completion, aborted on
+    failure (parity: chunks received into plasma-allocated buffers,
+    object_buffer_pool.h:41). Returns True when a local sealed copy exists
+    afterwards (including via a concurrent fetch winning the create race).
+    """
+    if store.contains(oid):
+        return True
+    created = False
+    try:
+
+        def make_dest(size: int):
+            nonlocal created
+            try:
+                view = store.create(oid, size)
+                created = True
+                return view
+            except ValueError:
+                return None  # a concurrent fetch owns it
+
+        n = fetch_object_into(addr, oid, auth_key, make_dest)
+        if n is not None and created:
+            store.seal(oid)
+            created = False
+            return True
+        return store.contains(oid)  # the concurrent fetch finished (or not)
+    finally:
+        if created:
+            try:
+                store.abort(oid)
+            except Exception:
+                pass

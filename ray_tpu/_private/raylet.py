@@ -1,12 +1,15 @@
 """Node daemon: the per-node process of the multi-host runtime.
 
-Design parity: the raylet (``src/ray/raylet/raylet.h:35``) reduced to its
-node-plane duties — worker pool hosting (``worker_pool.h:83``), local object
-store ownership (plasma runs inside the raylet, ``store_runner.h:14``), and
-the node half of inter-node object transfer (``object_manager.h:117``).
-Scheduling decisions stay at the head (the reference's ScheduleByGcs mode);
-this process relays its workers' pipe traffic over one socket to the head,
-spawns/kills workers on command, heartbeats, and serves/fetches objects.
+Design parity: the raylet (``src/ray/raylet/raylet.h:35``) — worker pool
+hosting (``worker_pool.h:83``), local object store ownership (plasma runs
+inside the raylet, ``store_runner.h:14``), the node half of inter-node object
+transfer (``object_manager.h:117``), and **node-local task dispatch**
+(``local_task_manager.cc:74``): the head does *placement* and leases blocks
+of normal tasks to this daemon; the daemon owns a local worker pool and a
+local resource ledger, dispatches queued tasks the moment a worker frees
+(no head round-trip between tasks), and reports completions in batches.
+Actor workers remain head-managed: their pipe traffic is relayed over the
+daemon socket as before.
 
 Runs standalone:  python -m ray_tpu._private.raylet --address HOST:PORT \
     --auth-key-env RAY_TPU_AUTH --num-cpus 4
@@ -15,6 +18,7 @@ Runs standalone:  python -m ray_tpu._private.raylet --address HOST:PORT \
 from __future__ import annotations
 
 import argparse
+import collections
 import logging
 import os
 import pickle
@@ -47,6 +51,9 @@ class NodeDaemon:
         self.auth_key = auth_key
         self._head_addr = tuple(head_addr)
         self.conn = Client(self._head_addr, authkey=auth_key)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(self.conn)
         self._send_lock = threading.Lock()
 
         total: Dict[str, float] = {"CPU": float(num_cpus)}
@@ -84,6 +91,27 @@ class NodeDaemon:
         self.workers: Dict[WorkerID, tuple] = {}
         self._pipe_to_wid: Dict[object, WorkerID] = {}
         self._stop = False
+
+        # ---- local task dispatcher (parity: LocalTaskManager) ----
+        # head-leased normal tasks queue here and run on a daemon-owned
+        # worker pool, gated by a local resource ledger
+        self._lease_queue: collections.deque = collections.deque()
+        self._lease_wids: set = set()  # workers owned by the local dispatcher
+        self._lease_idle: collections.deque = collections.deque()
+        # wid -> {"spec": TaskSpec, "charged": bool} while executing
+        self._lease_running: Dict[WorkerID, dict] = {}
+        self._lease_blocked: set = set()
+        self._lease_starting = 0
+        # head-granted budget: total resources minus head-managed (actor/PG)
+        # usage on this node; the local ledger schedules against it
+        self._lease_budget: Dict[str, float] = dict(self._total_resources)
+        self._lease_in_use: Dict[str, float] = {}
+        self._lease_done_buf: list = []
+        self._lease_started_buf: list = []
+        self._lease_idle_since: Dict[WorkerID, float] = {}
+        cpu_total = self._total_resources.get("CPU", 1.0)
+        self._lease_worker_cap = max(4, int(2 * cpu_total))
+        self._lease_last_reap = time.monotonic()
 
     def _register(self, conn=None, timeout: float = 30.0):
         """Announce this node to the (possibly restarted) head.
@@ -127,6 +155,19 @@ class NodeDaemon:
                 except Exception:
                     pass
         self._pipe_to_wid.clear()
+        # local dispatcher state dies with the workers; the head requeues
+        # this node's leased tasks when the re-registration lands
+        self._lease_queue.clear()
+        self._lease_wids.clear()
+        self._lease_idle.clear()
+        self._lease_running.clear()
+        self._lease_blocked.clear()
+        self._lease_starting = 0
+        self._lease_in_use.clear()
+        self._lease_done_buf.clear()
+        self._lease_started_buf.clear()
+        self._lease_idle_since.clear()
+        self._lease_budget = dict(self._total_resources)
         deadline = time.monotonic() + float(
             getattr(self.config, "daemon_reconnect_timeout_s", 60.0)
         )
@@ -140,6 +181,9 @@ class NodeDaemon:
                 # the OS default (~2 min), blowing the reconnect budget
                 _socket.create_connection(self._head_addr, timeout=5).close()
                 conn = Client(self._head_addr, authkey=self.auth_key)
+                from ray_tpu._private.object_transfer import set_nodelay
+
+                set_nodelay(conn)
                 # register on the fresh conn FIRST: installing it before the
                 # handshake would let the heartbeat thread race a beat in as
                 # the first message, which the head rejects
@@ -189,6 +233,12 @@ class NodeDaemon:
     def run(self):
         self._loop_tick = time.monotonic()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        if getattr(self.config, "prestart_workers", False):
+            # warm one dispatcher worker while the cluster is still
+            # assembling: the first leased task then starts instantly
+            # instead of paying the python import storm (parity: the
+            # reference prestarts idle workers, worker_pool.h:83)
+            self._lease_spawn()
         try:
             while not self._stop:
                 self._loop_tick = time.monotonic()
@@ -203,6 +253,7 @@ class NodeDaemon:
                             return
                     else:
                         self._drain_worker_pipe(r)
+                self._lease_tick()
         finally:
             self._shutdown()
 
@@ -238,6 +289,34 @@ class NodeDaemon:
                     entry[0].terminate()
                 except Exception:
                     pass
+        elif kind == "lease_tasks":
+            # a block of placed normal tasks; FIFO through the local ledger
+            self._lease_queue.extend(msg[1])
+        elif kind == "lease_cancel":
+            self._lease_cancel(msg[1], msg[2])
+        elif kind == "lease_revoke":
+            # head steals back queued (not yet started) tasks to run them on
+            # capacity that freed elsewhere; reply with what was actually
+            # still queued here (races with local dispatch are resolved in
+            # the daemon's favor — a started task stays)
+            wanted = set(msg[1])
+            taken = []
+            if wanted:
+                kept = collections.deque()
+                while self._lease_queue:
+                    spec = self._lease_queue.popleft()
+                    tb = spec.task_id.binary()
+                    if tb in wanted:
+                        taken.append(tb)
+                    else:
+                        kept.append(spec)
+                self._lease_queue = kept
+            try:
+                self._send(("lease_revoked", taken))
+            except (OSError, EOFError):
+                pass
+        elif kind == "lease_budget":
+            self._lease_budget = {k: float(v) for k, v in msg[1].items()}
         elif kind == "fetch_object":
             _, oid_bin, src_addr = msg
             threading.Thread(
@@ -292,10 +371,23 @@ class NodeDaemon:
         wid = self._pipe_to_wid.get(pipe)
         if wid is None:
             return
+        is_lease = wid in self._lease_wids
         try:
             while pipe.poll(0):
                 msg = pipe.recv()
-                self._send(("worker_msg", wid.binary(), msg))
+                if is_lease and msg[0] in (
+                    "ready",
+                    "task_done",
+                    "block_begin",
+                    "block_end",
+                ):
+                    # lifecycle of dispatcher-owned workers is handled HERE —
+                    # that locality is the whole point of lease dispatch;
+                    # everything else (pulls, rpcs, nested submits, ref ops,
+                    # logs) still rides the head relay below
+                    self._lease_worker_msg(wid, msg)
+                else:
+                    self._send(("worker_msg", wid.binary(), msg))
         except (EOFError, OSError):
             self._on_worker_pipe_death(wid)
 
@@ -309,25 +401,185 @@ class NodeDaemon:
             pipe.close()
         except OSError:
             pass
+        if wid in self._lease_wids:
+            self._lease_on_worker_death(wid)
+            return
         try:
             self._send(("worker_died", wid.binary()))
         except (OSError, EOFError):
             pass
 
+    # -- local task dispatch (parity: local_task_manager.cc:74) -----------
+
+    def _lease_avail_for(self, demand: Dict[str, float]) -> bool:
+        for k, v in demand.items():
+            if self._lease_budget.get(k, 0.0) - self._lease_in_use.get(k, 0.0) < v - 1e-9:
+                return False
+        return True
+
+    def _lease_charge(self, demand: Dict[str, float], sign: int) -> None:
+        for k, v in demand.items():
+            self._lease_in_use[k] = self._lease_in_use.get(k, 0.0) + sign * v
+
+    def _lease_tick(self) -> None:
+        """Dispatch queued leased tasks onto local workers, flush completed
+        batches, reap long-idle lease workers. Runs every loop iteration."""
+        # dispatch: FIFO while the local ledger fits the head of the queue
+        # (head-of-line order matches the head's promote bookkeeping)
+        while self._lease_queue:
+            spec = self._lease_queue[0]
+            if not self._lease_avail_for(spec.resources):
+                break
+            if self._lease_idle:
+                wid = self._lease_idle.popleft()
+                entry = self.workers.get(wid)
+                if entry is None:
+                    continue
+                self._lease_queue.popleft()
+                self._lease_charge(spec.resources, +1)
+                self._lease_running[wid] = {"spec": spec, "charged": True}
+                try:
+                    entry[1].send(("exec", spec))
+                    self._lease_started_buf.append(spec.task_id.binary())
+                except (OSError, EOFError, BrokenPipeError):
+                    self._on_worker_pipe_death(wid)
+            else:
+                # no idle worker: spawn only what the queue can actually use
+                # (starting workers already count toward demand — spawning 4
+                # for 1 queued task quadruples the import storm on small
+                # boxes), capped so blocked workers (parked in ray.get) never
+                # wedge dispatch but don't count against the pool either
+                active = len(self._lease_running) - len(self._lease_blocked)
+                if (
+                    self._lease_starting < min(4, len(self._lease_queue))
+                    and active + self._lease_starting < self._lease_worker_cap
+                ):
+                    self._lease_spawn()
+                break
+        # flush start/completion batches: one message each per loop
+        # iteration no matter how many tasks changed state in it
+        if self._lease_started_buf:
+            buf, self._lease_started_buf = self._lease_started_buf, []
+            try:
+                self._send(("lease_started", buf))
+            except (OSError, EOFError):
+                pass
+        if self._lease_done_buf:
+            buf, self._lease_done_buf = self._lease_done_buf, []
+            try:
+                self._send(("lease_done", buf))
+            except (OSError, EOFError):
+                # head link down: main loop will reconnect; completions are
+                # lost with the old head like every other in-flight state
+                pass
+        # reap lease workers idle beyond the timeout (keep one warm)
+        now = time.monotonic()
+        if now - self._lease_last_reap > 1.0:
+            self._lease_last_reap = now
+            timeout_s = getattr(self.config, "worker_idle_timeout_s", 300.0)
+            while len(self._lease_idle) > 1:
+                wid = self._lease_idle[0]
+                entry = self.workers.get(wid)
+                if entry is None:
+                    self._lease_idle.popleft()
+                    self._lease_idle_since.pop(wid, None)
+                    continue
+                idle_at = self._lease_idle_since.get(wid)
+                if idle_at is None or now - idle_at < timeout_s:
+                    break
+                self._lease_idle.popleft()
+                self._lease_idle_since.pop(wid, None)
+                try:
+                    entry[1].send(("exit",))
+                except (OSError, EOFError):
+                    self._on_worker_pipe_death(wid)
+
+    def _lease_spawn(self) -> None:
+        wid = WorkerID.from_random()
+        self._lease_wids.add(wid)
+        self._lease_starting += 1
+        # registration must reach the head BEFORE any relayed traffic from
+        # this worker (same socket => FIFO), so its pulls/rpcs resolve
+        try:
+            self._send(("lease_worker", wid.binary()))
+        except (OSError, EOFError):
+            pass
+        self._spawn_worker(wid)
+
+    def _lease_worker_msg(self, wid: WorkerID, msg) -> None:
+        kind = msg[0]
+        if kind == "ready":
+            self._lease_starting = max(0, self._lease_starting - 1)
+            self._lease_mark_idle(wid)
+        elif kind == "task_done":
+            _, task_id, results = msg
+            info = self._lease_running.pop(wid, None)
+            if info is not None and info["charged"]:
+                self._lease_charge(info["spec"].resources, -1)
+            self._lease_blocked.discard(wid)
+            self._lease_done_buf.append((task_id.binary(), results))
+            self._lease_mark_idle(wid)
+        elif kind == "block_begin":
+            # a worker blocked in get() releases its resources so queued
+            # tasks keep flowing (same oversubscription rule as the head's
+            # blocked-worker handling)
+            info = self._lease_running.get(wid)
+            if info is not None and info["charged"]:
+                info["charged"] = False
+                self._lease_charge(info["spec"].resources, -1)
+            self._lease_blocked.add(wid)
+        elif kind == "block_end":
+            self._lease_blocked.discard(wid)
+
+    def _lease_mark_idle(self, wid: WorkerID) -> None:
+        if wid in self.workers:
+            self._lease_idle.append(wid)
+            self._lease_idle_since[wid] = time.monotonic()
+
+    def _lease_on_worker_death(self, wid: WorkerID) -> None:
+        self._lease_wids.discard(wid)
+        self._lease_blocked.discard(wid)
+        self._lease_idle_since.pop(wid, None)
+        try:
+            self._lease_idle.remove(wid)
+        except ValueError:
+            pass
+        info = self._lease_running.pop(wid, None)
+        if info is not None and info["charged"]:
+            self._lease_charge(info["spec"].resources, -1)
+        tid_bin = info["spec"].task_id.binary() if info is not None else None
+        try:
+            self._send(("lease_worker_gone", wid.binary(), tid_bin))
+        except (OSError, EOFError):
+            pass
+
+    def _lease_cancel(self, tid_bin: bytes, force: bool) -> None:
+        for spec in list(self._lease_queue):
+            if spec.task_id.binary() == tid_bin:
+                try:
+                    self._lease_queue.remove(spec)
+                except ValueError:
+                    pass
+                return
+        if force:
+            for wid, info in list(self._lease_running.items()):
+                if info["spec"].task_id.binary() == tid_bin:
+                    entry = self.workers.get(wid)
+                    if entry is not None and entry[0] is not None:
+                        try:
+                            entry[0].terminate()
+                        except Exception:
+                            pass
+                    return
+
     # -- object plane ------------------------------------------------------
 
     def _fetch_object(self, oid: ObjectID, src_addr):
-        from ray_tpu._private.object_transfer import fetch_object_bytes
+        from ray_tpu._private.object_transfer import fetch_into_local_store
 
         ok = False
         try:
-            if self.store.contains(oid):
-                ok = True
-            else:
-                blob = fetch_object_bytes(src_addr, oid, self.auth_key)
-                if blob is not None:
-                    self.store.put_bytes(oid, blob)
-                    ok = True
+            ok = fetch_into_local_store(self.store, src_addr, oid, self.auth_key)
         except Exception:
             logger.exception("fetch %s failed", oid.hex()[:8])
         try:

@@ -182,6 +182,10 @@ class NodeState:
     daemon_conn: Any = None
     object_addr: Any = None
     last_heartbeat: float = 0.0
+    # resources held by head-leased tasks currently runnable at the node's
+    # local dispatcher (subset of total - available); the node's lease
+    # budget is available + lease_acquired = total - head-managed usage
+    lease_acquired: Dict[str, float] = field(default_factory=dict)
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) >= v for k, v in demand.items())
@@ -427,8 +431,16 @@ class Scheduler:
         # copy (parity: OwnershipBasedObjectDirectory,
         # ownership_based_object_directory.h:37)
         self._object_locations: Dict[ObjectID, Set[NodeID]] = collections.defaultdict(set)
-        # in-flight transfers: (oid, dest node)
-        self._fetching: Set[Tuple[ObjectID, NodeID]] = set()
+        # in-flight transfers: (oid, dest node) -> source node
+        self._fetching: Dict[Tuple[ObjectID, NodeID], NodeID] = {}
+        # per-source in-flight transfer count (admission control; parity:
+        # PushManager's max_chunks_in_flight, push_manager.h:30). Capping
+        # each source and re-sourcing waiters from freshly-landed copies
+        # turns an N-way broadcast into a relay tree instead of N pulls
+        # hammering one server.
+        self._xfer_load: Dict[NodeID, int] = collections.defaultdict(int)
+        # oid -> destinations waiting for a source slot
+        self._xfer_waiting: Dict[ObjectID, Set[NodeID]] = {}
         # head node's own object server address (set by HeadServer)
         self.head_object_addr = None
         self._last_gcs_snapshot = 0.0
@@ -436,6 +448,27 @@ class Scheduler:
         self._dispatch_dirty = True
         self._last_full_dispatch = 0.0
         self._last_reap_scan = 0.0
+        # ---- lease dispatch (parity: task spillback to raylet local
+        # queues — cluster_task_manager.cc:44 hands tasks to
+        # local_task_manager.cc:74; here the head leases blocks of normal
+        # tasks to daemon-local dispatchers) ----
+        # task_id -> (node_id, acquired: bool, demand) for leased tasks
+        self._leased: Dict[TaskID, Tuple[NodeID, bool, Dict[str, float]]] = {}
+        # per-node FIFO of leased-but-not-yet-acquired tasks (the node runs
+        # them when capacity frees; the head mirrors that with promote-on-
+        # completion so its ledger tracks the node's)
+        self._lease_backlog: Dict[NodeID, Deque[TaskID]] = collections.defaultdict(collections.deque)
+        # per-dispatch-pass buffer: node -> [spec]; flushed as one
+        # lease_tasks message per node per pass
+        self._lease_batch: Dict[NodeID, List[TaskSpec]] = {}
+        # last lease budget sent to each daemon (re-sent only on change)
+        self._lease_budget_sent: Dict[NodeID, Dict[str, float]] = {}
+        self._last_budget_sync = 0.0
+        # rotation cursor for overflow-backlog node selection
+        self._lease_rr = 0
+        # nodes with a revoke (work-steal) request in flight
+        self._lease_revoke_inflight: Set[NodeID] = set()
+        self._last_lease_steal = 0.0
 
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="ray_tpu-scheduler", daemon=True)
@@ -561,12 +594,45 @@ class Scheduler:
             self._on_worker_death(WorkerID(msg[1]))
         elif kind == "object_fetched":
             _, oid_bin, ok = msg
-            oid = ObjectID(oid_bin)
             nid = self._daemon_conns.get(conn)
             if nid is not None:
-                self._fetching.discard((oid, nid))
-                if ok:
-                    self._object_locations[oid].add(nid)
+                self._xfer_complete(ObjectID(oid_bin), nid, ok)
+        elif kind == "lease_done":
+            nid = self._daemon_conns.get(conn)
+            if nid is not None:
+                t0 = time.perf_counter()
+                self._on_lease_done(nid, msg[1])
+                stat = self._event_stats["daemon.lease_done"]
+                stat[0] += 1
+                stat[1] += time.perf_counter() - t0
+        elif kind == "lease_worker":
+            # a daemon-owned dispatcher worker: registered so its relayed
+            # pulls/rpcs/ref-ops resolve, but never in the head's idle pool
+            nid = self._daemon_conns.get(conn)
+            if nid is not None:
+                wid = WorkerID(msg[1])
+                self.workers[wid] = WorkerState(
+                    worker_id=wid,
+                    conn=DaemonWorkerChannel(
+                        conn, msg[1], self._daemon_send_locks[conn]
+                    ),
+                    proc=None,
+                    node_id=nid,
+                    state="leased",
+                )
+        elif kind == "lease_started":
+            for tid_bin in msg[1]:
+                rec = self.tasks.get(TaskID(tid_bin))
+                if rec is not None and rec.state == "LEASED":
+                    rec.state = "RUNNING"
+                    rec.start_time = time.monotonic()
+                    self._record_event(rec.spec, "RUNNING")
+        elif kind == "lease_revoked":
+            nid = self._daemon_conns.get(conn)
+            if nid is not None:
+                self._on_lease_revoked(nid, msg[1])
+        elif kind == "lease_worker_gone":
+            self._on_lease_worker_gone(WorkerID(msg[1]), msg[2])
         elif kind == "heartbeat":
             nid = self._daemon_conns.get(conn)
             node = self.nodes.get(nid) if nid is not None else None
@@ -592,6 +658,7 @@ class Scheduler:
             logger.warning("node daemon %s disconnected; removing node", nid.hex()[:8])
             for locs in self._object_locations.values():
                 locs.discard(nid)
+            self._lease_budget_sent.pop(nid, None)
             self._on_remove_node(nid)
 
     # ---- worker messages -------------------------------------------------
@@ -737,8 +804,17 @@ class Scheduler:
         node = self.nodes.get(node_id)
         return node.object_addr if node is not None else None
 
+    # transfers served concurrently per source node before further
+    # destinations wait for a relay copy (tree fan-out factor)
+    PER_SOURCE_XFER_CAP = 2
+
     def _ensure_local(self, oid: ObjectID, dest: NodeID) -> None:
-        """Start (at most one) transfer of oid to dest if it has no copy."""
+        """Start (at most one) transfer of oid to dest if it has no copy.
+
+        Source selection is load-balanced across every node holding a copy,
+        capped per source; over-cap destinations park in ``_xfer_waiting``
+        and are re-sourced as copies land — a broadcast therefore cascades
+        through the fleet as a tree."""
         dest = self._loc_node(dest)
         locs = self._object_locations.get(oid)
         if not locs:
@@ -751,14 +827,25 @@ class Scheduler:
         key = (oid, dest)
         if key in self._fetching:
             return
-        src_addr = None
+        best = None
         for src in locs:
-            src_addr = self._object_server_addr(src)
-            if src_addr is not None:
-                break
-        if src_addr is None:
+            addr = self._object_server_addr(src)
+            if addr is None:
+                continue
+            load = self._xfer_load[src]
+            if best is None or load < best[1]:
+                best = (src, load, addr)
+        if best is None:
             return
-        self._fetching.add(key)
+        src, load, src_addr = best
+        if load >= self.PER_SOURCE_XFER_CAP:
+            self._xfer_waiting.setdefault(oid, set()).add(dest)
+            return
+        waiting = self._xfer_waiting.get(oid)
+        if waiting is not None:
+            waiting.discard(dest)
+        self._fetching[key] = src
+        self._xfer_load[src] += 1
         if dest == self._node.head_node_id:
             threading.Thread(
                 target=self._fetch_into_head,
@@ -775,6 +862,29 @@ class Scheduler:
                     )
             except (OSError, EOFError):
                 self._on_daemon_death(dest_node.daemon_conn)
+
+    def _xfer_complete(self, oid: ObjectID, dest: NodeID, ok: bool) -> None:
+        """One transfer settled: free its source slot, record the new copy,
+        and restart parked destinations (which can now source from it)."""
+        src = self._fetching.pop((oid, dest), None)
+        if src is not None:
+            self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
+        if ok:
+            self._object_locations[oid].add(dest)
+        waiters = self._xfer_waiting.pop(oid, None)
+        if waiters:
+            waiters.discard(dest)
+            for d in waiters:
+                self._ensure_local(oid, d)
+        # the freed source slot may also unblock destinations parked on
+        # OTHER objects this source holds — without this cross-object wake
+        # they would wait for their consumer's next 2s ensure_local poll
+        if self._xfer_waiting:
+            for other in list(self._xfer_waiting):
+                if other == oid:
+                    continue
+                for d in list(self._xfer_waiting.get(other, ())):
+                    self._ensure_local(other, d)
 
     def _recover_object(self, oid: ObjectID, depth: int = 0) -> bool:
         """Owner-driven lineage reconstruction: re-execute the creating task
@@ -801,7 +911,7 @@ class Scheduler:
         rec = self.tasks.get(oid.task_id())
         if rec is None or rec.spec.task_type == TaskType.ACTOR_CREATION:
             return False
-        if rec.state in ("PENDING", "WAITING_DEPS", "SCHEDULED"):
+        if rec.state in ("PENDING", "WAITING_DEPS", "SCHEDULED", "LEASED"):
             return True  # already being recomputed
         if rec.state == "RUNNING":
             return True  # will recommit on completion
@@ -852,14 +962,13 @@ class Scheduler:
         return True
 
     def _fetch_into_head(self, oid: ObjectID, src_addr) -> None:
-        from ray_tpu._private.object_transfer import fetch_object_bytes
+        from ray_tpu._private.object_transfer import fetch_into_local_store
 
         ok = False
         try:
-            blob = fetch_object_bytes(src_addr, oid, self.config.cluster_auth_key)
-            if blob is not None:
-                self._node.store_client.put_bytes(oid, blob)
-                ok = True
+            ok = fetch_into_local_store(
+                self._node.store_client, src_addr, oid, self.config.cluster_auth_key
+            )
         except Exception:
             logger.exception("fetch of %s into head failed", oid.hex()[:8])
         self.post(("fetch_done", oid, self._node.head_node_id, ok))
@@ -925,12 +1034,15 @@ class Scheduler:
             self._daemon_conns[conn] = ns.node_id
             self._daemon_send_locks[conn] = threading.Lock()
             ns.last_heartbeat = time.monotonic()
+            # a re-registering daemon restarted its local dispatcher (and
+            # killed its workers): requeue whatever was leased to it, and
+            # forget the budget we last sent so the fresh one goes out
+            self._requeue_leased_for_node(ns.node_id)
+            self._lease_budget_sent.pop(ns.node_id, None)
             self._retry_pending_pgs()
         elif kind == "fetch_done":
             _, oid, nid, ok = cmd
-            self._fetching.discard((oid, nid))
-            if ok:
-                self._object_locations[oid].add(nid)
+            self._xfer_complete(oid, nid, ok)
         elif kind == "kill_actor":
             _, actor_id, no_restart = cmd
             self._kill_actor(actor_id, no_restart)
@@ -1136,6 +1248,12 @@ class Scheduler:
                 self._write_gcs_snapshot()
             except Exception:
                 logger.exception("gcs snapshot failed")
+        if self._daemon_conns and now0 - self._last_budget_sync > 0.5:
+            self._last_budget_sync = now0
+            self._sync_lease_budgets()
+        if self._daemon_conns and now0 - self._last_lease_steal > 0.2:
+            self._last_lease_steal = now0
+            self._steal_backlogged_leases()
         # daemon health: a node that missed heartbeats for the timeout window
         # is declared dead (parity: GcsHealthCheckManager,
         # gcs_health_check_manager.h:39)
@@ -1245,6 +1363,7 @@ class Scheduler:
         finally:
             self._pick_cache = None
         self._pending.extendleft(reversed(deferred))
+        self._flush_lease_batches()
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
         """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
@@ -1319,8 +1438,17 @@ class Scheduler:
         if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
             return self._try_dispatch_pg(rec)
         node = self._pick_node(spec)
+        leasable = spec.task_type == TaskType.NORMAL_TASK
         if node is None:
+            # saturated: normal tasks queue at a daemon's local dispatcher
+            # (bounded backlog) instead of waiting for a head-side retry
+            if leasable and strat.kind in ("DEFAULT", "SPREAD"):
+                overflow = self._pick_lease_overflow(spec)
+                if overflow is not None:
+                    return self._lease_to(overflow, rec, acquired=False)
             return False
+        if leasable and node.daemon_conn is not None:
+            return self._lease_to(node, rec, acquired=True)
         wid = self._acquire_worker(node, spec)
         if wid is None:
             return False
@@ -1385,6 +1513,304 @@ class Scheduler:
             w.conn.send(("exec", rec.spec))
         except (OSError, EOFError):
             self._on_worker_death(wid)
+
+    # ---- lease dispatch (head half; parity: spillback to raylet local
+    # queues, cluster_task_manager.cc:44 → local_task_manager.cc:74) -------
+
+    def _daemon_send(self, node: NodeState, msg) -> bool:
+        lock = self._daemon_send_locks.get(node.daemon_conn)
+        if lock is None:
+            return False
+        try:
+            with lock:
+                node.daemon_conn.send(msg)
+            return True
+        except (OSError, EOFError):
+            self._on_daemon_death(node.daemon_conn)
+            return False
+
+    def _lease_to(self, node: NodeState, rec: TaskRecord, acquired: bool) -> bool:
+        spec = rec.spec
+        if acquired:
+            node.acquire(spec.resources)
+            for k, v in spec.resources.items():
+                node.lease_acquired[k] = node.lease_acquired.get(k, 0.0) + v
+        else:
+            self._lease_backlog[node.node_id].append(spec.task_id)
+        rec.state = "LEASED"
+        rec.worker_id = None
+        self._leased[spec.task_id] = (node.node_id, acquired, dict(spec.resources))
+        self._lease_batch.setdefault(node.node_id, []).append(spec)
+        self._record_event(spec, "LEASED")
+        return True
+
+    def _flush_lease_batches(self) -> None:
+        if not self._lease_batch:
+            return
+        batches, self._lease_batch = self._lease_batch, {}
+        for nid, specs in batches.items():
+            node = self.nodes.get(nid)
+            if node is None or node.daemon_conn is None:
+                continue
+            self._daemon_send(node, ("lease_tasks", specs))
+
+    def _node_backlog_cap(self, node: NodeState) -> int:
+        """Per-node queue depth: enough to hide the lease_done->refill round
+        trip (a few tasks per execution slot), never the config ceiling on a
+        tiny node — deep queues on slow nodes just strand work that faster
+        nodes (or the head) could steal only later."""
+        slots = max(1.0, node.total.get("CPU", 1.0))
+        return min(self.config.lease_backlog_cap, int(2 * slots) + 2)
+
+    def _pick_lease_overflow(self, spec: TaskSpec) -> Optional[NodeState]:
+        """Cluster saturated: queue the task at a feasible daemon node's
+        local dispatcher (bounded backlog) so completions there start it
+        without a head round-trip."""
+        cache = self._pick_cache
+        cand = cache.get("__lease__") if cache is not None else None
+        if cand is None:
+            cand = [
+                n
+                for n in self.nodes.values()
+                if n.alive and n.daemon_conn is not None
+            ]
+            if cache is not None:
+                cache["__lease__"] = cand
+        if not cand:
+            return None
+        for i in range(len(cand)):
+            n = cand[(self._lease_rr + i) % len(cand)]
+            if (
+                n.alive
+                and len(self._lease_backlog[n.node_id]) < self._node_backlog_cap(n)
+                and n.feasible(spec.resources)
+            ):
+                self._lease_rr = (self._lease_rr + i + 1) % len(cand)
+                return n
+        return None
+
+    def _steal_backlogged_leases(self) -> None:
+        """Work stealing (parity role: raylet spillback rebalancing): when
+        the head queue is empty but capacity is free somewhere, pull queued
+        (unstarted) tasks back from the deepest node backlog so they can be
+        placed where the capacity is — without this, the tail of a big batch
+        sits parked behind one slow node."""
+        if self._pending or not self._lease_backlog:
+            return
+        victim = None
+        victim_len = 0
+        for nid, q in self._lease_backlog.items():
+            if len(q) > victim_len and nid not in self._lease_revoke_inflight:
+                node = self.nodes.get(nid)
+                if node is not None and node.alive and node.daemon_conn is not None:
+                    victim, victim_len = node, len(q)
+        if victim is None:
+            return
+        q = self._lease_backlog[victim.node_id]
+        # steal only if some OTHER node could actually run the queue head now
+        head_demand = None
+        for tid in q:
+            rec = self.tasks.get(tid)
+            if rec is not None:
+                head_demand = rec.spec.resources
+                break
+        if head_demand is None:
+            return
+        if not any(
+            n.alive and n.node_id != victim.node_id and n.can_run(head_demand)
+            for n in self.nodes.values()
+        ):
+            return
+        # take the tail half (the daemon consumes from the front)
+        tids = list(q)[max(1, victim_len // 2):] or list(q)
+        self._lease_revoke_inflight.add(victim.node_id)
+        if not self._daemon_send(
+            victim, ("lease_revoke", [t.binary() for t in tids])
+        ):
+            self._lease_revoke_inflight.discard(victim.node_id)
+
+    def _on_lease_revoked(self, nid: NodeID, tid_bins) -> None:
+        self._lease_revoke_inflight.discard(nid)
+        q = self._lease_backlog.get(nid)
+        for tid_bin in tid_bins:
+            tid = TaskID(tid_bin)
+            info = self._leased.pop(tid, None)
+            if info is None:
+                continue
+            if info[1]:
+                # promoted to acquired AFTER the revoke request went out (a
+                # lease_done landed in between): the daemon never started it,
+                # so the head must hand the resources back — this leak wedged
+                # a 50-node fleet at 0 available CPU
+                self._lease_release(nid, info[2])
+            if q is not None:
+                try:
+                    q.remove(tid)
+                except ValueError:
+                    pass
+            rec = self.tasks.get(tid)
+            if rec is not None and rec.state == "LEASED":
+                rec.state = "PENDING"
+                self._pending.append(tid)
+        self._dispatch_dirty = True
+
+    def _lease_release(self, nid: NodeID, demand: Dict[str, float]) -> None:
+        node = self.nodes.get(nid)
+        if node is None:
+            return
+        node.release(demand)
+        for k, v in demand.items():
+            left = node.lease_acquired.get(k, 0.0) - v
+            if left <= 1e-12:
+                node.lease_acquired.pop(k, None)
+            else:
+                node.lease_acquired[k] = left
+
+    def _promote_lease_backlog(self, nid: NodeID) -> None:
+        """Mirror the node dispatcher's FIFO: acquire resources for backlog
+        tasks that now fit, keeping the head ledger in step with what the
+        daemon will actually run next."""
+        q = self._lease_backlog.get(nid)
+        if not q:
+            return
+        node = self.nodes.get(nid)
+        while q:
+            tid = q[0]
+            rec = self.tasks.get(tid)
+            info = self._leased.get(tid)
+            if (
+                rec is None
+                or info is None
+                or rec.state not in ("LEASED", "RUNNING")
+                or info[1]  # already acquired
+            ):
+                q.popleft()
+                continue
+            if node is None or not node.alive or not node.can_run(info[2]):
+                break
+            node.acquire(info[2])
+            for k, v in info[2].items():
+                node.lease_acquired[k] = node.lease_acquired.get(k, 0.0) + v
+            self._leased[tid] = (nid, True, info[2])
+            q.popleft()
+
+    def _on_lease_done(self, nid: NodeID, entries) -> None:
+        self._dispatch_dirty = True
+        for tid_bin, results in entries:
+            tid = TaskID(tid_bin)
+            info = self._leased.pop(tid, None)
+            if info is not None and info[1]:
+                self._lease_release(info[0], info[2])
+            rec = self.tasks.get(tid)
+            if rec is None or info is None or rec.state not in ("LEASED", "RUNNING"):
+                continue  # cancelled / node re-registered meanwhile
+            spec = rec.spec
+            if (
+                spec.retry_exceptions
+                and not spec.is_streaming
+                and rec.retries_left > 0
+                and results
+                and results[0][0] == "error"
+                and self._retryable_app_error(results[0], spec.retry_exceptions)
+            ):
+                rec.retries_left -= 1
+                self._record_event(spec, "RETRY")
+                self._make_schedulable(rec)
+                continue
+            rec.state = "FINISHED"
+            rec.end_time = time.monotonic()
+            self._record_event(spec, "FINISHED")
+            for i, entry in enumerate(results):
+                oid = ObjectID.for_return(spec.task_id, i)
+                if entry[0] == "stored":
+                    self._object_locations[oid].add(nid)
+                self._commit_result(oid, entry)
+            self._unpin(spec.arg_ref_ids())
+        self._promote_lease_backlog(nid)
+
+    def _on_lease_worker_gone(self, wid: WorkerID, tid_bin) -> None:
+        w = self.workers.get(wid)
+        if w is not None:
+            w.current_task = None
+            self._on_worker_death(wid, graceful=True)
+        if tid_bin is None:
+            return
+        tid = TaskID(tid_bin)
+        info = self._leased.pop(tid, None)
+        if info is not None and info[1]:
+            self._lease_release(info[0], info[2])
+        rec = self.tasks.get(tid)
+        if rec is None or info is None or rec.state not in ("LEASED", "RUNNING"):
+            return
+        if rec.retries_left > 0:
+            rec.retries_left -= 1
+            rec.state = "PENDING"
+            rec.worker_id = None
+            self._pending.append(tid)
+            self._dispatch_dirty = True
+        else:
+            self._fail_task(
+                rec,
+                exc.WorkerCrashedError(
+                    f"worker died executing {rec.spec.name or tid.hex()}"
+                ),
+            )
+        if info is not None:
+            self._promote_lease_backlog(info[0])
+
+    def _requeue_leased_for_node(self, nid: NodeID) -> None:
+        """Node died or re-registered with a fresh dispatcher: its leased
+        tasks retry at the head (budget permitting) or fail."""
+        self._lease_backlog.pop(nid, None)
+        self._lease_revoke_inflight.discard(nid)
+        node = self.nodes.get(nid)
+        if node is not None:
+            node.lease_acquired.clear()
+        doomed = [tid for tid, info in self._leased.items() if info[0] == nid]
+        for tid in doomed:
+            info = self._leased.pop(tid)
+            if info[1] and node is not None and node.alive:
+                node.release(info[2])
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state not in ("LEASED", "RUNNING"):
+                continue
+            if rec.retries_left > 0:
+                rec.retries_left -= 1
+                rec.state = "PENDING"
+                rec.worker_id = None
+                self._pending.append(tid)
+                self._dispatch_dirty = True
+            else:
+                self._fail_task(
+                    rec,
+                    exc.WorkerCrashedError(
+                        f"node {nid.hex()[:8]} lost while running "
+                        f"{rec.spec.name or tid.hex()}"
+                    ),
+                )
+
+    def _sync_lease_budgets(self) -> None:
+        """Push each daemon its lease budget (= total - head-managed usage)
+        when it changed — actor/PG placements shrink it, their teardown grows
+        it. Leased-task churn cancels out (available and lease_acquired move
+        together), so this is quiet in steady state."""
+        for conn, nid in list(self._daemon_conns.items()):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            # head-managed releases (actor death, PG removal) may have made
+            # room for backlogged leases; fold that in before computing
+            self._promote_lease_backlog(nid)
+            budget = {
+                k: round(
+                    node.available.get(k, 0.0) + node.lease_acquired.get(k, 0.0), 9
+                )
+                for k in node.total
+            }
+            if self._lease_budget_sent.get(nid) == budget:
+                continue
+            if self._daemon_send(node, ("lease_budget", budget)):
+                self._lease_budget_sent[nid] = budget
 
     def _dispatch_actor_task(self, rec: TaskRecord):
         actor = self.actors[rec.spec.actor_id]
@@ -1607,6 +2033,12 @@ class Scheduler:
         w = self.workers.get(wid)
         if w is None or w.state == "dead":
             return
+        if w.state == "starting":
+            # died before "ready": un-count it from the spawn throttle or the
+            # node wedges at the 4-starting cap with nothing ever arriving
+            self._starting_count[w.node_id] = max(
+                0, self._starting_count[w.node_id] - 1
+            )
         w.state = "dead"
         w.dead_since = time.monotonic()
         self._conn_to_worker.pop(w.conn, None)
@@ -1722,6 +2154,23 @@ class Scheduler:
         rec = self.tasks.get(task_id)
         if rec is None:
             return
+        if task_id in self._leased:
+            if rec.state == "RUNNING" and not force:
+                # already executing at the daemon: non-force cancel is a
+                # no-op, matching the head-dispatched RUNNING semantics
+                return
+            info = self._leased.pop(task_id, None)
+            self._fail_task(rec, exc.RayTpuError("task cancelled"))
+            if info is not None:
+                if info[1]:
+                    self._lease_release(info[0], info[2])
+                node = self.nodes.get(info[0])
+                if node is not None and node.daemon_conn is not None:
+                    self._daemon_send(
+                        node, ("lease_cancel", task_id.binary(), force)
+                    )
+                self._promote_lease_backlog(info[0])
+            return
         if rec.state in ("PENDING", "WAITING_DEPS"):
             self._fail_task(rec, exc.RayTpuError("task cancelled"))
             try:
@@ -1741,6 +2190,15 @@ class Scheduler:
         if node is None:
             return
         node.alive = False
+        self._requeue_leased_for_node(node_id)
+        # transfer bookkeeping: in-flight fetches INTO the dead node never
+        # complete (free their source slots); it can't be a waiter either
+        for key in [k for k in self._fetching if k[1] == node_id]:
+            src = self._fetching.pop(key)
+            self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
+        self._xfer_load.pop(node_id, None)
+        for waiters in self._xfer_waiting.values():
+            waiters.discard(node_id)
         for wid, w in list(self.workers.items()):
             if w.node_id == node_id and w.state != "dead":
                 self._terminate_worker(w)
@@ -2149,6 +2607,7 @@ class Scheduler:
             )
 
     def _maybe_free(self, oid: ObjectID):
+        self._xfer_waiting.pop(oid, None)
         self.memory_store.evict(oid)
         store = self._node.store_client
         if store is not None and store.contains(oid):

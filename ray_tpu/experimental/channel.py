@@ -173,6 +173,9 @@ class SocketChannelWriter:
             sock.settimeout(timeout)
         try:
             self._conn = self._listener.accept()
+            from ray_tpu._private.object_transfer import set_nodelay
+
+            set_nodelay(self._conn)
         except (TimeoutError, OSError) as e:
             if isinstance(e, OSError) and not isinstance(e, TimeoutError):
                 raise
@@ -230,6 +233,9 @@ class SocketChannelReader:
         from multiprocessing.connection import Client
 
         self._conn = Client(tuple(address), authkey=auth_key)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(self._conn)
         self._serde = serialization.get_context()
         self._closed = False
 

@@ -74,6 +74,7 @@ def load_native():
     ]
     lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_used_bytes.restype = ctypes.c_uint64
     lib.rt_store_used_bytes.argtypes = [ctypes.c_void_p]

@@ -376,6 +376,19 @@ int rt_store_release(void* handle, const uint8_t* id) {
   return 0;
 }
 
+// creator-only abort of an unsealed object (plasma Abort): the one legal way
+// to free a kCreating block, because only the creator knows no fill is in
+// flight
+int rt_store_abort(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  LockGuard g(&s->hdr->mutex);
+  Entry* e = find_slot(s, id, false);
+  if (!e || e->state != kCreating) return -1;
+  if (e->owner_pid != static_cast<int32_t>(getpid())) return -1;
+  do_delete(s, e);
+  return 0;
+}
+
 int rt_store_delete(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
   LockGuard g(&s->hdr->mutex);

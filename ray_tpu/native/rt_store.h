@@ -15,6 +15,7 @@ int rt_store_seal(void* handle, const uint8_t* id);
 uint64_t rt_store_get(void* handle, const uint8_t* id, uint64_t* size);
 int rt_store_contains(void* handle, const uint8_t* id);
 int rt_store_release(void* handle, const uint8_t* id);
+int rt_store_abort(void* handle, const uint8_t* id);
 int rt_store_delete(void* handle, const uint8_t* id);
 uint64_t rt_store_used_bytes(void* handle);
 uint64_t rt_store_num_objects(void* handle);

@@ -36,9 +36,24 @@ class IMPALAConfig(AlgorithmConfig):
         self.vtrace_clip_c = 1.0
         # learner SPMD width: devices the one-program learner group spans
         self.num_learner_devices = 1
+        # >1: that many learner *processes* (actors on cluster nodes) join
+        # one jax.distributed mesh — the multi-host learner group (parity:
+        # rllib/core/learner/learner_group.py:154-174)
+        self.num_learner_workers = 1
+        self.learner_runtime_env = None
+        self.num_cpus_per_learner = 1.0
 
-    def learners(self, num_learner_devices: int = 1) -> "IMPALAConfig":
+    def learners(
+        self,
+        num_learner_devices: int = 1,
+        num_learner_workers: int = 1,
+        learner_runtime_env=None,
+        num_cpus_per_learner: float = 1.0,
+    ) -> "IMPALAConfig":
         self.num_learner_devices = num_learner_devices
+        self.num_learner_workers = num_learner_workers
+        self.learner_runtime_env = learner_runtime_env
+        self.num_cpus_per_learner = num_cpus_per_learner
         return self
 
     def build(self) -> "IMPALA":
@@ -79,6 +94,82 @@ def vtrace_targets(
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
 
+def build_impala_update(cfg_vals: Dict[str, Any], optimizer):
+    """The IMPALA learner update as a pure function of plain config values —
+    shared by the in-process SPMD learner and the multi-host learner-group
+    workers (which can't capture an Algorithm instance)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        T, N = batch["actions"].shape
+        obs = batch["obs"].reshape(T * N, -1)
+        logits, values = apply_mlp_policy(params, obs)
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        rhos = jnp.exp(logp - batch["logp"])  # pi / mu
+        vs, pg_adv = vtrace_targets(
+            values,
+            batch["last_values"],
+            batch["rewards"],
+            batch["dones"],
+            rhos,
+            cfg_vals["gamma"],
+            cfg_vals["vtrace_clip_rho"],
+            cfg_vals["vtrace_clip_c"],
+        )
+        # mask out env lanes padded up to the mesh multiple — their
+        # zero-filled transitions must not bias the gradient
+        w = batch["mask"][None, :]  # (1, N) broadcast over T
+        denom = jnp.maximum(jnp.sum(w) * T, 1.0)
+        pg_loss = -jnp.sum(logp * pg_adv * w) / denom
+        vf_loss = 0.5 * jnp.sum(((values - vs) ** 2) * w) / denom
+        entropy = (
+            -jnp.sum(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1) * w) / denom
+        )
+        loss = (
+            pg_loss
+            + cfg_vals["vf_loss_coeff"] * vf_loss
+            - cfg_vals["entropy_coeff"] * entropy
+        )
+        return loss, {
+            "pg_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def update(params, opt_state, batch):
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return update
+
+
+def impala_batch_shardings(mesh):
+    """NamedShardings for one learner batch over a ``data``-axis mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P(None, "data"))  # (T, N, ...)
+    n_sharded = NamedSharding(mesh, P("data"))  # (N,)
+    return replicated, {
+        "obs": batch_sharded,
+        "actions": batch_sharded,
+        "logp": batch_sharded,
+        "rewards": batch_sharded,
+        "dones": batch_sharded,
+        "last_values": n_sharded,
+        "mask": n_sharded,
+    }
+
+
 class IMPALA(Algorithm):
     def __init__(self, config: IMPALAConfig):
         super().__init__(config)
@@ -104,84 +195,51 @@ class IMPALA(Algorithm):
             seed=config.seed,
         )
 
-        # --- SPMD learner group: one program over a data-axis mesh ---
-        n_dev = max(1, int(config.num_learner_devices))
-        devices = jax.devices()[:n_dev]
-        if len(devices) < n_dev:
-            raise ValueError(f"need {n_dev} devices, have {len(devices)}")
-        self._mesh = Mesh(np.array(devices), ("data",))
-        replicated = NamedSharding(self._mesh, P())
-        batch_sharded = NamedSharding(self._mesh, P(None, "data"))  # (T, N, ...)
-        n_sharded = NamedSharding(self._mesh, P("data"))  # (N,)
-        batch_shardings = {
-            "obs": batch_sharded,
-            "actions": batch_sharded,
-            "logp": batch_sharded,
-            "rewards": batch_sharded,
-            "dones": batch_sharded,
-            "last_values": n_sharded,
-            "mask": n_sharded,
+        self._cfg_vals = {
+            "gamma": config.gamma,
+            "vtrace_clip_rho": config.vtrace_clip_rho,
+            "vtrace_clip_c": config.vtrace_clip_c,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
         }
-        self._update = jax.jit(
-            self._make_update(),
-            in_shardings=(replicated, replicated, batch_shardings),
-            out_shardings=(replicated, replicated, replicated),
-        )
+        self._group = None
+        if int(config.num_learner_workers) > 1:
+            # --- multi-host learner group: N actor processes, one mesh ---
+            from ray_tpu.rl.learner_group import SPMDLearnerGroup
+
+            self._group = SPMDLearnerGroup(
+                num_workers=int(config.num_learner_workers),
+                builder_config={
+                    "cfg_vals": dict(self._cfg_vals),
+                    "obs_dim": spec.obs_dim,
+                    "num_actions": spec.num_actions,
+                    "hidden": config.hidden,
+                    "lr": config.lr,
+                    "grad_clip": config.grad_clip,
+                    "seed": config.seed,
+                },
+                runtime_env=config.learner_runtime_env,
+                num_cpus_per_worker=config.num_cpus_per_learner,
+            )
+            self._mesh = None
+            self._total_learner_devices = self._group.total_devices
+        else:
+            # --- in-process SPMD learner: one program over a data mesh ---
+            n_dev = max(1, int(config.num_learner_devices))
+            devices = jax.devices()[:n_dev]
+            if len(devices) < n_dev:
+                raise ValueError(f"need {n_dev} devices, have {len(devices)}")
+            self._mesh = Mesh(np.array(devices), ("data",))
+            replicated, batch_shardings = impala_batch_shardings(self._mesh)
+            self._update = jax.jit(
+                build_impala_update(self._cfg_vals, self.optimizer),
+                in_shardings=(replicated, replicated, batch_shardings),
+                out_shardings=(replicated, replicated, replicated),
+            )
+            self._total_learner_devices = n_dev
         self._recent_returns: List[float] = []
         self._timesteps = 0
         self._device_batch = None
-
-    def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-        import optax
-
-        cfg = self.config
-
-        def loss_fn(params, batch):
-            T, N = batch["actions"].shape
-            obs = batch["obs"].reshape(T * N, -1)
-            logits, values = apply_mlp_policy(params, obs)
-            logits = logits.reshape(T, N, -1)
-            values = values.reshape(T, N)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1
-            )[..., 0]
-            rhos = jnp.exp(logp - batch["logp"])  # pi / mu
-            vs, pg_adv = vtrace_targets(
-                values,
-                batch["last_values"],
-                batch["rewards"],
-                batch["dones"],
-                rhos,
-                cfg.gamma,
-                cfg.vtrace_clip_rho,
-                cfg.vtrace_clip_c,
-            )
-            # mask out env lanes padded up to the mesh multiple — their
-            # zero-filled transitions must not bias the gradient
-            w = batch["mask"][None, :]  # (1, N) broadcast over T
-            denom = jnp.maximum(jnp.sum(w) * T, 1.0)
-            pg_loss = -jnp.sum(logp * pg_adv * w) / denom
-            vf_loss = 0.5 * jnp.sum(((values - vs) ** 2) * w) / denom
-            entropy = (
-                -jnp.sum(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1) * w) / denom
-            )
-            loss = pg_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
-            return loss, {
-                "pg_loss": pg_loss,
-                "vf_loss": vf_loss,
-                "entropy": entropy,
-            }
-
-        def update(params, opt_state, batch):
-            grads, metrics = jax.grad(loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, metrics
-
-        return update
 
     # -- training ----------------------------------------------------------
 
@@ -202,7 +260,7 @@ class IMPALA(Algorithm):
         T, N = batch["actions"].shape
         # pad N to a multiple of the mesh so shards are equal; a mask keeps
         # the padded lanes out of the loss
-        n_dev = self._mesh.devices.size
+        n_dev = self._total_learner_devices
         pad = (-N) % n_dev
         batch["mask"] = np.ones(N, np.float32)
         if pad:
@@ -214,9 +272,13 @@ class IMPALA(Algorithm):
         batch = {
             k: v.astype(np.float32) if v.dtype == bool else v for k, v in batch.items()
         }
-        self.params, self.opt_state, metrics = self._update(
-            self.params, self.opt_state, batch
-        )
+        if self._group is not None:
+            metrics = self._group.update(batch)
+            self.params = self._group.cached_params()
+        else:
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, batch
+            )
         self._timesteps += T * N
         mean_ret = (
             float(np.mean(self._recent_returns)) if self._recent_returns else 0.0
@@ -241,6 +303,10 @@ class IMPALA(Algorithm):
     def set_state(self, state):
         self.params = state["params"]
         self._timesteps = state.get("timesteps", 0)
+        if self._group is not None:
+            self._group.set_params(self.params)
 
     def stop(self):
         self.runners.stop()
+        if self._group is not None:
+            self._group.stop()

@@ -1,0 +1,217 @@
+"""Node-local lease dispatch tests.
+
+Parity: the raylet's local task queue + dispatch
+(``src/ray/raylet/local_task_manager.cc:74``) — the head leases blocks of
+normal tasks to daemon dispatchers, which run them on daemon-owned worker
+pools and report completions in batches; plus the work-stealing rebalance
+when capacity frees elsewhere.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+def _node_of():
+    # daemon-node workers share the daemon's shm dir: a per-node fingerprint
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().shm_dir
+
+
+def test_lease_drain_on_daemon_nodes(cluster):
+    """With a 0-CPU head, every task must run via daemon-local dispatch,
+    and a deep queue drains across both nodes."""
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    out = ray_tpu.get([sq.remote(i) for i in range(200)], timeout=300)
+    assert out == [i * i for i in range(200)]
+    nodes = set(ray_tpu.get([_node_of.remote() for _ in range(20)], timeout=300))
+    assert len(nodes) >= 1  # daemon-hosted (head has no CPUs)
+
+
+def test_lease_task_states_reach_running_and_finish(cluster):
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.5)
+        return "ok"
+
+    ref = slow.remote()
+    from ray_tpu.util import state as state_api
+
+    deadline = time.monotonic() + 60
+    saw_running = False
+    while time.monotonic() < deadline and not saw_running:
+        rows = [t for t in state_api.list_tasks() if t["name"] == "slow"]
+        if rows and rows[0]["state"] == "RUNNING":
+            saw_running = True
+        time.sleep(0.05)
+    assert saw_running, "leased task never reported RUNNING"
+    assert ray_tpu.get(ref, timeout=120) == "ok"
+
+
+def test_lease_worker_death_retries(cluster):
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        # die the first time, succeed after the marker exists
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    marker = f"/tmp/lease_flaky_{os.getpid()}"
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=300) == "survived"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_lease_worker_death_no_retries_fails(cluster):
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=300)
+
+
+def test_cancel_leased_task(cluster):
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(60)
+
+    @ray_tpu.remote
+    def queued():
+        return 1
+
+    b = blocker.remote()
+    time.sleep(1.0)  # let it start occupying the node's one slot
+    q = queued.remote()  # backlogged behind the blocker at the daemon
+    ray_tpu.cancel(q)
+    with pytest.raises(exc.RayTpuError):
+        ray_tpu.get(q, timeout=60)
+    ray_tpu.cancel(b, force=True)
+
+
+def test_work_stealing_rebalances_backlog(cluster):
+    """Tasks parked behind a busy node migrate when capacity appears."""
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def hold(sec):
+        time.sleep(sec)
+        from ray_tpu._private.worker import get_runtime
+
+        return get_runtime().shm_dir
+
+    @ray_tpu.remote
+    def quick():
+        from ray_tpu._private.worker import get_runtime
+
+        return get_runtime().shm_dir
+
+    # one long task occupies node A; quick tasks pile into its backlog
+    long_ref = hold.remote(20)
+    time.sleep(1.0)
+    quick_refs = [quick.remote() for _ in range(3)]
+    time.sleep(0.5)
+    # capacity appears elsewhere: the parked tasks must be stolen to it and
+    # complete long before the 20 s blocker releases node A
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    t0 = time.monotonic()
+    homes = ray_tpu.get(quick_refs, timeout=60)
+    assert time.monotonic() - t0 < 15, "backlogged tasks were not stolen"
+    assert len(set(homes)) >= 1
+    ray_tpu.cancel(long_ref, force=True)
+
+
+def test_lease_respects_custom_resources(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 2.0})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(num_cpus=0, resources={"gadget": 1.0})
+    def use_gadget():
+        return "used"
+
+    assert ray_tpu.get([use_gadget.remote() for _ in range(4)], timeout=300) == [
+        "used"
+    ] * 4
+
+
+def test_nested_tasks_from_lease_workers(cluster):
+    """A leased task submitting and getting child tasks must not deadlock
+    (blocked workers release their local slot)."""
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote(41), timeout=120)
+
+    assert ray_tpu.get(parent.remote(), timeout=300) == 42
+
+
+def test_no_resource_leak_under_steal_churn(cluster):
+    """Regression: steal-vs-promote races must not leak node resources.
+    After everything drains, every node's available == total."""
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(60)]
+    # capacity appears mid-flight: steals fire while promotes race them
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    refs += [quick.remote(i) for i in range(60, 120)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == list(range(120))
+    time.sleep(1.5)  # let trailing lease_done batches settle
+    for n in ray_tpu.nodes():
+        if not n["alive"]:
+            continue
+        for k, total in n["total"].items():
+            assert abs(n["available"][k] - total) < 1e-6, (
+                f"leaked {k} on node {n['node_id'][:8]}: "
+                f"{n['available'][k]} != {total}"
+            )

@@ -191,3 +191,24 @@ def test_store_chaos_sanitized(variant, tmp_path):
     assert run.returncode == 0, run.stderr.decode()[-2000:]
     assert b"WARNING: ThreadSanitizer" not in run.stderr
     assert b"ERROR: AddressSanitizer" not in run.stderr
+
+
+def test_abort_unsealed_object(store):
+    """A failed transfer aborts its creation (plasma Abort): the id becomes
+    creatable again instead of wedging every retry."""
+    from ray_tpu._private.ids import JobID, TaskID
+
+    tid = TaskID.for_driver(JobID.from_int(9))
+    oid = ObjectID.for_put(tid, 0)
+    buf = store.create(oid, 128)
+    buf[:4] = b"dead"
+    assert store.abort(oid)
+    assert not store.contains(oid)
+    # creatable again, and the normal path still works
+    buf = store.create(oid, 64)
+    buf[:] = bytes(range(64))
+    store.seal(oid)
+    mv = store.get(oid, timeout=5)
+    assert bytes(mv) == bytes(range(64))
+    # aborting a sealed object is refused
+    assert not store.abort(oid)

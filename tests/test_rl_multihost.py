@@ -1,0 +1,93 @@
+"""Multi-host RL learner group: IMPALA learners as actor processes on daemon
+nodes joining one ``jax.distributed`` mesh, with gang restart on failure.
+
+Parity: ``rllib/core/learner/learner_group.py:154-174`` (multi-learner
+updates) + the learner-group restart path. TPU-first: the update is one
+jitted SPMD program over a mesh spanning the learner processes (gloo on the
+virtual-CPU path, ICI on real slices).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+LEARNER_ENV = {
+    "env_vars": {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+}
+
+
+@pytest.fixture
+def two_node_cluster():
+    # head has no CPUs: learner + env-runner actors land on the daemon nodes
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    cluster.add_node(num_cpus=3)
+    cluster.add_node(num_cpus=3)
+    cluster.wait_for_nodes()
+    yield cluster
+    cluster.shutdown()
+
+
+def _impala_config():
+    from ray_tpu.rl import IMPALAConfig
+
+    return (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=2,
+            num_envs_per_env_runner=16,
+            rollout_fragment_length=64,
+        )
+        .training(lr=1e-3, entropy_coeff=0.005)
+        .learners(num_learner_workers=2, learner_runtime_env=LEARNER_ENV)
+        .debugging(seed=0)
+    )
+
+
+def test_impala_learner_group_spans_daemon_nodes(two_node_cluster):
+    """2 learner processes x 2 virtual devices = one 4-device data mesh;
+    CartPole learns to >= 150 through the multi-host learner group."""
+    algo = _impala_config().build()
+    try:
+        assert algo._group is not None
+        assert algo._group.total_devices == 4  # 2 procs x 2 devices
+        # learners must be on daemon nodes (the head has no CPUs)
+        best = 0.0
+        for i in range(400):
+            result = algo.training_step()
+            best = max(best, result["episode_return_mean"])
+            if best >= 150.0:
+                break
+        assert best >= 150.0, f"multi-host IMPALA did not learn (best {best})"
+    finally:
+        algo.stop()
+
+
+def test_impala_learner_death_restarts_group(two_node_cluster):
+    """Kill one learner actor mid-train: the group must re-rendezvous under
+    a fresh coordinator, restore params, and keep training (parity: the
+    learner-group / backend-executor restart path)."""
+    algo = _impala_config().build()
+    try:
+        returns = []
+        for i in range(8):
+            result = algo.training_step()
+            returns.append(result["episode_return_mean"])
+            if i == 3:
+                # hard-kill learner rank 1 (actor process dies mid-gang)
+                ray_tpu.kill(algo._group.workers[1])
+        # the kill forced at least one restart (fresh rendezvous attempt)
+        assert algo._group._attempt >= 1, "group never restarted"
+        assert all(np.isfinite(r) for r in returns)
+        # training still works after the restart
+        result = algo.training_step()
+        assert np.isfinite(result["pg_loss"])
+    finally:
+        algo.stop()

@@ -15,7 +15,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
-from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig, report
+from ray_tpu.train import FailureConfig, JaxTrainer, ScalingConfig, RunConfig, report
 
 N_STEPS = 3
 SEQ = 64
@@ -158,3 +158,152 @@ def test_jax_distributed_spans_daemon_nodes(two_node_cluster):
     np.testing.assert_allclose(
         distributed_losses, single_losses, rtol=2e-5, atol=1e-6
     )
+
+
+@pytest.mark.parametrize("die_phase", ["rendezvous", "midstep"])
+def test_worker_death_rejoins_fresh_coordinator(two_node_cluster, die_phase):
+    """Failure injection (parity: backend_executor restart path): rank 1 dies
+    either right after joining the coordination service ("rendezvous" — the
+    peer is entering its first collective) or after one optimizer step
+    ("midstep"). The retry must rendezvous against a FRESH attempt-suffixed
+    coordinator key (a stale coordinator address must not be reused) and
+    train to completion."""
+    import os as _os
+    import uuid as _uuid
+
+    marker = f"/tmp/jaxdist_die_{_uuid.uuid4().hex[:8]}"
+
+    def train_loop(config):
+        import os
+
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import ray_tpu.train as train
+        from ray_tpu.train import get_context
+
+        rank = get_context().get_world_rank()
+        phase = config["die_phase"]
+        first_attempt = not os.path.exists(config["marker"])
+        if rank == 1 and first_attempt and phase == "rendezvous":
+            # die right after jax.distributed.initialize returned (the
+            # wrapper ran before this loop): rank 0 is heading into its
+            # first collective against a doomed peer
+            open(config["marker"], "w").close()
+            os._exit(1)
+        devices = jax.devices()
+        assert len(devices) == 8, f"global mesh should be 8, got {len(devices)}"
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(devices), ("data",))
+        sharded = NamedSharding(mesh, P("data"))
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x * 2.0)
+
+        losses = []
+        for i in range(3):
+            x = jax.make_array_from_process_local_data(
+                sharded, np.full(4, i + 1.0, np.float32)
+            )
+            losses.append(float(step(x)))
+            if rank == 1 and first_attempt and phase == "midstep" and i == 1:
+                open(config["marker"], "w").close()
+                os._exit(1)
+        train.report({"losses": losses})
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"marker": marker, "die_phase": die_phase},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            use_jax_distributed=True,
+            worker_runtime_env={
+                "env_vars": {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                }
+            },
+        ),
+        run_config=RunConfig(
+            name=f"jaxdist_failure_{die_phase}",
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    try:
+        result = trainer.fit()
+        assert result.error is None, result.error
+        # every step summed 8 devices' worth of 2*(i+1)
+        assert result.metrics["losses"] == [16.0, 32.0, 48.0]
+        assert _os.path.exists(marker), "the injected death never happened"
+    finally:
+        if _os.path.exists(marker):
+            _os.unlink(marker)
+
+
+def test_pipeline_axis_spans_processes(two_node_cluster):
+    """pipeline >= 2 across OS processes: a 4-stage GPipe ring whose
+    ``pipeline`` mesh axis spans 2 worker processes (2 virtual devices each);
+    the ppermute stage-to-stage hops cross the process boundary. Output must
+    match a sequential host evaluation of the same 4 stages."""
+
+    def train_loop(config):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        import ray_tpu.train as train
+        from ray_tpu.parallel.pipeline import make_pipeline_fn
+
+        devices = jax.devices()
+        assert len(devices) == 4, f"expected 4 global devices, got {len(devices)}"
+        mesh = Mesh(np.array(devices), ("pipeline",))
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        rng = np.random.default_rng(3)
+        d = 8
+        stacked = {
+            "w": jnp.asarray(rng.normal(0, 0.5, (4, d, d)), jnp.float32),
+            "b": jnp.asarray(rng.normal(0, 0.1, (4, d)), jnp.float32),
+        }
+        micro = jnp.asarray(rng.normal(0, 1, (3, 2, d)), jnp.float32)  # (M, mb, d)
+        pipeline = make_pipeline_fn(stage_fn, mesh)
+        out = jax.jit(pipeline)(
+            jax.device_put(stacked, NamedSharding(mesh, P("pipeline"))),
+            jax.device_put(micro, NamedSharding(mesh, P())),
+        )
+        # replicate (allgather) so every process can read the full result
+        full = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))(out)
+        got = np.asarray(jax.device_get(full))
+
+        # host reference: apply the 4 stages sequentially
+        ref = np.asarray(micro)
+        for s in range(4):
+            w = np.asarray(stacked["w"][s])
+            b = np.asarray(stacked["b"][s])
+            ref = np.tanh(ref @ w + b)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        train.report({"ok": True, "mesh_pipeline": 4})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            use_jax_distributed=True,
+            worker_runtime_env={
+                "env_vars": {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                }
+            },
+        ),
+        run_config=RunConfig(name="pipeline_multihost"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["ok"] is True
+    assert result.metrics["mesh_pipeline"] == 4
