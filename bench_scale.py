@@ -99,17 +99,34 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
     """One driver-put object read by a task pinned to each daemon node.
 
     Reference: 1 GiB -> 50 nodes in 20.2 s (~2.48 GiB/s aggregate,
-    release_logs/2.9.3/scalability/object_store.json). Reported as aggregate
-    delivered GiB/s so the ratio is hardware-normalized-ish (their fleet has
-    64 machines; this is one box's loopback sockets).
+    release_logs/2.9.3/scalability/object_store.json — their readers receive
+    the object into plasma; consumption is not part of the measurement).
+
+    Two metrics here, because the object plane has two paths:
+    * ``..._agg`` — the default plane: nodes colocated on one machine
+      deliver through /dev/shm (zero-copy pinned views; the reader verifies
+      edge content). This is the plasma model — on one host the broadcast
+      IS shared memory.
+    * ``..._socket_agg`` — same run with the shm short-circuit disabled:
+      the cross-host plane (striped multi-stream fetch + relay tree), which
+      is what a real multi-machine fleet would exercise.
     """
     blob = ray_tpu.put(np.ones(mib * 1024 * 1024 // 8, dtype=np.float64))
 
-    # one reader pinned per daemon node (the bcast marker): every read is a
-    # genuine cross-process transfer of the full object
+    # one reader pinned per daemon node (the bcast marker)
     @ray_tpu.remote(num_cpus=0, resources={"bcast": 1.0})
     def reader(x):
-        return float(x[0]) + x.nbytes
+        # verify edges (delivery proof) without turning the metric into a
+        # numpy-sum throughput test
+        n = x.shape[0]
+        assert float(x[0]) == 1.0 and float(x[n // 2]) == 1.0 and float(x[-1]) == 1.0
+        return x.nbytes
+
+    # warm the per-node workers with a tiny object first: the metric is the
+    # object plane's delivered bandwidth, not python import time (the
+    # reference's release benchmark also measures an established cluster)
+    small = ray_tpu.put(np.ones(8))
+    ray_tpu.get([reader.remote(small) for _ in range(n_nodes)], timeout=1200)
 
     t0 = time.perf_counter()
     out = ray_tpu.get(
@@ -117,13 +134,50 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
     )
     dt = time.perf_counter() - t0
     assert len(out) == n_nodes
-    agg_gib_s = (mib / 1024.0) * n_nodes / dt
     emit(
         f"scale_broadcast_{mib}mib_{n_nodes}tasks_agg",
-        agg_gib_s,
+        (mib / 1024.0) * n_nodes / dt,
         "GiB/s",
         reference=round(50.0 / 20.2, 3),  # 1 GiB x 50 nodes / 20.2 s
     )
+
+    # cross-host plane: disable the shm short-circuit cluster-wide and force
+    # socket transfers of a fresh object
+    from ray_tpu._private.worker import get_runtime
+
+    sch = get_runtime().node.scheduler
+    sch.config.same_host_shm_transfer = False
+    try:
+        blob2 = ray_tpu.put(np.ones(mib * 1024 * 1024 // 8, dtype=np.float64))
+        oid2 = blob2.id()
+        nids = [
+            nid
+            for nid, n in sch.nodes.items()
+            if n.daemon_conn is not None and n.total.get("bcast")
+        ][:n_nodes]
+        t0 = time.perf_counter()
+        for nid in nids:
+            sch.post(("local_rpc", "ensure_local", (oid2, nid),
+                      __import__("threading").Event(), {}))
+        deadline = time.monotonic() + 1200
+        while time.monotonic() < deadline:
+            if sum(1 for x in nids if x in sch._object_locations.get(oid2, ())) == len(nids):
+                break
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        landed = sum(1 for x in nids if x in sch._object_locations.get(oid2, ()))
+        assert landed == len(nids), (
+            f"socket broadcast incomplete: {landed}/{len(nids)} replicas "
+            "landed before the deadline — refusing to emit a bogus rate"
+        )
+        emit(
+            f"scale_broadcast_{mib}mib_{len(nids)}tasks_socket_agg",
+            (mib / 1024.0) * len(nids) / dt,
+            "GiB/s",
+            reference=round(50.0 / 20.2, 3),
+        )
+    finally:
+        sch.config.same_host_shm_transfer = True
 
 
 def main() -> None:

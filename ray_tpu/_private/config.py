@@ -39,6 +39,16 @@ class Config:
     # collects pins whose serialized blob was dropped without ever being
     # deserialized. It is a leak bound, not a correctness window.
     transit_pin_backstop_s: float = 3600.0
+    # same-host object transfer short-circuit: nodes colocated on one
+    # machine read each other's store arenas directly through /dev/shm
+    # instead of looping bytes through sockets (parity: plasma is shared
+    # memory for everything on the node; the object manager only moves
+    # bytes BETWEEN hosts). Off => always socket (test/debug).
+    same_host_shm_transfer: bool = True
+    # concurrent cross-host transfers served per source node before further
+    # destinations wait for a relay copy (broadcast-tree fan-out; parity:
+    # PushManager admission, push_manager.h:30)
+    object_transfer_fanout: int = 2
     object_spilling_threshold: float = 0.8  # fraction of store full before spilling
     spill_directory: str = ""  # default: <session>/spill
     # --- scheduler ---

@@ -112,6 +112,8 @@ class HeadServer:
                 labels=dict(info.get("labels") or {}),
                 daemon_conn=conn,
                 object_addr=info["object_addr"],
+                shm_dir=info.get("shm_dir", ""),
+                host_id=info.get("host_id", ""),
             )
             conn.send(
                 (

@@ -100,12 +100,16 @@ class Node:
         total["object_store_memory"] = float(config.object_store_memory)
         if resources:
             total.update({k: float(v) for k, v in resources.items()})
+        from ray_tpu._private.object_transfer import machine_id
+
         self.head_node_id = NodeID.from_random()
         head = NodeState(
             node_id=self.head_node_id,
             total=dict(total),
             available=dict(total),
             labels=dict(labels or {}),
+            shm_dir=self.shm_dir,
+            host_id=machine_id(),
         )
 
         self.scheduler = Scheduler(self, config)

@@ -175,7 +175,13 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]
         _recv_range(conn, view, 0, first_end)
         rest = size - first_end
         if rest > 0:
-            streams = min(MAX_FETCH_STREAMS, max(1, rest // STRIPE_THRESHOLD + 1))
+            # stripe across sockets only when there are cores to drive them:
+            # on a 1-core host the extra threads just contend
+            streams = min(
+                MAX_FETCH_STREAMS,
+                max(1, os.cpu_count() or 1),
+                max(1, rest // STRIPE_THRESHOLD + 1),
+            )
             stripe = -(-rest // streams)  # ceil
             errors: list = []
 
@@ -224,6 +230,171 @@ def fetch_object_bytes(addr, oid: ObjectID, auth_key) -> Optional[bytearray]:
     if fetch_object_into(addr, oid, auth_key, make_dest) is None:
         return None
     return out["buf"]
+
+
+_MACHINE_ID = None
+
+
+def machine_id() -> str:
+    """Stable identity of THIS machine (boot id + hostname): two cluster
+    nodes share it iff their /dev/shm is the same memory."""
+    global _MACHINE_ID
+    if _MACHINE_ID is None:
+        import socket
+
+        boot = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as fh:
+                boot = fh.read().strip()
+        except OSError:
+            pass
+        _MACHINE_ID = f"{boot}:{socket.gethostname()}"
+    return _MACHINE_ID
+
+
+# cached read-only attachments to same-host peers' arenas: shm_dir -> handle
+_PEER_ARENAS: dict = {}
+_PEER_ARENAS_LOCK = threading.Lock()
+
+
+def _peer_arena(src_shm_dir: str):
+    # the open is held under the lock: a double-open would leak the losing
+    # rt_store handle. Failures are NOT cached — a transient EMFILE must not
+    # permanently demote this peer to the byte-copy path.
+    with _PEER_ARENAS_LOCK:
+        handle = _PEER_ARENAS.get(src_shm_dir)
+        if handle is not None:
+            return handle
+        try:
+            from ray_tpu.native import load_native
+
+            lib = load_native()
+            path = os.path.join(src_shm_dir, "arena")
+            if lib is not None and os.path.exists(path):
+                h = lib.rt_store_open(path.encode(), 0, 0, 0)
+                if h:
+                    handle = (lib, h, lib.rt_store_base(h))
+                    _PEER_ARENAS[src_shm_dir] = handle
+        except Exception:
+            handle = None
+        return handle
+
+
+def read_peer_pinned(src_shm_dir: str, oid: ObjectID) -> Optional[memoryview]:
+    """Zero-copy same-host read: a view straight over a colocated peer
+    node's store memory. Arena objects carry a cross-process pin released
+    when the last deserialized view is GC'd (the peer's deferred delete
+    honors it); .obj-file objects ride the mmap's lifetime. None when the
+    peer doesn't hold a sealed copy reachable this way.
+
+    This is the plasma model: on one machine, every worker reads THE shared
+    memory — only cross-host reads move bytes.
+    """
+    import mmap
+
+    p = os.path.join(src_shm_dir, oid.hex() + ".obj")
+    if os.path.exists(p):
+        try:
+            with open(p, "rb") as fh:
+                m = mmap.mmap(fh.fileno(), 0, prot=mmap.PROT_READ)
+            mv = memoryview(m)
+            size = int.from_bytes(mv[:8], "little")
+            return mv[16 : 16 + size]  # slice keeps the mapping alive
+        except (OSError, ValueError):
+            return None
+    handle = _peer_arena(src_shm_dir)
+    if handle is None:
+        return None
+    lib, h, base = handle
+    import ctypes
+
+    from ray_tpu._private.native_store import _Pin
+
+    size = ctypes.c_uint64(0)
+    off = lib.rt_store_get(h, oid.binary(), ctypes.byref(size))
+    if not off:
+        return None
+    pin = _Pin(lib, h, oid.binary(), base, off, size.value)
+    return memoryview(pin)
+
+
+def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
+    """Same-host short-circuit: copy ``oid`` out of a colocated peer node's
+    store (shm arena or .obj file) straight into ``store`` — one memcpy, no
+    sockets (parity: plasma's everything-on-one-node-is-shared-memory).
+    Returns False when the peer copy isn't reachable this way (caller falls
+    back to the socket path)."""
+    import ctypes
+    import mmap
+
+    if store.contains(oid):
+        return True
+
+    def copy_in(view: memoryview) -> bool:
+        try:
+            dest = store.create(oid, view.nbytes)
+        except ValueError:
+            return store.contains(oid)  # concurrent fetch owns/finished it
+        try:
+            dest[:] = view
+        except BaseException:
+            store.abort(oid)
+            raise
+        store.seal(oid)
+        return True
+
+    # sealed .obj file in the peer's shm dir (file-store backend)
+    p = os.path.join(src_shm_dir, oid.hex() + ".obj")
+    if os.path.exists(p):
+        try:
+            with open(p, "rb") as fh:
+                m = mmap.mmap(fh.fileno(), 0, prot=mmap.PROT_READ)
+            try:
+                mv = memoryview(m)
+                size = int.from_bytes(mv[:8], "little")
+                return copy_in(mv[16 : 16 + size])
+            finally:
+                mv.release()
+                m.close()
+        except (OSError, ValueError):
+            return False
+    # the peer's native arena
+    handle = _peer_arena(src_shm_dir)
+    if handle is None:
+        return False
+    lib, h, base = handle
+    size = ctypes.c_uint64(0)
+    off = lib.rt_store_get(h, oid.binary(), ctypes.byref(size))
+    if not off:
+        return False
+    try:
+        src = (ctypes.c_char * size.value).from_address(base + off)
+        return copy_in(memoryview(src).cast("B"))
+    finally:
+        lib.rt_store_release(h, oid.binary())
+
+
+def fetch_via_src_info(store, src_info, oid: ObjectID, auth_key, shm_enabled: bool) -> bool:
+    """Shared head/daemon fetch driver: normalize the source descriptor, try
+    the same-host shm path when eligible, fall back to the socket plane —
+    UNLESS the head marked the transfer shm-only (uncharged against the
+    per-source admission cap): then a shm miss is reported as failure so the
+    head can re-admit it through the socket plane's cap instead of letting N
+    uncapped socket fetches stampede one origin."""
+    if not isinstance(src_info, dict):  # legacy shape: bare address
+        src_info = {"addr": src_info, "shm_dir": "", "host_id": ""}
+    if (
+        shm_enabled
+        and src_info.get("shm_dir")
+        and src_info.get("host_id") == machine_id()
+    ):
+        if fetch_from_same_host(store, src_info["shm_dir"], oid):
+            return True
+        if src_info.get("shm_only"):
+            return False
+    if src_info.get("addr"):
+        return fetch_into_local_store(store, src_info["addr"], oid, auth_key)
+    return False
 
 
 def fetch_into_local_store(store, addr, oid: ObjectID, auth_key) -> bool:

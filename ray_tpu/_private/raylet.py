@@ -113,6 +113,12 @@ class NodeDaemon:
         self._lease_worker_cap = max(4, int(2 * cpu_total))
         self._lease_last_reap = time.monotonic()
 
+    @staticmethod
+    def _machine_id() -> str:
+        from ray_tpu._private.object_transfer import machine_id
+
+        return machine_id()
+
     def _register(self, conn=None, timeout: float = 30.0):
         """Announce this node to the (possibly restarted) head.
 
@@ -130,6 +136,8 @@ class NodeDaemon:
                     "labels": dict(self._labels),
                     "object_addr": self.object_server.address,
                     "pid": os.getpid(),
+                    "shm_dir": self.shm_dir,
+                    "host_id": self._machine_id(),
                 },
             )
         )
@@ -318,10 +326,10 @@ class NodeDaemon:
         elif kind == "lease_budget":
             self._lease_budget = {k: float(v) for k, v in msg[1].items()}
         elif kind == "fetch_object":
-            _, oid_bin, src_addr = msg
+            _, oid_bin, src_info = msg
             threading.Thread(
                 target=self._fetch_object,
-                args=(ObjectID(oid_bin), src_addr),
+                args=(ObjectID(oid_bin), src_info),
                 daemon=True,
             ).start()
         elif kind == "delete_object":
@@ -574,12 +582,18 @@ class NodeDaemon:
 
     # -- object plane ------------------------------------------------------
 
-    def _fetch_object(self, oid: ObjectID, src_addr):
-        from ray_tpu._private.object_transfer import fetch_into_local_store
+    def _fetch_object(self, oid: ObjectID, src_info):
+        from ray_tpu._private.object_transfer import fetch_via_src_info
 
         ok = False
         try:
-            ok = fetch_into_local_store(self.store, src_addr, oid, self.auth_key)
+            ok = fetch_via_src_info(
+                self.store,
+                src_info,
+                oid,
+                self.auth_key,
+                getattr(self.config, "same_host_shm_transfer", True),
+            )
         except Exception:
             logger.exception("fetch %s failed", oid.hex()[:8])
         try:

@@ -182,6 +182,10 @@ class NodeState:
     daemon_conn: Any = None
     object_addr: Any = None
     last_heartbeat: float = 0.0
+    # same-host transfer short-circuit identity: nodes sharing host_id can
+    # read each other's stores through /dev/shm at shm_dir
+    shm_dir: str = ""
+    host_id: str = ""
     # resources held by head-leased tasks currently runnable at the node's
     # local dispatcher (subset of total - available); the node's lease
     # budget is available + lease_acquired = total - head-managed usage
@@ -431,8 +435,13 @@ class Scheduler:
         # copy (parity: OwnershipBasedObjectDirectory,
         # ownership_based_object_directory.h:37)
         self._object_locations: Dict[ObjectID, Set[NodeID]] = collections.defaultdict(set)
-        # in-flight transfers: (oid, dest node) -> source node
-        self._fetching: Dict[Tuple[ObjectID, NodeID], NodeID] = {}
+        # in-flight transfers: (oid, dest node) -> (source node, charged)
+        # where charged means the transfer holds one of the source's
+        # admission slots (same-host shm reads don't)
+        self._fetching: Dict[Tuple[ObjectID, NodeID], Tuple[NodeID, bool]] = {}
+        # (oid, dest) pairs whose same-host shm read failed (object only in
+        # the peer's spill dir, arena unreadable): re-admitted via sockets
+        self._shm_xfer_failed: Set[Tuple[ObjectID, NodeID]] = set()
         # per-source in-flight transfer count (admission control; parity:
         # PushManager's max_chunks_in_flight, push_manager.h:30). Capping
         # each source and re-sourcing waiters from freshly-landed copies
@@ -750,7 +759,7 @@ class Scheduler:
             self._handle_cmd(msg[1], holder=wid)
         elif kind == "rpc":
             _, req_id, op, args = msg
-            if op == "ensure_local" and len(args) == 1:
+            if op in ("ensure_local", "same_host_dirs") and len(args) == 1:
                 # destination defaults to the calling worker's node
                 args = (args[0], w.node_id)
             try:
@@ -771,15 +780,43 @@ class Scheduler:
         else:
             logger.warning("unknown worker message: %r", kind)
 
+    def _same_host_dirs_for(self, oid: ObjectID, node_id: NodeID) -> tuple:
+        """shm dirs of colocated nodes holding oid (zero-copy read set)."""
+        if not self.config.same_host_shm_transfer:
+            return ()
+        dest = self._loc_node(node_id)
+        dn = self.nodes.get(dest)
+        if dn is None or not dn.host_id:
+            return ()
+        return tuple(
+            sn.shm_dir
+            for s in self._object_locations.get(oid, ())
+            if (sn := self.nodes.get(s)) is not None
+            and s != dest
+            and sn.host_id == dn.host_id
+            and sn.shm_dir
+        )
+
+    def _stored_entry_for(self, oid: ObjectID, entry: Tuple, node_id: NodeID) -> Tuple:
+        """Augment a ("stored",) entry with same-host zero-copy dirs so the
+        consumer can map a peer store immediately instead of paying another
+        rpc round-trip (or a byte copy)."""
+        if entry[0] != "stored":
+            return entry
+        dirs = self._same_host_dirs_for(oid, node_id)
+        return ("stored", dirs) if dirs else entry
+
     def _handle_pull(self, wid: WorkerID, req_id: int, oids: List[ObjectID]):
         w = self.workers[wid]
         reply: Dict[ObjectID, Tuple] = {}
         for oid in oids:
             entry = self.memory_store.get_entry(oid)
             if entry is not None:
-                reply[oid] = entry
                 if entry[0] == "stored":
-                    self._ensure_local(oid, w.node_id)
+                    entry = self._stored_entry_for(oid, entry, w.node_id)
+                    if len(entry) == 1:  # no zero-copy peer: start a transfer
+                        self._ensure_local(oid, w.node_id)
+                reply[oid] = entry
             else:
                 self._pull_waiters[oid].append((wid, req_id))
                 reply[oid] = ("pending",)
@@ -804,10 +841,6 @@ class Scheduler:
         node = self.nodes.get(node_id)
         return node.object_addr if node is not None else None
 
-    # transfers served concurrently per source node before further
-    # destinations wait for a relay copy (tree fan-out factor)
-    PER_SOURCE_XFER_CAP = 2
-
     def _ensure_local(self, oid: ObjectID, dest: NodeID) -> None:
         """Start (at most one) transfer of oid to dest if it has no copy.
 
@@ -827,29 +860,64 @@ class Scheduler:
         key = (oid, dest)
         if key in self._fetching:
             return
+        # same-host sources first: that transfer is ONE memcpy through
+        # /dev/shm (no socket, no admission cap needed — it doesn't consume
+        # a source's server bandwidth)
+        same_host = None
+        dest_host = dest_node.host_id if dest_node is not None else ""
+        if (
+            dest_host
+            and self.config.same_host_shm_transfer
+            and key not in self._shm_xfer_failed
+        ):
+            for src in locs:
+                sn = self.nodes.get(src)
+                if sn is not None and sn.host_id == dest_host and sn.shm_dir:
+                    same_host = (src, sn)
+                    break
         best = None
-        for src in locs:
-            addr = self._object_server_addr(src)
-            if addr is None:
-                continue
-            load = self._xfer_load[src]
-            if best is None or load < best[1]:
-                best = (src, load, addr)
-        if best is None:
-            return
-        src, load, src_addr = best
-        if load >= self.PER_SOURCE_XFER_CAP:
-            self._xfer_waiting.setdefault(oid, set()).add(dest)
-            return
+        if same_host is None:
+            for src in locs:
+                addr = self._object_server_addr(src)
+                if addr is None:
+                    continue
+                load = self._xfer_load[src]
+                if best is None or load < best[1]:
+                    best = (src, load, addr)
+            if best is None:
+                return
+            src, load, src_addr = best
+            if load >= self.config.object_transfer_fanout:
+                self._xfer_waiting.setdefault(oid, set()).add(dest)
+                return
+        else:
+            src, sn = same_host
+            src_addr = self._object_server_addr(src)
         waiting = self._xfer_waiting.get(oid)
         if waiting is not None:
             waiting.discard(dest)
-        self._fetching[key] = src
-        self._xfer_load[src] += 1
+        # value: (src, charged) — shm short-circuits don't hold a source slot
+        self._fetching[key] = (src, same_host is None)
+        if same_host is None:
+            self._xfer_load[src] += 1
+        src_node = self.nodes.get(src)
+        # shm hints ride along only when the short-circuit is on — daemons
+        # gate on their own flag too, but the head's decision must be enough
+        # to force the socket plane (benchmarks/tests flip it head-side)
+        allow_shm = self.config.same_host_shm_transfer and src_node is not None
+        src_info = {
+            "addr": src_addr,
+            "shm_dir": src_node.shm_dir if allow_shm else "",
+            "host_id": src_node.host_id if allow_shm else "",
+            # uncharged (shm) transfers must NOT silently fall back to
+            # sockets at the daemon — that would bypass the per-source
+            # admission cap; a miss comes back as failure and re-admits here
+            "shm_only": same_host is not None,
+        }
         if dest == self._node.head_node_id:
             threading.Thread(
                 target=self._fetch_into_head,
-                args=(oid, src_addr),
+                args=(oid, src_info),
                 daemon=True,
                 name="obj-fetch",
             ).start()
@@ -858,7 +926,7 @@ class Scheduler:
             try:
                 with lock:
                     dest_node.daemon_conn.send(
-                        ("fetch_object", oid.binary(), src_addr)
+                        ("fetch_object", oid.binary(), src_info)
                     )
             except (OSError, EOFError):
                 self._on_daemon_death(dest_node.daemon_conn)
@@ -866,11 +934,19 @@ class Scheduler:
     def _xfer_complete(self, oid: ObjectID, dest: NodeID, ok: bool) -> None:
         """One transfer settled: free its source slot, record the new copy,
         and restart parked destinations (which can now source from it)."""
-        src = self._fetching.pop((oid, dest), None)
-        if src is not None:
-            self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
+        entry = self._fetching.pop((oid, dest), None)
+        if entry is not None and entry[1]:
+            self._xfer_load[entry[0]] = max(0, self._xfer_load[entry[0]] - 1)
         if ok:
             self._object_locations[oid].add(dest)
+            self._shm_xfer_failed.discard((oid, dest))
+        elif entry is not None and not entry[1]:
+            # an shm-only read missed (peer spilled it / arena unreadable):
+            # remember, so the retry goes through socket admission, and
+            # re-drive the fetch now rather than waiting for the consumer's
+            # next 2s poll
+            self._shm_xfer_failed.add((oid, dest))
+            self._ensure_local(oid, dest)
         waiters = self._xfer_waiting.pop(oid, None)
         if waiters:
             waiters.discard(dest)
@@ -961,13 +1037,17 @@ class Scheduler:
             self._make_schedulable(rec)
         return True
 
-    def _fetch_into_head(self, oid: ObjectID, src_addr) -> None:
-        from ray_tpu._private.object_transfer import fetch_into_local_store
+    def _fetch_into_head(self, oid: ObjectID, src_info) -> None:
+        from ray_tpu._private.object_transfer import fetch_via_src_info
 
         ok = False
         try:
-            ok = fetch_into_local_store(
-                self._node.store_client, src_addr, oid, self.config.cluster_auth_key
+            ok = fetch_via_src_info(
+                self._node.store_client,
+                src_info,
+                oid,
+                self.config.cluster_auth_key,
+                self.config.same_host_shm_transfer,
             )
         except Exception:
             logger.exception("fetch of %s into head failed", oid.hex()[:8])
@@ -1993,10 +2073,13 @@ class Scheduler:
         for wid, req_id in self._pull_waiters.pop(oid, ()):  # type: ignore[arg-type]
             w = self.workers.get(wid)
             if w is not None and w.state != "dead":
+                send_entry = entry
                 if entry[0] == "stored":
-                    self._ensure_local(oid, w.node_id)
+                    send_entry = self._stored_entry_for(oid, entry, w.node_id)
+                    if len(send_entry) == 1:
+                        self._ensure_local(oid, w.node_id)
                 try:
-                    w.conn.send(("pull_reply", req_id, {oid: entry}))
+                    w.conn.send(("pull_reply", req_id, {oid: send_entry}))
                 except (OSError, EOFError):
                     self._on_worker_death(wid)
 
@@ -2194,8 +2277,9 @@ class Scheduler:
         # transfer bookkeeping: in-flight fetches INTO the dead node never
         # complete (free their source slots); it can't be a waiter either
         for key in [k for k in self._fetching if k[1] == node_id]:
-            src = self._fetching.pop(key)
-            self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
+            src, charged = self._fetching.pop(key)
+            if charged:
+                self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
         self._xfer_load.pop(node_id, None)
         for waiters in self._xfer_waiting.values():
             waiters.discard(node_id)
@@ -2463,6 +2547,11 @@ class Scheduler:
             return False
         if op == "object_locations":
             return [n.hex() for n in self._object_locations.get(args[0], set())]
+        if op == "same_host_dirs":
+            # shm dirs of nodes holding oid that share the requester's
+            # machine — the zero-copy read set (plasma: one host, one memory)
+            dest = args[1] if len(args) > 1 else self._node.head_node_id
+            return list(self._same_host_dirs_for(args[0], dest))
         if op == "call_actor":
             # Frontend-agnostic actor invocation (no Python pickled callables
             # required from the caller) — the entry point for the C++ API
@@ -2608,6 +2697,10 @@ class Scheduler:
 
     def _maybe_free(self, oid: ObjectID):
         self._xfer_waiting.pop(oid, None)
+        if self._shm_xfer_failed:
+            self._shm_xfer_failed = {
+                k for k in self._shm_xfer_failed if k[0] != oid
+            }
         self.memory_store.evict(oid)
         store = self._node.store_client
         if store is not None and store.contains(oid):

@@ -251,6 +251,23 @@ class DriverRuntime:
     def object_ready(self, oid: ObjectID) -> bool:
         return self.scheduler.memory_store.contains(oid) or self.store.contains(oid)
 
+    def _read_same_host_peer(self, oid: ObjectID):
+        """Zero-copy view from a colocated daemon node's store (plasma
+        model: one machine, one shared memory); None when no peer copy."""
+        if not self.config.same_host_shm_transfer:
+            return None
+        from ray_tpu._private.object_transfer import read_peer_pinned
+
+        try:
+            dirs = self.rpc("same_host_dirs", oid)
+        except Exception:
+            return None
+        for d in dirs or ():
+            mv = read_peer_pinned(d, oid)
+            if mv is not None:
+                return mv
+        return None
+
     def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         ms = self.scheduler.memory_store
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -295,6 +312,8 @@ class DriverRuntime:
             budget = 60.0 if timeout is None else min(float(timeout), 60.0)
             deadline = time.monotonic() + budget
             mv = self.store.get(oid, timeout=0.05)
+            if mv is None:
+                mv = self._read_same_host_peer(oid)
             while mv is None:
                 if time.monotonic() >= deadline:
                     return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
@@ -303,6 +322,8 @@ class DriverRuntime:
                 except Exception:
                     pass
                 mv = self.store.get(oid, timeout=2.0)
+                if mv is None:
+                    mv = self._read_same_host_peer(oid)
             return self.serde.deserialize_from(mv), False
         if kind == "error":
             err = pickle.loads(entry[1])

@@ -198,10 +198,21 @@ class WorkerRuntime:
             return err, True
         if kind == "stored":
             # the copy may live on another node (or have been lost with it):
-            # poll the local store while periodically asking the scheduler to
-            # transfer — or lineage-reconstruct — it (ensure_local)
+            # try a zero-copy read out of a colocated peer node's store
+            # first, then poll the local store while periodically asking the
+            # scheduler to transfer — or lineage-reconstruct — it
             deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
             mv = self.store.get(oid, timeout=0.05)
+            if mv is None and len(entry) > 1:
+                # zero-copy dirs rode the pull reply: map the peer store now
+                from ray_tpu._private.object_transfer import read_peer_pinned
+
+                for d in entry[1]:
+                    mv = read_peer_pinned(d, oid)
+                    if mv is not None:
+                        break
+            if mv is None:
+                mv = self._read_same_host_peer(oid)
             while mv is None:
                 if time.monotonic() >= deadline or self._stopped.is_set():
                     return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
@@ -210,8 +221,27 @@ class WorkerRuntime:
                 except Exception:
                     pass
                 mv = self.store.get(oid, timeout=2.0)
+                if mv is None:
+                    mv = self._read_same_host_peer(oid)
             return self.serde.deserialize_from(mv), False
         return exc.RayTpuError(f"bad entry {kind}"), True
+
+    def _read_same_host_peer(self, oid: ObjectID) -> Optional[memoryview]:
+        """Zero-copy view from a colocated peer node's store (plasma model:
+        one machine, one shared memory); None when no peer copy exists."""
+        if not getattr(self.config, "same_host_shm_transfer", True):
+            return None
+        from ray_tpu._private.object_transfer import read_peer_pinned
+
+        try:
+            dirs = self.rpc("same_host_dirs", oid)
+        except Exception:
+            return None
+        for d in dirs or ():
+            mv = read_peer_pinned(d, oid)
+            if mv is not None:
+                return mv
+        return None
 
     def object_ready_local(self, oid: ObjectID) -> bool:
         return self.store.contains(oid)

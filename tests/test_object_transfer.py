@@ -5,6 +5,7 @@ Parity: ``src/ray/object_manager`` tests (push/pull manager, buffer pool).
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -89,10 +90,14 @@ def test_concurrent_fetches_same_object(served_store):
     assert all(r == payload for r in results)
 
 
-def test_broadcast_tree_bookkeeping():
-    """Per-source admission: with cap 2, an 8-way broadcast's first wave
-    draws from the origin and later waves re-source from landed copies; the
-    load ledger returns to zero."""
+def test_broadcast_zero_copy_and_tree_bookkeeping():
+    """Both broadcast planes on one cluster:
+
+    1. default (same-host shm): readers get content with NO transfers — the
+       origin stays the only replica (zero-copy pinned views);
+    2. socket plane (short-circuit disabled): per-source admission relays
+       the object as a tree; every node lands a replica and the per-source
+       load ledger drains to zero."""
     import ray_tpu.cluster_utils as cu
 
     cluster = cu.Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
@@ -105,16 +110,40 @@ def test_broadcast_tree_bookkeeping():
         def read(x):
             return int(x[0]) + x.nbytes
 
-        blob = ray_tpu.put(np.full(1024 * 1024, 7, dtype=np.int64))
-        out = ray_tpu.get([read.remote(blob) for _ in range(4)], timeout=600)
-        assert out == [7 + 8 * 1024 * 1024] * 4
         from ray_tpu._private.worker import get_runtime
 
         sch = get_runtime().node.scheduler
-        # all transfers settled: no residual per-source load, 4 replicas + origin
-        assert all(v == 0 for v in sch._xfer_load.values()), dict(sch._xfer_load)
-        assert not sch._fetching
+
+        blob = ray_tpu.put(np.full(1024 * 1024, 7, dtype=np.int64))
+        out = ray_tpu.get([read.remote(blob) for _ in range(4)], timeout=600)
+        assert out == [7 + 8 * 1024 * 1024] * 4
+        # zero-copy delivery: the origin remains the only replica
         locs = sch._object_locations.get(blob.id(), set())
-        assert len(locs) >= 4
+        assert len(locs) == 1, locs
+
+        # socket plane: disable the shm short-circuit and broadcast afresh
+        sch.config.same_host_shm_transfer = False
+        try:
+            blob2 = ray_tpu.put(np.full(1024 * 1024, 9, dtype=np.int64))
+            out = ray_tpu.get(
+                [read.remote(blob2) for _ in range(4)], timeout=600
+            )
+            assert out == [9 + 8 * 1024 * 1024] * 4
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (
+                    len(sch._object_locations.get(blob2.id(), set())) >= 5
+                    and not sch._fetching
+                ):
+                    break
+                time.sleep(0.1)
+            # every reader node + origin holds a replica; ledger drained
+            assert len(sch._object_locations.get(blob2.id(), set())) >= 5
+            assert all(v == 0 for v in sch._xfer_load.values()), dict(
+                sch._xfer_load
+            )
+            assert not sch._fetching
+        finally:
+            sch.config.same_host_shm_transfer = True
     finally:
         cluster.shutdown()
