@@ -106,6 +106,28 @@ def main() -> None:
     rps_http = drive(http_call, args.clients, args.seconds)
     emit("serve_http_rps", rps_http, "req/s")
 
+    # persistent-connection clients (what real HTTP clients do): each client
+    # thread keeps ONE socket for the whole run — measures the data plane
+    # (proxy -> direct replica channel), not TCP setup
+    import http.client
+
+    local = threading.local()
+
+    def http_keepalive_call():
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = http.client.HTTPConnection(
+                "127.0.0.1", DEFAULT_PORT, timeout=60
+            )
+        conn.request(
+            "POST", "/bench", b"1", {"Content-Type": "application/json"}
+        )
+        conn.getresponse().read()
+
+    http_keepalive_call()
+    rps_ka = drive(http_keepalive_call, args.clients, args.seconds)
+    emit("serve_http_keepalive_rps", rps_ka, "req/s")
+
     serve.delete("bench")
     ray_tpu.shutdown()
 

@@ -9,6 +9,7 @@ requests); HTTP proxy actor; dynamic batching (``batching.py``); model
 composition via ``.bind()``.
 """
 
+from ray_tpu.serve._asgi import ASGIApp, ingress
 from ray_tpu.serve._replica import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.api import (
     delete,
@@ -50,6 +51,8 @@ __all__ = [
     "grpc_predict",
     "start_grpc_proxy",
     "start_node_proxies",
+    "ingress",
+    "ASGIApp",
     "multiplexed",
     "get_multiplexed_model_id",
     "DeploymentHandle",

@@ -1,0 +1,311 @@
+"""Direct proxy→replica data plane.
+
+Parity: the reference proxy speaks gRPC straight to replica processes
+(``python/ray/serve/_private/proxy.py`` → replica ``ASGIReplicaWrapper``),
+bypassing the control plane per request. Here every Replica hosts a small
+authenticated socket server inside its worker process; proxies hold
+persistent connections (the keep-alive hop) and exchange framed-pickle
+request/response pairs — the cluster head is no longer in the per-request
+path. Handle-path dispatch remains the fallback when a direct channel
+breaks (replica restarting / autoscaled away).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+
+class DirectReplicaServer:
+    """Runs inside the replica worker: serves requests over persistent
+    authenticated connections, executing through the SAME gate/ongoing
+    accounting as handle-path requests (autoscaling sees both)."""
+
+    def __init__(self, replica, auth_key: bytes, host: str = "0.0.0.0"):
+        self._replica = replica
+        self._listener = Listener((host, 0), backlog=64, authkey=auth_key)
+        self._stop = False
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="serve-direct"
+        ).start()
+
+    @property
+    def port(self) -> int:
+        return tuple(self._listener.address)[1]
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._stop:
+                    return
+                continue
+            from ray_tpu._private.object_transfer import set_nodelay
+
+            set_nodelay(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                method, args, kwargs, model_id, stream = conn.recv()
+                try:
+                    if stream:
+                        for item in self._replica.handle_request_streaming(
+                            method, args, kwargs, model_id
+                        ):
+                            conn.send(("item", item))
+                        conn.send(("end", None))
+                    else:
+                        result = self._replica.handle_request(
+                            method, args, kwargs, model_id
+                        )
+                        conn.send(("ok", result))
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        blob = cloudpickle.dumps(e)
+                    except Exception:
+                        blob = pickle.dumps(RuntimeError(str(e)))
+                    conn.send(("err", blob))
+        except (EOFError, OSError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class DirectChannel:
+    """Proxy-side persistent connection to one replica's direct server.
+
+    A channel whose request/response framing can no longer be trusted (recv
+    timeout, stream abandoned mid-flight) marks itself broken; the pool
+    re-dials a replacement lazily.
+    """
+
+    CALL_TIMEOUT_S = 120.0
+    STREAM_FRAME_TIMEOUT_S = 300.0
+
+    def __init__(self, address, auth_key: bytes):
+        self._address = tuple(address)
+        self._auth = auth_key
+        self._conn = Client(self._address, authkey=auth_key)
+        from ray_tpu._private.object_transfer import set_nodelay
+
+        set_nodelay(self._conn)
+        self._lock = threading.Lock()
+        self.broken = False
+
+    def _recv(self, timeout: float):
+        if not self._conn.poll(timeout):
+            self.broken = True
+            self.close()
+            raise TimeoutError(
+                f"direct replica call timed out after {timeout}s"
+            )
+        return self._conn.recv()
+
+    def call(self, method: str, args, kwargs, model_id: str = ""):
+        with self._lock:
+            self._conn.send((method, list(args), dict(kwargs), model_id, False))
+            kind, payload = self._recv(self.CALL_TIMEOUT_S)
+        if kind == "ok":
+            return payload
+        raise pickle.loads(payload)
+
+    def call_streaming(self, method: str, args, kwargs, model_id: str = ""):
+        completed = False
+        with self._lock:
+            try:
+                self._conn.send((method, list(args), dict(kwargs), model_id, True))
+                while True:
+                    kind, payload = self._recv(self.STREAM_FRAME_TIMEOUT_S)
+                    if kind == "item":
+                        yield payload
+                    elif kind == "end":
+                        completed = True
+                        return
+                    else:
+                        completed = True  # framing intact: error frame ends it
+                        raise pickle.loads(payload)
+            finally:
+                if not completed:
+                    # abandoned mid-stream (client went away): unread frames
+                    # would desync the next request on this socket
+                    self.broken = True
+                    self.close()
+
+    def close(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class DirectPool:
+    """Pow-2 routed pool of direct channels for one application.
+
+    Several channels per replica so concurrent proxy threads don't serialize
+    on one socket; broken channels evict the replica until the next refresh
+    (the caller falls back to the handle path meanwhile).
+    """
+
+    REFRESH_PERIOD_S = 5.0
+    CHANNELS_PER_REPLICA = 4
+
+    def __init__(self, handle, auth_key: bytes):
+        self._handle = handle
+        self._auth = auth_key
+        self._lock = threading.Lock()
+        # actor_id hex -> {"addr", "channels": [DirectChannel], "rr": int}
+        self._replicas: Dict[str, dict] = {}
+        self._outstanding: Dict[str, int] = {}
+        self._last_refresh = 0.0
+        self.refresh()
+
+    def refresh(self) -> None:
+        import time
+
+        import ray_tpu
+
+        with self._lock:
+            if time.monotonic() - self._last_refresh < 1.0:
+                return
+            self._last_refresh = time.monotonic()
+        try:
+            self._handle._maybe_refresh()  # pick up autoscaling changes
+        except Exception:
+            pass
+        with self._lock:
+            replicas = list(getattr(self._handle, "_replicas", []) or [])
+        addrs: Dict[str, Any] = {}
+        for r in replicas:
+            rid = r._actor_id.hex()
+            with self._lock:
+                if rid in self._replicas:
+                    continue
+            try:
+                addrs[rid] = (r, ray_tpu.get(r.direct_address.remote(), timeout=30))
+            except Exception:
+                continue
+        for rid, (r, addr) in addrs.items():
+            if not addr:
+                continue
+            try:
+                chans = [
+                    DirectChannel(addr, self._auth)
+                    for _ in range(self.CHANNELS_PER_REPLICA)
+                ]
+            except Exception:
+                continue
+            with self._lock:
+                self._replicas[rid] = {"addr": addr, "channels": chans, "rr": 0}
+                self._outstanding.setdefault(rid, 0)
+        # drop replicas no longer in the handle's set
+        live = {r._actor_id.hex() for r in replicas}
+        with self._lock:
+            for rid in [x for x in self._replicas if x not in live]:
+                for c in self._replicas[rid]["channels"]:
+                    c.close()
+                del self._replicas[rid]
+                self._outstanding.pop(rid, None)
+
+    def _pick(self) -> Optional[Tuple[str, DirectChannel]]:
+        import random
+
+        with self._lock:
+            rids = list(self._replicas)
+            if not rids:
+                return None
+            if len(rids) == 1:
+                rid = rids[0]
+            else:
+                a, b = random.sample(rids, 2)
+                rid = a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
+            entry = self._replicas[rid]
+            entry["rr"] = (entry["rr"] + 1) % len(entry["channels"])
+            chan = entry["channels"][entry["rr"]]
+            if chan.broken:
+                # lazy re-dial into the same slot (a stream abandoned on it)
+                try:
+                    chan = DirectChannel(entry["addr"], self._auth)
+                    entry["channels"][entry["rr"]] = chan
+                except Exception:
+                    return None
+            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+            return rid, chan
+
+    def _done(self, rid: str) -> None:
+        with self._lock:
+            if rid in self._outstanding:
+                self._outstanding[rid] -= 1
+
+    def _evict(self, rid: str) -> None:
+        with self._lock:
+            entry = self._replicas.pop(rid, None)
+            self._outstanding.pop(rid, None)
+        if entry:
+            for c in entry["channels"]:
+                c.close()
+
+    def call(self, method: str, args, kwargs, model_id: str = ""):
+        """Direct call; raises _DirectUnavailable when no channel works (the
+        caller falls back to the handle path)."""
+        import time
+
+        if time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S:
+            self.refresh()
+        for _ in range(2):
+            picked = self._pick()
+            if picked is None:
+                break
+            rid, chan = picked
+            try:
+                try:
+                    return chan.call(method, args, kwargs, model_id)
+                finally:
+                    self._done(rid)
+            except (OSError, EOFError, BrokenPipeError):
+                self._evict(rid)
+        raise _DirectUnavailable()
+
+    def call_streaming(self, method: str, args, kwargs, model_id: str = ""):
+        picked = self._pick()
+        if picked is None:
+            raise _DirectUnavailable()
+        rid, chan = picked
+        try:
+            try:
+                yield from chan.call_streaming(method, args, kwargs, model_id)
+            finally:
+                self._done(rid)
+        except (OSError, EOFError, BrokenPipeError):
+            self._evict(rid)
+            raise _DirectUnavailable()
+
+    def close(self):
+        with self._lock:
+            entries = list(self._replicas.values())
+            self._replicas.clear()
+        for entry in entries:
+            for c in entry["channels"]:
+                c.close()
+
+
+class _DirectUnavailable(Exception):
+    pass

@@ -1,26 +1,42 @@
-"""HTTP proxy actor.
+"""HTTP proxy actor: the Serve data-plane ingress.
 
-Parity: ``python/ray/serve/_private/proxy.py`` — per-cluster HTTP ingress
-routing requests to application handles. The reference embeds uvicorn; here a
-stdlib ThreadingHTTPServer runs inside a threaded actor (no extra deps), with
-JSON request/response bodies.
+Parity: ``python/ray/serve/_private/proxy.py`` — per-node HTTP ingress
+routing requests to application handles. The reference embeds uvicorn; here
+an asyncio HTTP/1.1 server runs inside the actor (no extra deps) with:
+
+* persistent (keep-alive) client connections;
+* raw-bytes request/response passthrough (JSON remains the convention for
+  ``application/json`` bodies, matching the handle protocol);
+* ASGI app deployments (``serve.ingress``): the full scope + body forward
+  to the replica, whose response events stream back through the handle's
+  streaming path — chunked transfer out when the app streams;
+* the proxy→replica hop rides the cluster's persistent actor channels (one
+  connection per worker, reused for every request — the keep-alive
+  equivalent of the reference's cached gRPC channels).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
+from urllib.parse import unquote, urlsplit
 
 import ray_tpu
 
 _PROXY_NAME = "SERVE_PROXY"
 DEFAULT_PORT = 8700
+_MAX_BODY = 512 * 1024 * 1024
 
 
 class _NoRouteError(Exception):
     """Distinguishes route misses from user KeyErrors (which must be 500s)."""
+
+
+def _error_body(status: int, message: str) -> Tuple[int, bytes, str]:
+    return status, json.dumps({"error": message}).encode(), "application/json"
 
 
 @ray_tpu.remote(max_concurrency=16)
@@ -28,6 +44,9 @@ class HTTPProxy:
     def __init__(self, port: int = DEFAULT_PORT, bind_host: str = "127.0.0.1"):
         self.routes: Dict[str, str] = {}  # route_prefix -> app name
         self._handles: Dict[str, object] = {}
+        self._stream_handles: Dict[str, object] = {}
+        self._is_asgi: Dict[str, bool] = {}
+        self._direct: Dict[str, object] = {}  # app -> DirectPool
         self.port = port
         # the address peers should dial: loopback clusters stay loopback;
         # a proxy pinned to a remote node advertises its node's outbound IP
@@ -39,65 +58,352 @@ class HTTPProxy:
             if bind_host == "127.0.0.1"
             else _advertised_host(get_runtime().config.cluster_host)
         )
-        proxy = self
+        # handle calls block on ray_tpu.get: they run here, off the loop
+        self._pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="serve-http")
+        self._loop = asyncio.new_event_loop()
+        started = threading.Event()
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        async def _start():
+            self._server = await asyncio.start_server(
+                self._handle_conn, bind_host, port, backlog=256
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            started.set()
 
-            def log_message(self, *a):
+        def _run_loop():
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        threading.Thread(target=_run_loop, daemon=True, name="serve-http-loop").start()
+        started.wait(30)
+
+    # -- HTTP/1.1 ----------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    return
+                if req == "bad-request":
+                    await self._write_simple(
+                        writer, *_error_body(400, "malformed request"), False
+                    )
+                    return
+                method, target, headers, body, http11 = req
+                conn_hdr = headers.get("connection", "").lower()
+                keep = (http11 and conn_hdr != "close") or conn_hdr == "keep-alive"
+                try:
+                    conn_ok = await self._respond(
+                        writer, method, target, headers, body, keep
+                    )
+                except (ConnectionError, BrokenPipeError):
+                    return
+                if not keep or conn_ok is False:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
                 pass
 
-            def _dispatch(self):
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length) if length else b""
-                    payload = json.loads(body) if body else None
-                    result = proxy._route(self.path, payload)
-                    blob = json.dumps({"result": result}, default=str).encode()
-                    self.send_response(200)
-                except _NoRouteError:
-                    blob = json.dumps({"error": f"no route for {self.path}"}).encode()
-                    self.send_response(404)
-                except Exception as e:  # noqa: BLE001
-                    blob = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(blob)))
-                self.end_headers()
-                self.wfile.write(blob)
+    async def _read_request(self, reader):
+        try:
+            line = await reader.readline()
+        except (ConnectionError, asyncio.LimitOverrunError):
+            return None
+        if not line:
+            return None
+        try:
+            method, target, version = line.decode("latin1").strip().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        try:
+            length = int(headers.get("content-length") or 0)
+        except ValueError:
+            return "bad-request"
+        if length > _MAX_BODY:
+            return "bad-request"
+        body = await reader.readexactly(length) if length else b""
+        return method, target, headers, body, version.endswith("1.1")
 
-            do_GET = _dispatch
-            do_POST = _dispatch
+    async def _respond(self, writer, method, target, headers, body, keep):
+        """Returns False when the connection must be dropped (a truncated
+        chunked stream cannot be reused)."""
+        split = urlsplit(target)
+        path = unquote(split.path)
+        app = self._match(path)
+        if app is None:
+            await self._write_simple(
+                writer, *_error_body(404, f"no route for {path}"), keep
+            )
+            return True
+        if self._is_asgi.get(app):
+            return await self._respond_asgi(
+                writer, app, method, path, split.query, headers, body, keep
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            status, blob, ctype = await loop.run_in_executor(
+                self._pool, self._call_plain, app, headers, body
+            )
+        except Exception as e:  # noqa: BLE001
+            status, blob, ctype = _error_body(500, str(e))
+        await self._write_simple(writer, status, blob, ctype, keep)
+        return True
 
-        self._server = ThreadingHTTPServer((bind_host, port), Handler)
-        self.port = self._server.server_address[1]  # resolved when port=0
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-
-    def _route(self, path: str, payload):
+    def _match(self, path: str) -> Optional[str]:
         for prefix, app in sorted(self.routes.items(), key=lambda kv: -len(kv[0])):
             if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
-                handle = self._handles[app]
-                if payload is None:
-                    resp = handle.remote()
-                else:
-                    resp = handle.remote(payload)
-                return resp.result(timeout_s=120)
-        raise _NoRouteError(path)
+                return app
+        return None
+
+    # -- plain (handle-protocol) deployments ------------------------------
+
+    def _call_plain(self, app, headers, body) -> Tuple[int, bytes, str]:
+        """Runs on the pool: JSON convention for json bodies, raw bytes
+        otherwise; responses map by type (bytes -> octet-stream, str ->
+        text, else JSON). Dispatch rides the direct proxy->replica channel
+        when available, else the handle path."""
+        ctype = headers.get("content-type", "")
+        if body and "json" not in ctype and ctype:
+            args = (body,)
+        else:
+            payload = json.loads(body) if body else None
+            args = (payload,) if payload is not None else ()
+        result = self._dispatch(app, "__call__", args)
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            return 200, bytes(result), "application/octet-stream"
+        if isinstance(result, str):
+            return 200, result.encode(), "text/plain; charset=utf-8"
+        return 200, json.dumps({"result": result}, default=str).encode(), "application/json"
+
+    def _dispatch(self, app, method, args):
+        from ray_tpu.serve._direct import _DirectUnavailable
+
+        pool = self._direct.get(app)
+        if pool is not None:
+            try:
+                return pool.call(method, args, {})
+            except _DirectUnavailable:
+                pass
+        handle = self._handles[app]
+        return handle._call(method, args, {}).result(timeout_s=120)
+
+    async def _write_simple(self, writer, status, blob, ctype, keep):
+        writer.write(
+            (
+                f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(blob)}\r\n"
+                f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+            ).encode("latin1")
+        )
+        writer.write(blob)
+        await writer.drain()
+
+    # -- ASGI deployments --------------------------------------------------
+
+    async def _respond_asgi(self, writer, app, method, path, query, headers, body, keep):
+        """Returns False when the connection is no longer reusable (client
+        vanished or the chunked stream was truncated by a replica error)."""
+        scope = {
+            "type": "http",
+            "http_version": "1.1",
+            "method": method.upper(),
+            "path": path,
+            "raw_path": path.encode(),
+            "query_string": query.encode("latin1"),
+            "root_path": "",
+            "headers": [
+                (k.encode("latin1"), v.encode("latin1")) for k, v in headers.items()
+            ],
+        }
+        loop = asyncio.get_running_loop()
+        # bounded: a slow/vanished client must backpressure the pump, not
+        # buffer an SSE stream forever
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        cancelled = threading.Event()
+
+        def put(event) -> bool:
+            """Blocking put from the pump thread; False once cancelled."""
+            while not cancelled.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(event), loop)
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except TimeoutError:
+                    fut.cancel()
+                except Exception:
+                    return False
+            return False
+
+        def pump():
+            from ray_tpu.serve._direct import _DirectUnavailable
+
+            try:
+                pool = self._direct.get(app)
+                if pool is not None:
+                    forwarded = False
+                    try:
+                        for event in pool.call_streaming(
+                            "__asgi__", (scope, body), {}
+                        ):
+                            forwarded = True
+                            if not put(event):
+                                return  # client gone; channel cleans itself
+                        put(None)
+                        return
+                    except _DirectUnavailable:
+                        if forwarded:
+                            raise  # mid-stream break: don't replay chunks
+                        # nothing sent yet: fall through to the handle path
+                handle = self._stream_handles[app]
+                for event in handle._call("__asgi__", (scope, body), {}):
+                    if not put(event):
+                        return
+                put(None)
+            except BaseException as e:  # noqa: BLE001
+                put(e)
+
+        self._pool.submit(pump)
+        try:
+            return await self._write_asgi_response(writer, q, keep)
+        finally:
+            cancelled.set()
+
+    async def _write_asgi_response(self, writer, q, keep) -> bool:
+        first = await q.get()
+        if first is None or isinstance(first, BaseException):
+            msg = str(first) if first is not None else "empty ASGI response"
+            await self._write_simple(writer, *_error_body(500, msg), keep)
+            return True
+        _, status, hdr_pairs = first
+        # peek the next event to choose Content-Length vs chunked
+        second = await q.get()
+        hdr_lines = [
+            f"{k.decode('latin1')}: {v.decode('latin1')}\r\n"
+            for k, v in hdr_pairs
+            if k.lower() not in (b"content-length", b"transfer-encoding", b"connection")
+        ]
+        conn_line = f"Connection: {'keep-alive' if keep else 'close'}\r\n"
+        head = f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n" + "".join(hdr_lines)
+        bodiless = second is None  # start followed by end: 204/304 pattern
+        if bodiless or (
+            isinstance(second, tuple) and second[0] == "body" and not second[2]
+        ):
+            blob = b"" if bodiless else second[1]
+            writer.write(
+                (head + f"Content-Length: {len(blob)}\r\n" + conn_line + "\r\n").encode("latin1")
+            )
+            writer.write(blob)
+            await writer.drain()
+            return True
+        # streaming: chunked transfer encoding
+        writer.write((head + "Transfer-Encoding: chunked\r\n" + conn_line + "\r\n").encode("latin1"))
+        event = second
+        while True:
+            if event is None:
+                break
+            if isinstance(event, BaseException):
+                # replica died mid-stream: DROP the connection without the
+                # terminal chunk so the client sees truncation, not success
+                return False
+            if event[0] == "body":
+                chunk = event[1]
+                if chunk:
+                    writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    await writer.drain()
+                if not event[2]:
+                    break
+            event = await q.get()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+        return True
+
+    # -- control -----------------------------------------------------------
+
+    def _route(self, path: str, payload):
+        """In-process dispatch (kept for tests/back-compat)."""
+        app = self._match(path)
+        if app is None:
+            raise _NoRouteError(path)
+        handle = self._handles[app]
+        resp = handle.remote(payload) if payload is not None else handle.remote()
+        return resp.result(timeout_s=120)
 
     def add_route(self, route_prefix: str, app_name: str, handle):
         self.routes[route_prefix] = app_name
         self._handles[app_name] = handle
+        self._stream_handles[app_name] = handle.options(stream=True)
+        is_asgi = False
+        try:
+            replicas = getattr(handle, "_replicas", None) or []
+            if replicas:
+                is_asgi = bool(
+                    ray_tpu.get(replicas[0].is_asgi.remote(), timeout=30)
+                )
+        except Exception:
+            is_asgi = False
+        self._is_asgi[app_name] = is_asgi
+        # direct proxy->replica data plane (head out of the request path);
+        # a re-added route must close the prior pool's channels first
+        old = self._direct.pop(app_name, None)
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        try:
+            from ray_tpu._private.worker import get_runtime
+            from ray_tpu.serve._direct import DirectPool
+
+            key = get_runtime().config.cluster_auth_key.encode()
+            self._direct[app_name] = DirectPool(handle, key)
+        except Exception:
+            self._direct.pop(app_name, None)
         return self.port
+
+    def _refresh_direct(self):
+        for pool in self._direct.values():
+            try:
+                pool.refresh()
+            except Exception:
+                pass
 
     def remove_route(self, route_prefix: str):
         app = self.routes.pop(route_prefix, None)
         if app:
             self._handles.pop(app, None)
+            self._stream_handles.pop(app, None)
+            self._is_asgi.pop(app, None)
+            pool = self._direct.pop(app, None)
+            if pool is not None:
+                try:
+                    pool.close()
+                except Exception:
+                    pass
         return True
 
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
+
+
+_REASONS = {
+    200: "OK",
+    404: "Not Found",
+    500: "Internal Server Error",
+}
 
 
 def ensure_proxy(controller, app_name: str, route_prefix: str, port: int = DEFAULT_PORT):

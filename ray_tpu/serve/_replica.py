@@ -100,6 +100,7 @@ class Replica:
         self._gate = threading.Semaphore(max_ongoing)
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        self._direct_lock = threading.Lock()
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -124,6 +125,28 @@ class Replica:
         with self._ongoing_lock:
             self._ongoing -= 1
 
+    def is_asgi(self) -> bool:
+        """Whether this deployment mounts an ASGI app (serve.ingress)."""
+        return getattr(self._callable, "__serve_asgi_app__", None) is not None
+
+    def direct_address(self):
+        """Start (once) and return the direct data-plane endpoint: proxies
+        dial it and keep the connection for every subsequent request
+        (parity: the proxy->replica gRPC channel, bypassing the control
+        plane per request)."""
+        with self._direct_lock:  # threaded actor: one listener, one port
+            srv = getattr(self, "_direct_server", None)
+            if srv is None:
+                from ray_tpu._private.worker import get_runtime
+                from ray_tpu.experimental.channel import _advertised_host
+                from ray_tpu.serve._direct import DirectReplicaServer
+
+                rt = get_runtime()
+                key = rt.config.cluster_auth_key.encode()
+                srv = self._direct_server = DirectReplicaServer(self, key)
+                self._direct_host = _advertised_host(rt.config.cluster_host)
+            return (self._direct_host, srv.port)
+
     def handle_request(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
         self._enter(model_id)
         try:
@@ -135,9 +158,21 @@ class Replica:
 
     def handle_request_streaming(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
         """Generator execution: items stream back as they are yielded
-        (parity: streaming responses, _private/proxy_response_generator.py)."""
+        (parity: streaming responses, _private/proxy_response_generator.py).
+        The reserved ``__asgi__`` method drives the mounted ASGI app and
+        streams its response events."""
         self._enter(model_id)
         try:
+            if method == "__asgi__":
+                from ray_tpu.serve._asgi import run_asgi_request
+
+                app = getattr(self._callable, "__serve_asgi_app__")
+                scope, body = args
+                for event in run_asgi_request(
+                    app, scope, body, instance=self._callable
+                ):
+                    yield event
+                return
             fn = (
                 self._callable
                 if method == "__call__"

@@ -496,3 +496,161 @@ def test_probed_queue_depths_reach_handles(serve_cluster):
         _time.sleep(0.5)
     assert handle._probed_depths, "controller depths never reached the handle"
     serve.delete("probed")
+
+
+# ---- ASGI-grade ingress (parity: serve.ingress + uvicorn data plane) ----
+
+
+def _http_roundtrip(host, port, method, path, body=b"", headers=None, n=1):
+    """Raw HTTP/1.1 client exercising keep-alive: n requests on ONE socket.
+    Returns list of (status, headers_dict, body_bytes)."""
+    import socket
+
+    out = []
+    s = socket.create_connection((host, port), timeout=30)
+    try:
+        for _ in range(n):
+            hdrs = {"Host": host, "Content-Length": str(len(body))}
+            hdrs.update(headers or {})
+            req = f"{method} {path} HTTP/1.1\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in hdrs.items()
+            ) + "\r\n"
+            s.sendall(req.encode() + body)
+            f = s.makefile("rb")
+            status = int(f.readline().split()[1])
+            resp_headers = {}
+            while True:
+                line = f.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                resp_headers[k.strip().lower()] = v.strip()
+            if resp_headers.get("transfer-encoding") == "chunked":
+                chunks = []
+                while True:
+                    size = int(f.readline().strip(), 16)
+                    if size == 0:
+                        f.readline()
+                        break
+                    chunks.append(f.read(size))
+                    f.readline()
+                payload = b"".join(chunks)
+            else:
+                payload = f.read(int(resp_headers.get("content-length", 0)))
+            out.append((status, resp_headers, payload))
+    finally:
+        s.close()
+    return out
+
+
+def test_http_raw_bytes_body(serve_cluster):
+    """Non-JSON request/response: raw bytes pass through untouched."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    @serve.deployment
+    def echo_upper(data):
+        assert isinstance(data, bytes)
+        return data.upper()  # bytes in, bytes out
+
+    serve.run(echo_upper.bind(), name="rawapp", route_prefix="/raw")
+    proxy = ensure_proxy(_get_or_create_controller(), "rawapp", "/raw")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+    [(status, hdrs, body)] = _http_roundtrip(
+        host, port, "POST", "/raw", b"\x00binary\xffdata",
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    assert status == 200
+    assert hdrs["content-type"] == "application/octet-stream"
+    assert body == b"\x00BINARY\xffDATA"
+    serve.delete("rawapp")
+
+
+def test_http_asgi_app_and_streaming(serve_cluster):
+    """An ASGI app mounted with serve.ingress: routed responses, raw bodies,
+    and a chunked streaming endpoint delivering incrementally."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    async def app(scope, receive, send):
+        assert scope["type"] == "http"
+        path = scope["path"]
+        if path.endswith("/stream"):
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"text/plain")]})
+            for i in range(5):
+                await send({"type": "http.response.body",
+                            "body": f"chunk-{i};".encode(), "more_body": True})
+            await send({"type": "http.response.body", "body": b"done",
+                        "more_body": False})
+            return
+        msg = await receive()
+        body = msg.get("body", b"")
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"content-type", b"application/x-custom"),
+                                (b"x-echo-len", str(len(body)).encode())]})
+        await send({"type": "http.response.body",
+                    "body": b"asgi:" + body[::-1], "more_body": False})
+
+    @serve.deployment
+    @serve.ingress(app)
+    class AsgiD:
+        pass
+
+    serve.run(AsgiD.bind(), name="asgiapp", route_prefix="/asgi")
+    proxy = ensure_proxy(_get_or_create_controller(), "asgiapp", "/asgi")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+
+    [(status, hdrs, body)] = _http_roundtrip(
+        host, port, "POST", "/asgi/echo", b"hello",
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    assert status == 201
+    assert hdrs["content-type"] == "application/x-custom"
+    assert hdrs["x-echo-len"] == "5"
+    assert body == b"asgi:olleh"
+
+    [(status, hdrs, body)] = _http_roundtrip(host, port, "GET", "/asgi/stream")
+    assert status == 200
+    assert hdrs.get("transfer-encoding") == "chunked"
+    assert body == b"chunk-0;chunk-1;chunk-2;chunk-3;chunk-4;done"
+    serve.delete("asgiapp")
+
+
+def test_http_keep_alive_reuse(serve_cluster):
+    """Several requests on one client socket (persistent connections)."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    @serve.deployment
+    def count(payload=None):
+        return {"n": (payload or {}).get("n", 0) * 2}
+
+    serve.run(count.bind(), name="kaapp", route_prefix="/ka")
+    proxy = ensure_proxy(_get_or_create_controller(), "kaapp", "/ka")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+    results = []
+    import json as _json
+
+    for i in range(4):
+        results.append(
+            _http_roundtrip(
+                host, port, "POST", "/ka",
+                _json.dumps({"n": i}).encode(),
+                headers={"Content-Type": "application/json"},
+            )[0]
+        )
+    # all four rode persistent connections and returned doubled values
+    assert [
+        _json.loads(b)["result"]["n"] for (_, _, b) in results
+    ] == [0, 2, 4, 6]
+    # and 4 requests over a SINGLE socket work end-to-end
+    multi = _http_roundtrip(
+        host, port, "POST", "/ka", _json.dumps({"n": 5}).encode(),
+        headers={"Content-Type": "application/json"}, n=4,
+    )
+    assert all(_json.loads(b)["result"]["n"] == 10 for (_, _, b) in multi)
+    serve.delete("kaapp")
