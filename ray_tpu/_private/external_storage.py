@@ -1,0 +1,223 @@
+"""Pluggable external storage behind a ``scheme://`` URI API.
+
+Parity: ``python/ray/_private/external_storage.py`` (spill targets) +
+``pyarrow.fs``-style URI resolution used by Data IO and Train checkpoints.
+One registry serves all three consumers:
+
+* object-store spill (``NativeStoreClient`` with a scheme'd spill target);
+* Data read/write (``ray_tpu.data`` paths like ``file:///...``);
+* Train checkpoint upload/restore (``RunConfig(storage_path=...)``,
+  ``Checkpoint.from_uri``).
+
+Built-in backends: ``file://`` (local filesystem) and ``memory://`` (an
+in-process fake for unit tests — NOT shared across workers). Third-party
+backends (an S3/GCS client, say) register with :func:`register_backend`;
+nothing else in the framework knows more than the URI.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+_LOCK = threading.Lock()
+_BACKENDS: Dict[str, "StorageBackend"] = {}
+_FACTORIES: Dict[str, Callable[[], "StorageBackend"]] = {}
+
+
+class StorageBackend:
+    """Byte-level storage behind one URI scheme."""
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class FileBackend(StorageBackend):
+    """``file://`` — the local filesystem (atomic writes via tmp+rename)."""
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str) -> bool:
+        try:
+            os.unlink(path)
+            return True
+        except OSError:
+            return False
+
+    def list(self, prefix: str) -> List[str]:
+        # directory (or explicit dir prefix): recursive file walk, matching
+        # the flat-key semantics of object stores
+        if os.path.isdir(prefix) or prefix.endswith("/"):
+            root = prefix.rstrip("/")
+            out: List[str] = []
+            for r, _dirs, files in os.walk(root):
+                out.extend(os.path.join(r, n) for n in files)
+            return sorted(out)
+        d, base = os.path.dirname(prefix), os.path.basename(prefix)
+        try:
+            return sorted(
+                os.path.join(d, n) for n in os.listdir(d) if n.startswith(base)
+            )
+        except OSError:
+            return []
+
+
+class MemoryBackend(StorageBackend):
+    """``memory://`` — an in-process dict; the unit-test fake (the
+    reference's unstable mock storage plays the same role). Contents are
+    NOT visible to other worker processes."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._data[path] = bytes(data)
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(path)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._data
+
+    def delete(self, path: str) -> bool:
+        with self._lock:
+            return self._data.pop(path, None) is not None
+
+    def list(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+
+def register_backend(scheme: str, factory: Callable[[], StorageBackend]) -> None:
+    """Register (or replace) the backend for a URI scheme."""
+    with _LOCK:
+        _FACTORIES[scheme] = factory
+        _BACKENDS.pop(scheme, None)
+
+
+register_backend("file", FileBackend)
+register_backend("memory", MemoryBackend)
+
+
+def has_scheme(uri: str) -> bool:
+    return "://" in (uri or "")
+
+
+def resolve(uri: str) -> Tuple[StorageBackend, str]:
+    """``scheme://path`` -> (backend instance, backend-local path).
+
+    Plain paths resolve to the file backend, so every call site can take
+    either a path or a URI.
+    """
+    if not has_scheme(uri):
+        scheme, path = "file", uri
+    else:
+        # file:///abs/path partitions to /abs/path; file://rel stays relative
+        scheme, _, path = uri.partition("://")
+    with _LOCK:
+        backend = _BACKENDS.get(scheme)
+        if backend is None:
+            factory = _FACTORIES.get(scheme)
+            if factory is None:
+                raise ValueError(
+                    f"no storage backend registered for scheme '{scheme}'"
+                )
+            backend = _BACKENDS[scheme] = factory()
+    return backend, path
+
+
+def join(uri: str, *parts: str) -> str:
+    out = uri.rstrip("/")
+    for p in parts:
+        out += "/" + p.strip("/")
+    return out
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    backend, path = resolve(uri)
+    backend.write_bytes(path, data)
+
+
+def read_bytes(uri: str) -> Optional[bytes]:
+    backend, path = resolve(uri)
+    return backend.read_bytes(path)
+
+
+def exists(uri: str) -> bool:
+    backend, path = resolve(uri)
+    return backend.exists(path)
+
+
+def delete(uri: str) -> bool:
+    backend, path = resolve(uri)
+    return backend.delete(path)
+
+
+def list_uri(uri: str) -> List[str]:
+    backend, path = resolve(uri)
+    scheme = uri.partition("://")[0] if has_scheme(uri) else "file"
+    return [f"{scheme}://{p}" if has_scheme(uri) else p for p in backend.list(path)]
+
+
+def sync_dir_to_uri(local_dir: str, uri: str) -> List[str]:
+    """Mirror a local directory tree into external storage (checkpoint
+    upload; parity: the trainable's storage sync)."""
+    out = []
+    for root, _dirs, files in os.walk(local_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, local_dir)
+            dest = join(uri, rel)
+            with open(p, "rb") as fh:
+                write_bytes(dest, fh.read())
+            out.append(dest)
+    return out
+
+
+def sync_uri_to_dir(uri: str, local_dir: str) -> List[str]:
+    """Materialize an external-storage prefix into a local directory
+    (checkpoint download; ``Checkpoint.from_uri``)."""
+    backend, prefix = resolve(uri)
+    out = []
+    for path in backend.list(prefix.rstrip("/") + "/"):
+        rel = path[len(prefix.rstrip("/")) + 1 :]
+        dest = os.path.join(local_dir, rel)
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        data = backend.read_bytes(path)
+        if data is not None:
+            with open(dest, "wb") as fh:
+                fh.write(data)
+            out.append(dest)
+    return out

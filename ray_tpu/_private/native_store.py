@@ -9,6 +9,7 @@ plasma's fallback allocation to disk).
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import time
 from typing import Dict, Optional, Set
@@ -46,10 +47,23 @@ class _Pin:
 
 
 class NativeStoreClient(StorePutMixin):
-    def __init__(self, lib, arena_path: str, fallback: ObjectStoreClient, capacity: int):
+    def __init__(
+        self,
+        lib,
+        arena_path: str,
+        fallback: ObjectStoreClient,
+        capacity: int,
+        spill_uri: str = "",
+    ):
         self._lib = lib
         self._fallback = fallback
         self._capacity = capacity
+        # external spill target (scheme:// URI): evicted objects go to the
+        # storage backend instead of the local fallback dir (parity:
+        # external_storage.py spill to FS/S3). Sidecar .uri markers in the
+        # shm dir let every same-node client restore them.
+        self._spill_uri = spill_uri
+        self._shm_dir = os.path.dirname(arena_path)
         table_size = max(4096, min(1 << 20, capacity // (64 * 1024)))
         self._h = lib.rt_store_open(arena_path.encode(), capacity, table_size, 1)
         if not self._h:
@@ -93,10 +107,61 @@ class NativeStoreClient(StorePutMixin):
             self._creating[oid] = False
         return self._fallback.create(oid, size)
 
+    # -- external spill (scheme:// backends) ------------------------------
+
+    def _spill_marker(self, oid: ObjectID) -> str:
+        return os.path.join(self._shm_dir, f"spilled_{oid.hex()}.uri")
+
+    def _spill_external(self, oid: ObjectID, src: memoryview) -> bool:
+        from ray_tpu._private import external_storage as storage
+
+        uri = storage.join(self._spill_uri, f"{oid.hex()}.obj")
+        try:
+            storage.write_bytes(uri, bytes(src))
+        except Exception:
+            return False
+        tmp = self._spill_marker(oid) + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(uri)
+        os.replace(tmp, self._spill_marker(oid))
+        return True
+
+    def _external_spilled_uri(self, oid: ObjectID) -> Optional[str]:
+        try:
+            with open(self._spill_marker(oid)) as fh:
+                return fh.read().strip()
+        except OSError:
+            return None
+
+    def _restore_external(self, oid: ObjectID) -> Optional[memoryview]:
+        uri = self._external_spilled_uri(oid)
+        if uri is None:
+            return None
+        from ray_tpu._private import external_storage as storage
+
+        data = storage.read_bytes(uri)
+        if data is None:
+            return None
+        # reinstate locally so repeat gets don't re-download a hot object
+        # from the backend every time (the external copy stays the durable
+        # one; delete() purges both). create/seal directly: put_bytes would
+        # early-return on contains() — the spill marker makes that true —
+        # and recurse back here
+        try:
+            dest = self.create(oid, len(data))
+            dest[:] = data
+            self.seal(oid)
+            mv = self.get(oid, timeout=0)
+            if mv is not None:
+                return mv
+        except Exception:
+            pass
+        return memoryview(data)
+
     def _spill_one_lru(self) -> bool:
-        """Copy the LRU sealed+unpinned arena object into the file store,
-        then delete it from the arena. Returns False when nothing is
-        evictable."""
+        """Copy the LRU sealed+unpinned arena object into the file store (or
+        the external storage backend when a spill URI is configured), then
+        delete it from the arena. Returns False when nothing is evictable."""
         vid_buf = (ctypes.c_uint8 * ObjectID.SIZE)()
         if not self._lib.rt_store_lru_victim(self._h, vid_buf):
             return False
@@ -106,8 +171,12 @@ class NativeStoreClient(StorePutMixin):
         off = self._lib.rt_store_get(self._h, vid_bytes, ctypes.byref(size))
         if off:
             try:
-                if not self._fallback.contains(vid):
-                    src = self._view(off, size.value)
+                src = self._view(off, size.value)
+                if self._spill_uri:
+                    if not os.path.exists(self._spill_marker(vid)):
+                        if not self._spill_external(vid, src):
+                            return False
+                elif not self._fallback.contains(vid):
                     try:
                         dest = self._fallback.create(vid, size.value)
                         dest[:] = src
@@ -145,6 +214,8 @@ class NativeStoreClient(StorePutMixin):
     def contains(self, oid: ObjectID) -> bool:
         if self._lib.rt_store_contains(self._h, oid.binary()):
             return True
+        if self._spill_uri and os.path.exists(self._spill_marker(oid)):
+            return True
         return self._fallback.contains(oid)
 
     def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
@@ -162,6 +233,10 @@ class NativeStoreClient(StorePutMixin):
             mv = self._fallback.get(oid, timeout=0)
             if mv is not None:
                 return mv
+            if self._spill_uri:
+                mv = self._restore_external(oid)
+                if mv is not None:
+                    return mv
             if deadline is not None and time.monotonic() >= deadline:
                 return None
             time.sleep(delay)
@@ -172,6 +247,19 @@ class NativeStoreClient(StorePutMixin):
         self._fallback.release(oid)
 
     def delete(self, oid: ObjectID) -> None:
+        if self._spill_uri:
+            uri = self._external_spilled_uri(oid)
+            if uri is not None:
+                from ray_tpu._private import external_storage as storage
+
+                try:
+                    storage.delete(uri)
+                except Exception:
+                    pass
+                try:
+                    os.unlink(self._spill_marker(oid))
+                except OSError:
+                    pass
         if self._lib.rt_store_delete(self._h, oid.binary()) != 0:
             self._fallback.delete(oid)
 
@@ -191,10 +279,13 @@ class NativeStoreClient(StorePutMixin):
         # safe when no views exist, so we deliberately leak the mapping here.
 
 
-def create_store_client(shm_dir: str, fallback_dir: str, capacity: int):
-    """Factory: native arena client if the .so is available, else files."""
-    import os
+def create_store_client(
+    shm_dir: str, fallback_dir: str, capacity: int, spill_uri: str = ""
+):
+    """Factory: native arena client if the .so is available, else files.
 
+    ``spill_uri`` (a ``scheme://`` target) redirects LRU eviction to an
+    external storage backend instead of the local fallback dir."""
     fallback = ObjectStoreClient(shm_dir, fallback_dir, capacity)
     if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
         return fallback
@@ -205,6 +296,8 @@ def create_store_client(shm_dir: str, fallback_dir: str, capacity: int):
         if lib is None:
             return fallback
         arena_path = os.path.join(shm_dir, "arena")
-        return NativeStoreClient(lib, arena_path, fallback, capacity)
+        return NativeStoreClient(
+            lib, arena_path, fallback, capacity, spill_uri=spill_uri
+        )
     except Exception:
         return fallback

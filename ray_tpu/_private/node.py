@@ -67,13 +67,27 @@ class Node:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         shm_root = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
         self.shm_dir = os.path.join(shm_root, "ray_tpu_" + self.session_name)
-        self.fallback_dir = config.spill_directory or os.path.join(self.session_dir, "spill")
+        # a scheme'd spill target routes eviction through the external
+        # storage API; the local fallback dir still backs oversize creates
+        from ray_tpu._private import external_storage as _xstorage
+
+        spill_uri = (
+            config.spill_directory
+            if _xstorage.has_scheme(config.spill_directory)
+            else ""
+        )
+        self.fallback_dir = (
+            "" if spill_uri else config.spill_directory
+        ) or os.path.join(self.session_dir, "spill")
         config.dump(os.path.join(self.session_dir, "config.json"))
 
         from ray_tpu._private.native_store import create_store_client
 
         self.store_client = create_store_client(
-            self.shm_dir, self.fallback_dir, config.object_store_memory
+            self.shm_dir,
+            self.fallback_dir,
+            config.object_store_memory,
+            spill_uri=spill_uri,
         )
 
         if num_cpus is None:

@@ -81,8 +81,17 @@ class NodeDaemon:
         self.object_server = ObjectServer(lambda: self.store, host, auth_key)
 
         self._register()
+        from ray_tpu._private import external_storage as _xstorage
+
         self.store = create_store_client(
-            self.shm_dir, self.fallback_dir, self.config.object_store_memory
+            self.shm_dir,
+            self.fallback_dir,
+            self.config.object_store_memory,
+            spill_uri=(
+                self.config.spill_directory
+                if _xstorage.has_scheme(self.config.spill_directory)
+                else ""
+            ),
         )
 
         method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"

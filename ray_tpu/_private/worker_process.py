@@ -566,7 +566,18 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
     config = pickle.loads(config_blob)
     worker_id = WorkerID(worker_id_bin)
-    store = create_store_client(shm_dir, fallback_dir, config.object_store_memory)
+    from ray_tpu._private import external_storage as _xstorage
+
+    store = create_store_client(
+        shm_dir,
+        fallback_dir,
+        config.object_store_memory,
+        spill_uri=(
+            config.spill_directory
+            if _xstorage.has_scheme(config.spill_directory)
+            else ""
+        ),
+    )
     rt = WorkerRuntime(conn, worker_id, store, config)
     # node identity for same-node checks (e.g. compiled-DAG channel
     # placement): workers on one node share this shm dir

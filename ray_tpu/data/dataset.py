@@ -594,12 +594,21 @@ class Dataset:
     def _write(self, path: str, ext: str, writer_fn) -> List[str]:
         import cloudpickle
 
-        os.makedirs(path, exist_ok=True)
+        from ray_tpu._private import external_storage as storage
+
+        if not storage.has_scheme(path):
+            os.makedirs(path, exist_ok=True)
         blob = cloudpickle.dumps(writer_fn)
         src_refs, ops = self._refs_and_ops()
         refs = [
-            _write_block.remote(ref, ops,
-                                os.path.join(path, f"part-{i:05d}{ext}"), blob)
+            _write_block.remote(
+                ref,
+                ops,
+                storage.join(path, f"part-{i:05d}{ext}")
+                if storage.has_scheme(path)
+                else os.path.join(path, f"part-{i:05d}{ext}"),
+                blob,
+            )
             for i, ref in enumerate(src_refs)
         ]
         return ray_tpu.get(refs, timeout=600)
@@ -680,8 +689,28 @@ def _block_unique(block, ops, column: str):
 def _write_block(block, ops, out_path: str, writer_blob):
     import cloudpickle
 
+    from ray_tpu._private import external_storage as storage
+
     block = _apply_ops(block, ops)
-    cloudpickle.loads(writer_blob)(block, out_path)
+    writer = cloudpickle.loads(writer_blob)
+    if storage.has_scheme(out_path):
+        # scheme'd target: stage locally, then hand the bytes to the backend
+        import tempfile
+
+        suffix = os.path.splitext(out_path)[1]
+        with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as tmp:
+            local = tmp.name
+        try:
+            writer(block, local)
+            with open(local, "rb") as fh:
+                storage.write_bytes(out_path, fh.read())
+        finally:
+            try:
+                os.unlink(local)
+            except OSError:
+                pass
+    else:
+        writer(block, out_path)
     return out_path
 
 

@@ -64,11 +64,26 @@ def from_pandas(df) -> Dataset:
 
 
 def _expand_paths(paths, suffix: str) -> List[str]:
+    from ray_tpu._private import external_storage as storage
+
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if storage.has_scheme(p):
+            # scheme'd prefix: expand through the backend's listing first
+            # (directories look like existing keys on the file backend);
+            # fall back to treating p as one exact key
+            listed = [
+                u
+                for u in storage.list_uri(p.rstrip("/") + "/")
+                if u.endswith(suffix)
+            ]
+            if listed:
+                out.extend(listed)
+            elif storage.exists(p):
+                out.append(p)
+        elif os.path.isdir(p):
             out.extend(sorted(globlib.glob(os.path.join(p, f"*{suffix}"))))
         elif "*" in p:
             out.extend(sorted(globlib.glob(p)))
@@ -79,11 +94,43 @@ def _expand_paths(paths, suffix: str) -> List[str]:
     return out
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _local_copy(path: str):
+    """Scheme'd URIs download to a local temp file for the parser (removed
+    after the read); plain paths pass through (parity: pyarrow.fs
+    resolution in Data reads)."""
+    from ray_tpu._private import external_storage as storage
+
+    if not storage.has_scheme(path):
+        yield path
+        return
+    import tempfile
+
+    data = storage.read_bytes(path)
+    if data is None:
+        raise FileNotFoundError(path)
+    suffix = os.path.splitext(path)[1]
+    with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as tmp:
+        tmp.write(data)
+        local = tmp.name
+    try:
+        yield local
+    finally:
+        try:
+            os.unlink(local)
+        except OSError:
+            pass
+
+
 @ray_tpu.remote
 def _read_parquet_file(path: str):
     import pyarrow.parquet as pq
 
-    table = pq.read_table(path)
+    with _local_copy(path) as local:
+        table = pq.read_table(local)
     return {c: table.column(c).to_numpy(zero_copy_only=False) for c in table.column_names}
 
 
@@ -91,7 +138,7 @@ def _read_parquet_file(path: str):
 def _read_csv_file(path: str):
     import csv
 
-    with open(path, newline="") as fh:
+    with _local_copy(path) as local, open(local, newline="") as fh:
         reader = csv.DictReader(fh)
         rows = list(reader)
     block = rows_to_block(rows)
@@ -113,7 +160,7 @@ def _read_json_file(path: str):
     import json
 
     rows = []
-    with open(path) as fh:
+    with _local_copy(path) as local, open(local) as fh:
         first = fh.read(1)
         fh.seek(0)
         if first == "[":
@@ -125,14 +172,14 @@ def _read_json_file(path: str):
 
 @ray_tpu.remote
 def _read_text_file(path: str):
-    with open(path) as fh:
+    with _local_copy(path) as local, open(local) as fh:
         lines = [ln.rstrip("\r\n") for ln in fh]
     return {"text": np.array(lines, dtype=object)}
 
 
 @ray_tpu.remote
 def _read_binary_file(path: str):
-    with open(path, "rb") as fh:
+    with _local_copy(path) as local, open(local, "rb") as fh:
         data = fh.read()
     return {"bytes": np.array([data], dtype=object),
             "path": np.array([path], dtype=object)}

@@ -57,7 +57,10 @@ def run_asgi_request(
     """
     import asyncio
 
-    q: "queue.Queue" = queue.Queue()
+    # bounded: a slow consumer (ultimately the HTTP client) must
+    # backpressure the app's send, not buffer its stream in replica memory
+    q: "queue.Queue" = queue.Queue(maxsize=64)
+    abandoned = threading.Event()
     # rebuild bytes-pair headers (they cross the wire as lists)
     scope = dict(scope)
     scope["headers"] = [
@@ -79,42 +82,57 @@ def run_asgi_request(
                 return {"type": "http.request", "body": body, "more_body": False}
             return {"type": "http.disconnect"}
 
+        def put(item) -> bool:
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=1.0)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         async def send(event):
-            q.put(event)
+            if not put(event):
+                raise RuntimeError("ASGI response consumer went away")
 
         try:
             asyncio.run(asgi_app(scope, receive, send))
-            q.put(None)
+            put(None)
         except BaseException as e:  # noqa: BLE001
-            q.put(e)
+            put(e)
 
     t = threading.Thread(target=runner, daemon=True, name="asgi-request")
     t.start()
 
     started = False
-    while True:
-        event = q.get()
-        if event is None:
-            if not started:
-                raise RuntimeError("ASGI app completed without a response")
-            return
-        if isinstance(event, BaseException):
-            # before start: a clean 500 for the proxy to render; after
-            # start: propagate so the proxy TRUNCATES the chunked stream
-            # (a crash must never masquerade as a complete 200)
-            raise event
-        kind = event.get("type")
-        if kind == "http.response.start":
-            started = True
-            headers: List[Tuple[bytes, bytes]] = [
-                (bytes(k), bytes(v)) for k, v in event.get("headers", [])
-            ]
-            yield ("start", int(event.get("status", 200)), headers)
-        elif kind == "http.response.body":
-            yield (
-                "body",
-                bytes(event.get("body", b"")),
-                bool(event.get("more_body", False)),
-            )
-            if not event.get("more_body", False):
+    try:
+        while True:
+            event = q.get()
+            if event is None:
+                if not started:
+                    raise RuntimeError("ASGI app completed without a response")
                 return
+            if isinstance(event, BaseException):
+                # before start: a clean 500 for the proxy to render; after
+                # start: propagate so the proxy TRUNCATES the chunked stream
+                # (a crash must never masquerade as a complete 200)
+                raise event
+            kind = event.get("type")
+            if kind == "http.response.start":
+                started = True
+                headers: List[Tuple[bytes, bytes]] = [
+                    (bytes(k), bytes(v)) for k, v in event.get("headers", [])
+                ]
+                yield ("start", int(event.get("status", 200)), headers)
+            elif kind == "http.response.body":
+                yield (
+                    "body",
+                    bytes(event.get("body", b"")),
+                    bool(event.get("more_body", False)),
+                )
+                if not event.get("more_body", False):
+                    return
+    finally:
+        # consumer gone (client disconnect) or complete: unblock the app
+        # thread's bounded put so it can exit instead of leaking
+        abandoned.set()

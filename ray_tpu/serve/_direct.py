@@ -41,7 +41,11 @@ class DirectReplicaServer:
         while not self._stop:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
+            except Exception:
+                # AuthenticationError (a failed HMAC challenge from a
+                # scanner or stale-key proxy) is NOT an OSError; the accept
+                # loop must survive it or the replica permanently loses its
+                # direct plane
                 if self._stop:
                     return
                 continue
@@ -112,27 +116,48 @@ class DirectChannel:
         self.broken = False
 
     def _recv(self, timeout: float):
-        if not self._conn.poll(timeout):
+        try:
+            ready = self._conn.poll(timeout)
+        except (OSError, EOFError) as e:
             self.broken = True
             self.close()
-            raise TimeoutError(
+            raise _ChannelBroken(str(e)) from e
+        if not ready:
+            self.broken = True
+            self.close()
+            raise _ChannelBroken(
                 f"direct replica call timed out after {timeout}s"
             )
-        return self._conn.recv()
+        try:
+            return self._conn.recv()
+        except (OSError, EOFError) as e:
+            self.broken = True
+            self.close()
+            raise _ChannelBroken(str(e)) from e
+
+    def _send(self, msg):
+        try:
+            self._conn.send(msg)
+        except (OSError, EOFError, BrokenPipeError) as e:
+            self.broken = True
+            self.close()
+            raise _ChannelBroken(str(e)) from e
 
     def call(self, method: str, args, kwargs, model_id: str = ""):
         with self._lock:
-            self._conn.send((method, list(args), dict(kwargs), model_id, False))
+            self._send((method, list(args), dict(kwargs), model_id, False))
             kind, payload = self._recv(self.CALL_TIMEOUT_S)
         if kind == "ok":
             return payload
+        # an APPLICATION exception (may subclass OSError!) — it must reach
+        # the caller untouched, never be mistaken for a transport failure
         raise pickle.loads(payload)
 
     def call_streaming(self, method: str, args, kwargs, model_id: str = ""):
         completed = False
         with self._lock:
             try:
-                self._conn.send((method, list(args), dict(kwargs), model_id, True))
+                self._send((method, list(args), dict(kwargs), model_id, True))
                 while True:
                     kind, payload = self._recv(self.STREAM_FRAME_TIMEOUT_S)
                     if kind == "item":
@@ -280,7 +305,7 @@ class DirectPool:
                     return chan.call(method, args, kwargs, model_id)
                 finally:
                     self._done(rid)
-            except (OSError, EOFError, BrokenPipeError):
+            except _ChannelBroken:
                 self._evict(rid)
         raise _DirectUnavailable()
 
@@ -294,7 +319,7 @@ class DirectPool:
                 yield from chan.call_streaming(method, args, kwargs, model_id)
             finally:
                 self._done(rid)
-        except (OSError, EOFError, BrokenPipeError):
+        except _ChannelBroken:
             self._evict(rid)
             raise _DirectUnavailable()
 
@@ -305,6 +330,11 @@ class DirectPool:
         for entry in entries:
             for c in entry["channels"]:
                 c.close()
+
+
+class _ChannelBroken(Exception):
+    """Transport-level failure on a direct channel (distinct from user
+    exceptions, which may themselves subclass OSError)."""
 
 
 class _DirectUnavailable(Exception):

@@ -128,6 +128,26 @@ class HTTPProxy:
                 break
             k, _, v = h.decode("latin1").partition(":")
             headers[k.strip().lower()] = v.strip()
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # chunked request body: drain it fully or the unread chunk
+            # framing would desync the next keep-alive request
+            chunks = []
+            total = 0
+            while True:
+                size_line = await reader.readline()
+                try:
+                    size = int(size_line.strip().split(b";")[0], 16)
+                except ValueError:
+                    return "bad-request"
+                if size == 0:
+                    await reader.readline()  # trailing CRLF
+                    break
+                total += size
+                if total > _MAX_BODY:
+                    return "bad-request"
+                chunks.append(await reader.readexactly(size))
+                await reader.readexactly(2)  # chunk CRLF
+            return method, target, headers, b"".join(chunks), version.endswith("1.1")
         try:
             length = int(headers.get("content-length") or 0)
         except ValueError:
@@ -243,7 +263,10 @@ class HTTPProxy:
                     fut.result(timeout=1.0)
                     return True
                 except TimeoutError:
-                    fut.cancel()
+                    if not fut.cancel():
+                        # completed in the cancel window: the event IS
+                        # enqueued — re-submitting would duplicate a chunk
+                        return True
                 except Exception:
                     return False
             return False

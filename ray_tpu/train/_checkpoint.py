@@ -24,6 +24,26 @@ class Checkpoint:
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """Materialize a checkpoint from external storage (parity:
+        ``Checkpoint.from_uri``): the ``scheme://`` prefix downloads into a
+        local temp directory through the storage backend registry."""
+        from ray_tpu._private import external_storage as storage
+
+        dest = os.path.join(tempfile.gettempdir(), f"ckpt_dl_{uuid.uuid4().hex[:8]}")
+        files = storage.sync_uri_to_dir(uri, dest)
+        if not files:
+            raise FileNotFoundError(f"no checkpoint files under {uri}")
+        return cls(dest)
+
+    def to_uri(self, uri: str) -> str:
+        """Upload this checkpoint's directory to external storage."""
+        from ray_tpu._private import external_storage as storage
+
+        storage.sync_dir_to_uri(self.path, uri)
+        return uri
+
     def to_directory(self, path: Optional[str] = None) -> str:
         dest = path or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
         if os.path.abspath(dest) != self.path:

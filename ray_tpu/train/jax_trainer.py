@@ -116,8 +116,25 @@ class JaxTrainer:
         return wrapped
 
     def fit(self) -> Result:
+        from ray_tpu._private import external_storage as _xstorage
+
         name = self.run_config.name or f"JaxTrainer_{time.strftime('%Y%m%d_%H%M%S')}"
-        trial_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        storage_path = self.run_config.resolved_storage_path()
+        storage_uri = None
+        if _xstorage.has_scheme(storage_path) and not storage_path.startswith("file://"):
+            # external storage: train into a local staging dir, mirror each
+            # checkpoint out through the storage backend (parity: the
+            # reference's storage_path sync to FS/S3)
+            storage_uri = _xstorage.join(storage_path, name)
+            import tempfile
+
+            trial_dir = os.path.join(
+                tempfile.gettempdir(), f"ray_tpu_trial_{name}_{os.getpid()}"
+            )
+        elif storage_path.startswith("file://"):
+            trial_dir = os.path.join(storage_path[len("file://"):], name)
+        else:
+            trial_dir = os.path.join(storage_path, name)
         os.makedirs(trial_dir, exist_ok=True)
 
         executor = BackendExecutor(self.scaling_config, self.run_config, trial_dir)
@@ -130,10 +147,17 @@ class JaxTrainer:
                 last.update(metrics)
                 last["training_iteration"] = iteration
                 if ckpt_path:
+                    ckpt = Checkpoint(ckpt_path)
+                    if storage_uri is not None:
+                        uri = _xstorage.join(
+                            storage_uri, f"checkpoint_{iteration:06d}"
+                        )
+                        ckpt.to_uri(uri)
+                        ckpt._uploaded_uri = uri  # pruning removes it too
                     checkpoints.append(
                         (
                             {**metrics, "training_iteration": iteration},
-                            Checkpoint(ckpt_path),
+                            ckpt,
                         )
                     )
                     self._prune_checkpoints(checkpoints)
@@ -189,5 +213,16 @@ class JaxTrainer:
             del checkpoints[: -cfg.num_to_keep]
         import shutil
 
+        from ray_tpu._private import external_storage as _xstorage
+
         for _, ckpt in doomed:
             shutil.rmtree(ckpt.path, ignore_errors=True)
+            # num_to_keep governs the EXTERNAL copies too, or a long run
+            # accumulates every checkpoint in the backend
+            uri = getattr(ckpt, "_uploaded_uri", None)
+            if uri:
+                try:
+                    for key in _xstorage.list_uri(uri.rstrip("/") + "/"):
+                        _xstorage.delete(key)
+                except Exception:
+                    pass
