@@ -71,8 +71,19 @@ class RemoteDriverRuntime(WorkerRuntime):
                 config.object_store_memory,
             )
         else:
+            # same-machine attach shares the head's arena: the spill config
+            # must match the other clients of that arena
+            from ray_tpu._private import external_storage as _xstorage
+
             store = create_store_client(
-                info["shm_dir"], info["fallback_dir"], config.object_store_memory
+                info["shm_dir"],
+                info["fallback_dir"],
+                config.object_store_memory,
+                spill_uri=(
+                    config.spill_directory
+                    if _xstorage.has_scheme(config.spill_directory)
+                    else ""
+                ),
             )
         super().__init__(conn, WorkerID(info["worker_id"]), store, config)
         # unique put-id namespace per driver (workers get theirs per-task)

@@ -118,13 +118,16 @@ class NativeStoreClient(StorePutMixin):
         uri = storage.join(self._spill_uri, f"{oid.hex()}.obj")
         try:
             storage.write_bytes(uri, bytes(src))
+            # per-process tmp name: same-node clients can race on the same
+            # LRU victim, and losing that race must not fail the caller's
+            # put (the old local-spill path had the same tolerance)
+            tmp = f"{self._spill_marker(oid)}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                fh.write(uri)
+            os.replace(tmp, self._spill_marker(oid))
+            return True
         except Exception:
-            return False
-        tmp = self._spill_marker(oid) + ".tmp"
-        with open(tmp, "w") as fh:
-            fh.write(uri)
-        os.replace(tmp, self._spill_marker(oid))
-        return True
+            return os.path.exists(self._spill_marker(oid))
 
     def _external_spilled_uri(self, oid: ObjectID) -> Optional[str]:
         try:

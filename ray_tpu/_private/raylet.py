@@ -121,6 +121,8 @@ class NodeDaemon:
         cpu_total = self._total_resources.get("CPU", 1.0)
         self._lease_worker_cap = max(4, int(2 * cpu_total))
         self._lease_last_reap = time.monotonic()
+        # pending stack-dump aggregations: req_id -> {texts, expect, deadline}
+        self._stack_reqs: Dict[str, dict] = {}
 
     @staticmethod
     def _machine_id() -> str:
@@ -237,10 +239,27 @@ class NodeDaemon:
         # event loop (single-core boxes stall it for seconds under load), but
         # must still stop for a genuinely *hung* one — so each beat is gated
         # on the main loop having completed an iteration recently.
+        # Each beat carries the reporter stats (parity: reporter_agent.py:314
+        # pushing cpu/mem/store metrics to the dashboard head).
+        from ray_tpu._private.reporter import StatsCollector
+
+        collector = StatsCollector()
         while not self._stop:
             if time.monotonic() - self._loop_tick < self.LOOP_HUNG_S:
                 try:
-                    self._send(("heartbeat", time.monotonic()))
+                    stats = collector.collect(
+                        store=self.store,
+                        extra={
+                            "workers": len(self.workers),
+                            "lease_queued": len(self._lease_queue),
+                            "lease_running": len(self._lease_running),
+                            "pid": os.getpid(),
+                        },
+                    )
+                except Exception:
+                    stats = {}
+                try:
+                    self._send(("heartbeat", time.monotonic(), stats))
                 except (OSError, EOFError):
                     # connection down — the main loop handles re-attach;
                     # keep this thread alive to beat on the new conn
@@ -271,6 +290,8 @@ class NodeDaemon:
                     else:
                         self._drain_worker_pipe(r)
                 self._lease_tick()
+                if self._stack_reqs:
+                    self._flush_stack_reqs()
         finally:
             self._shutdown()
 
@@ -349,12 +370,43 @@ class NodeDaemon:
             except Exception:
                 pass
         elif kind == "dump_stacks":
+            # fan out to every worker process too (parity: py-spy dumping
+            # worker stacks, not just the agent's); replies aggregate in
+            # _stack_reqs and flush from the main loop tick
             from ray_tpu._private.profiling import format_thread_stacks
 
-            try:
-                self._send(("stacks", msg[1], format_thread_stacks()))
-            except (OSError, EOFError):
-                pass
+            req_id = msg[1]
+            entry = {
+                "texts": {"daemon": format_thread_stacks()},
+                "expect": 0,
+                "deadline": time.monotonic() + 3.0,
+            }
+            for wid, (proc, pipe) in list(self.workers.items()):
+                try:
+                    pipe.send(("dump_stacks", req_id))
+                    entry["expect"] += 1
+                except (OSError, EOFError, BrokenPipeError):
+                    pass
+            self._stack_reqs[req_id] = entry
+            self._flush_stack_reqs()
+        elif kind == "sample_stacks":
+            # py-spy-style sampling of the daemon process, off-thread so the
+            # event loop keeps running while we profile it
+            _, req_id, duration_s, interval_s = msg
+
+            def _sample():
+                from ray_tpu._private.reporter import sample_stacks
+
+                try:
+                    out = sample_stacks(float(duration_s), float(interval_s))
+                except Exception as e:  # noqa: BLE001
+                    out = {f"<sampling failed: {e!r}>": 1}
+                try:
+                    self._send(("stack_samples", req_id, out))
+                except (OSError, EOFError):
+                    pass
+
+            threading.Thread(target=_sample, daemon=True).start()
         elif kind == "exit":
             return False
         else:
@@ -392,6 +444,12 @@ class NodeDaemon:
         try:
             while pipe.poll(0):
                 msg = pipe.recv()
+                if msg[0] == "stacks_reply":
+                    # worker's answer to a fanned-out dump_stacks
+                    entry = self._stack_reqs.get(msg[1])
+                    if entry is not None:
+                        entry["texts"][f"worker-{wid.hex()[:8]}"] = msg[2]
+                    continue
                 if is_lease and msg[0] in (
                     "ready",
                     "task_done",
@@ -425,6 +483,24 @@ class NodeDaemon:
             self._send(("worker_died", wid.binary()))
         except (OSError, EOFError):
             pass
+
+    def _flush_stack_reqs(self) -> None:
+        """Send aggregated stack dumps whose workers all replied (or whose
+        deadline passed) back to the head."""
+        now = time.monotonic()
+        for req_id in list(self._stack_reqs):
+            entry = self._stack_reqs[req_id]
+            got = len(entry["texts"]) - 1  # minus the daemon's own
+            if got < entry["expect"] and now < entry["deadline"]:
+                continue
+            del self._stack_reqs[req_id]
+            text = "\n\n".join(
+                f"==== {name} ====\n{t}" for name, t in entry["texts"].items()
+            )
+            try:
+                self._send(("stacks", req_id, text))
+            except (OSError, EOFError):
+                pass
 
     # -- local task dispatch (parity: local_task_manager.cc:74) -----------
 

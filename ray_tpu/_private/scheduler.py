@@ -186,6 +186,8 @@ class NodeState:
     # read each other's stores through /dev/shm at shm_dir
     shm_dir: str = ""
     host_id: str = ""
+    # latest reporter metrics pushed on the node's heartbeat
+    stats: Dict[str, Any] = field(default_factory=dict)
     # resources held by head-leased tasks currently runnable at the node's
     # local dispatcher (subset of total - available); the node's lease
     # budget is available + lease_acquired = total - head-managed usage
@@ -647,6 +649,14 @@ class Scheduler:
             node = self.nodes.get(nid) if nid is not None else None
             if node is not None:
                 node.last_heartbeat = time.monotonic()
+                if len(msg) > 2 and msg[2]:
+                    node.stats = msg[2]  # reporter metrics ride the beat
+        elif kind == "stack_samples":
+            _, req_id, samples = msg
+            waiter = self._stack_waiters.get(req_id)
+            if waiter is not None:
+                waiter[1]["samples"] = samples
+                waiter[0].set()
         elif kind == "stacks":
             _, req_id, text = msg
             waiter = self._stack_waiters.get(req_id)
@@ -2604,6 +2614,8 @@ class Scheduler:
                     return ("ok", bytes(view))
             self._ensure_local(oid, self._node.head_node_id)
             return None
+        if op == "node_stats":
+            return self.node_stats()
         if op == "event_stats":
             # parity: event_stats.h handler instrumentation
             return {
@@ -2746,6 +2758,69 @@ class Scheduler:
             out[f"node-{nid.hex()[:12]}"] = (
                 box.get("text", "") if ok else "<no reply within timeout>"
             )
+        return out
+
+    def request_node_stack_samples(
+        self, duration_s: float = 2.0, interval_s: float = 0.01, timeout: float = 30.0
+    ) -> Dict[str, Dict[str, int]]:
+        """py-spy-style sampling profile of every node daemon: each samples
+        its own threads for ``duration_s`` and returns {stack: hit_count}
+        (the reporter agent's profiling endpoint, reporter_agent.py:314)."""
+        import uuid as _uuid
+
+        waiters = []
+        for conn, nid in list(self._daemon_conns.items()):
+            req_id = _uuid.uuid4().hex
+            ev = threading.Event()
+            box: Dict[str, Any] = {}
+            self._stack_waiters[req_id] = (ev, box)
+            try:
+                with self._daemon_send_locks[conn]:
+                    conn.send(("sample_stacks", req_id, duration_s, interval_s))
+            except (OSError, EOFError, KeyError):
+                self._stack_waiters.pop(req_id, None)
+                continue
+            waiters.append((nid, req_id, ev, box))
+        out: Dict[str, Dict[str, int]] = {}
+        deadline = time.monotonic() + duration_s + timeout
+        for nid, req_id, ev, box in waiters:
+            ok = ev.wait(max(0.0, deadline - time.monotonic()))
+            self._stack_waiters.pop(req_id, None)
+            out[f"node-{nid.hex()[:12]}"] = (
+                box.get("samples", {}) if ok else {"<no reply within timeout>": 1}
+            )
+        return out
+
+    def node_stats(self) -> Dict[str, dict]:
+        """Latest reporter metrics per node (heartbeat-pushed), plus the
+        head's own, collected on demand."""
+        from ray_tpu._private.reporter import StatsCollector
+
+        out: Dict[str, dict] = {}
+        now = time.monotonic()
+        for nid, node in list(self.nodes.items()):
+            if not node.alive:
+                continue
+            if node.daemon_conn is None and nid == self._node.head_node_id:
+                collector = getattr(self, "_head_stats_collector", None)
+                if collector is None:
+                    collector = self._head_stats_collector = StatsCollector()
+                stats = collector.collect(
+                    store=self._node.store_client,
+                    extra={"workers": len(self.workers), "pid": os.getpid()},
+                )
+                out[nid.hex()] = {"node": "head", **stats}
+            elif node.stats:
+                age = (
+                    round(now - node.last_heartbeat, 1)
+                    if node.last_heartbeat
+                    else None
+                )
+                out[nid.hex()] = {
+                    "node": nid.hex()[:12],
+                    "heartbeat_age_s": age,
+                    **node.stats,
+                }
         return out
 
     def _write_gcs_snapshot(self):

@@ -89,6 +89,15 @@ class WorkerRuntime:
                         q.put(msg)
                 elif kind == "exec":
                     self.exec_queue.put(msg[1])
+                elif kind == "dump_stacks":
+                    # reporter-agent stack dump (runs here on the reader
+                    # thread so a busy/blocked task thread still reports)
+                    from ray_tpu._private.profiling import format_thread_stacks
+
+                    try:
+                        self._send(("stacks_reply", msg[1], format_thread_stacks()))
+                    except (OSError, EOFError):
+                        pass
                 elif kind == "exit":
                     break
                 # unknown messages dropped

@@ -95,6 +95,26 @@ def start_dashboard(port: int = 8765) -> int:
 
                     jax.profiler.stop_trace()
                     body = {"status": "stopped"}
+                elif self.path == "/api/node_stats":
+                    # per-node reporter metrics (cpu/mem/store/workers),
+                    # pushed on heartbeats (reporter_agent.py:314 role)
+                    from ray_tpu._private.worker import get_driver
+
+                    body = get_driver().rpc("node_stats")
+                elif self.path.startswith("/api/profile"):
+                    # py-spy-style sampled stacks from every node daemon
+                    from urllib.parse import parse_qs, urlparse
+
+                    from ray_tpu._private.worker import get_driver
+
+                    q = parse_qs(urlparse(self.path).query)
+                    dur = float(q.get("duration", ["2.0"])[0])
+                    drv = get_driver()
+                    body = {}
+                    if drv is not None and hasattr(drv, "node"):
+                        body = drv.node.scheduler.request_node_stack_samples(
+                            duration_s=min(dur, 30.0)
+                        )
                 elif self.path == "/api/stacks":
                     # live thread stacks: driver + every node daemon (the
                     # reporter-agent py-spy role, reporter_agent.py:314)

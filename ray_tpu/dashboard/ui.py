@@ -55,8 +55,8 @@ PAGE = """<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const TABS = ["overview","tasks","actors","objects","placement_groups",
-              "serve","jobs","logs","event_stats","stacks"];
+const TABS = ["overview","node_stats","tasks","actors","objects","placement_groups",
+              "serve","jobs","logs","event_stats","stacks","profile"];
 let tab = location.hash.slice(1) || "overview";
 const $ = (id) => document.getElementById(id);
 
@@ -136,6 +136,32 @@ const RENDER = {
     return Object.entries(s).map(([proc, txt]) =>
       `<h2>${esc(proc)}</h2><pre>${esc(txt)}</pre>`).join("");
   },
+  async node_stats() {
+    // per-node reporter metrics (cpu/mem/object-store), heartbeat-pushed
+    const s = await j("/api/node_stats");
+    const rows = Object.entries(s).map(([nid, st]) => ({
+      node: st.node || nid.slice(0,12),
+      "cpu %": st.cpu_percent,
+      "rss MB": st.rss_bytes ? (st.rss_bytes/1e6).toFixed(1) : "",
+      "store MB": st.object_store_bytes ? (st.object_store_bytes/1e6).toFixed(1) : "0.0",
+      "mem avail GB": st.mem_available ? (st.mem_available/1e9).toFixed(2) : "",
+      workers: st.workers,
+      "lease q/run": (st.lease_queued??"") + "/" + (st.lease_running??""),
+      "hb age s": st.heartbeat_age_s ?? 0,
+    }));
+    return table(rows);
+  },
+  async profile() {
+    // py-spy-style sampled stacks across node daemons (2s capture)
+    $("main").innerHTML = "sampling node stacks for 2s\u2026";
+    const s = await j("/api/profile?duration=2");
+    return Object.entries(s).map(([node, counts]) => {
+      const total = Object.values(counts).reduce((a,b)=>a+b, 0) || 1;
+      const lines = Object.entries(counts).slice(0, 40).map(([stack, n]) =>
+        `${String(Math.round(100*n/total)).padStart(3)}%  ${esc(stack)}`);
+      return `<h2>${esc(node)}</h2><pre>${lines.join("\n")}</pre>`;
+    }).join("");
+  },
 };
 
 let timer = null;
@@ -148,7 +174,7 @@ async function refresh() {
     $("err").textContent = String(e);
   }
   clearTimeout(timer);
-  timer = setTimeout(refresh, tab === "stacks" ? 10000 : 2000);
+  timer = setTimeout(refresh, (tab === "stacks" || tab === "profile") ? 15000 : 2000);
 }
 nav();
 refresh();

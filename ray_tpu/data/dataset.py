@@ -693,7 +693,12 @@ def _write_block(block, ops, out_path: str, writer_blob):
 
     block = _apply_ops(block, ops)
     writer = cloudpickle.loads(writer_blob)
-    if storage.has_scheme(out_path):
+    if out_path.startswith("file://"):
+        # already local: write straight to the resolved path
+        local = storage.resolve(out_path)[1]
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        writer(block, local)
+    elif storage.has_scheme(out_path):
         # scheme'd target: stage locally, then hand the bytes to the backend
         import tempfile
 

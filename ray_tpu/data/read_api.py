@@ -107,6 +107,10 @@ def _local_copy(path: str):
     if not storage.has_scheme(path):
         yield path
         return
+    if path.startswith("file://"):
+        # already local: no point copying a multi-GB file through memory
+        yield storage.resolve(path)[1]
+        return
     import tempfile
 
     data = storage.read_bytes(path)

@@ -140,7 +140,12 @@ class HTTPProxy:
                 except ValueError:
                     return "bad-request"
                 if size == 0:
-                    await reader.readline()  # trailing CRLF
+                    # consume any trailer fields up to the final blank line,
+                    # or the leftovers desync the next keep-alive request
+                    while True:
+                        trailer = await reader.readline()
+                        if trailer in (b"\r\n", b"\n", b""):
+                            break
                     break
                 total += size
                 if total > _MAX_BODY:

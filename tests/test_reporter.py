@@ -1,0 +1,86 @@
+"""Per-node reporter agent: heartbeat-pushed stats, fanned-out stack dumps,
+py-spy-style sampling.
+
+Parity: ``python/ray/dashboard/modules/reporter/reporter_agent.py:314``.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=1)
+    c.wait_for_nodes()
+    yield c
+    c.shutdown()
+
+
+def _scheduler():
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().node.scheduler
+
+
+def test_heartbeat_carries_node_stats(cluster):
+    sch = _scheduler()
+    deadline = time.monotonic() + 30
+    stats = {}
+    while time.monotonic() < deadline:
+        stats = sch.node_stats()
+        daemon_rows = [v for v in stats.values() if v.get("node") != "head"]
+        if daemon_rows and "cpu_percent" in daemon_rows[0]:
+            break
+        time.sleep(0.3)
+    daemon_rows = [v for v in stats.values() if v.get("node") != "head"]
+    assert daemon_rows, stats
+    row = daemon_rows[0]
+    assert row["mem_total"] > 0
+    assert row["rss_bytes"] > 0
+    assert "object_store_bytes" in row
+    assert row["workers"] >= 0
+    assert row["heartbeat_age_s"] is not None and row["heartbeat_age_s"] < 10
+    # the head reports its own stats too
+    head_rows = [v for v in stats.values() if v.get("node") == "head"]
+    assert head_rows and head_rows[0]["mem_total"] > 0
+    # and the worker-facing rpc serves the same table
+    from ray_tpu._private.worker import get_runtime
+
+    assert get_runtime().rpc("node_stats") if hasattr(
+        get_runtime(), "rpc"
+    ) else True
+
+
+def test_stack_dump_includes_workers(cluster):
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(20)
+        return 1
+
+    ref = sleeper.remote()
+    time.sleep(2.0)  # let it start on the daemon node
+    sch = _scheduler()
+    stacks = sch.request_node_stacks(timeout=15)
+    assert stacks, "no node stacks returned"
+    text = "\n".join(stacks.values())
+    assert "==== daemon ====" in text
+    assert "worker-" in text, "worker stacks missing from the dump"
+    assert "sleeper" in text or "sleep" in text
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_stack_sampling_profile(cluster):
+    sch = _scheduler()
+    samples = sch.request_node_stack_samples(duration_s=0.6, interval_s=0.02)
+    assert samples, "no sampling results"
+    for node, counts in samples.items():
+        assert counts, f"{node} returned no samples"
+        # hottest-first dict of stack -> hit count
+        values = list(counts.values())
+        assert all(isinstance(v, int) and v >= 1 for v in values)
+        assert values == sorted(values, reverse=True)
