@@ -2730,42 +2730,12 @@ class Scheduler:
                     except (OSError, EOFError):
                         pass
 
-    def request_node_stacks(self, timeout: float = 5.0) -> Dict[str, str]:
-        """Per-daemon thread-stack dumps (dashboard /api/stacks; the role of
-        the reference's py-spy reporter agents). Called from an HTTP thread:
-        sends ride the per-conn locks, replies land on the scheduler loop.
-        """
-        import uuid as _uuid
-
-        waiters = []
-        for conn, nid in list(self._daemon_conns.items()):
-            req_id = _uuid.uuid4().hex
-            ev = threading.Event()
-            box: Dict[str, str] = {}
-            self._stack_waiters[req_id] = (ev, box)
-            try:
-                with self._daemon_send_locks[conn]:
-                    conn.send(("dump_stacks", req_id))
-            except (OSError, EOFError, KeyError):
-                self._stack_waiters.pop(req_id, None)
-                continue
-            waiters.append((nid, req_id, ev, box))
-        out: Dict[str, str] = {}
-        deadline = time.monotonic() + timeout
-        for nid, req_id, ev, box in waiters:
-            ok = ev.wait(max(0.0, deadline - time.monotonic()))
-            self._stack_waiters.pop(req_id, None)
-            out[f"node-{nid.hex()[:12]}"] = (
-                box.get("text", "") if ok else "<no reply within timeout>"
-            )
-        return out
-
-    def request_node_stack_samples(
-        self, duration_s: float = 2.0, interval_s: float = 0.01, timeout: float = 30.0
-    ) -> Dict[str, Dict[str, int]]:
-        """py-spy-style sampling profile of every node daemon: each samples
-        its own threads for ``duration_s`` and returns {stack: hit_count}
-        (the reporter agent's profiling endpoint, reporter_agent.py:314)."""
+    def _broadcast_and_wait(
+        self, msg_builder, box_key: str, timeout: float, missing_value
+    ) -> Dict[str, Any]:
+        """Send one request to every daemon (rides the per-conn locks) and
+        gather replies arriving on the scheduler loop via _stack_waiters.
+        ``msg_builder(req_id)`` produces the message."""
         import uuid as _uuid
 
         waiters = []
@@ -2776,20 +2746,43 @@ class Scheduler:
             self._stack_waiters[req_id] = (ev, box)
             try:
                 with self._daemon_send_locks[conn]:
-                    conn.send(("sample_stacks", req_id, duration_s, interval_s))
+                    conn.send(msg_builder(req_id))
             except (OSError, EOFError, KeyError):
                 self._stack_waiters.pop(req_id, None)
                 continue
             waiters.append((nid, req_id, ev, box))
-        out: Dict[str, Dict[str, int]] = {}
-        deadline = time.monotonic() + duration_s + timeout
+        out: Dict[str, Any] = {}
+        deadline = time.monotonic() + timeout
         for nid, req_id, ev, box in waiters:
             ok = ev.wait(max(0.0, deadline - time.monotonic()))
             self._stack_waiters.pop(req_id, None)
             out[f"node-{nid.hex()[:12]}"] = (
-                box.get("samples", {}) if ok else {"<no reply within timeout>": 1}
+                box.get(box_key, missing_value) if ok else missing_value
             )
         return out
+
+    def request_node_stacks(self, timeout: float = 5.0) -> Dict[str, str]:
+        """Per-daemon thread-stack dumps, workers included (dashboard
+        /api/stacks; the reference's py-spy reporter-agent role)."""
+        return self._broadcast_and_wait(
+            lambda req_id: ("dump_stacks", req_id),
+            "text",
+            timeout,
+            "<no reply within timeout>",
+        )
+
+    def request_node_stack_samples(
+        self, duration_s: float = 2.0, interval_s: float = 0.01, timeout: float = 30.0
+    ) -> Dict[str, Dict[str, int]]:
+        """py-spy-style sampling profile of every node daemon: each samples
+        its own threads for ``duration_s`` and returns {stack: hit_count}
+        (the reporter agent's profiling endpoint, reporter_agent.py:314)."""
+        return self._broadcast_and_wait(
+            lambda req_id: ("sample_stacks", req_id, duration_s, interval_s),
+            "samples",
+            duration_s + timeout,
+            {"<no reply within timeout>": 1},
+        )
 
     def node_stats(self) -> Dict[str, dict]:
         """Latest reporter metrics per node (heartbeat-pushed), plus the
@@ -2805,9 +2798,14 @@ class Scheduler:
                 collector = getattr(self, "_head_stats_collector", None)
                 if collector is None:
                     collector = self._head_stats_collector = StatsCollector()
+                head_workers = sum(
+                    1
+                    for w in self.workers.values()
+                    if w.node_id == self._node.head_node_id and w.state != "dead"
+                )
                 stats = collector.collect(
                     store=self._node.store_client,
-                    extra={"workers": len(self.workers), "pid": os.getpid()},
+                    extra={"workers": head_workers, "pid": os.getpid()},
                 )
                 out[nid.hex()] = {"node": "head", **stats}
             elif node.stats:

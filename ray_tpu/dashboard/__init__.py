@@ -22,6 +22,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 _server: Optional[ThreadingHTTPServer] = None
 
@@ -101,10 +102,9 @@ def start_dashboard(port: int = 8765) -> int:
                     from ray_tpu._private.worker import get_driver
 
                     body = get_driver().rpc("node_stats")
-                elif self.path.startswith("/api/profile"):
+                elif urlparse(self.path).path == "/api/profile":
                     # py-spy-style sampled stacks from every node daemon
-                    from urllib.parse import parse_qs, urlparse
-
+                    # (exact path match: /api/profiler/* must not land here)
                     from ray_tpu._private.worker import get_driver
 
                     q = parse_qs(urlparse(self.path).query)

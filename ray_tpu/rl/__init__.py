@@ -12,6 +12,13 @@ actors. In-tree algorithms: PPO (CartPole learning target: return >= 150,
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import CartPoleEnv, EnvSpec, make_env, register_env
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.offline import BC, MARWIL, BCConfig, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 
@@ -22,6 +29,12 @@ __all__ = [
     "IMPALAConfig",
     "DQN",
     "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "MultiAgentEnv",
+    "MultiAgentCartPole",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "BC",
     "BCConfig",
     "MARWIL",

@@ -1,0 +1,355 @@
+"""Multi-agent RL: env contract, multi-agent episode collection, and
+per-policy PPO learning.
+
+Parity: ``rllib/env/multi_agent_env.py`` (the dict-keyed env API with the
+``__all__`` termination sentinel), ``rllib/env/multi_agent_env_runner.py``
+(episode collection with a policy-mapping function), and the multi-RLModule
+learner (``rllib/core/rl_module/multi_rl_module.py``): each policy id owns
+its own module (params + optimizer state); one jitted update is shared
+across policies and applied per-policy to its own batch — the TPU-first
+shape of per-policy learner updates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import CartPoleEnv, make_env
+from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+
+
+class MultiAgentEnv:
+    """The multi-agent env contract (parity: ``MultiAgentEnv``):
+
+    * ``reset() -> (obs_dict, info_dict)`` keyed by agent id;
+    * ``step(action_dict) -> (obs, rewards, terminateds, truncateds, infos)``
+      where ``terminateds["__all__"]`` / ``truncateds["__all__"]`` end the
+      episode. Agents absent from ``obs`` need no action next step.
+    """
+
+    agents: List[str] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPoles, one per agent (the reference's own
+    multi-agent test env, ``rllib/examples/envs/classes/multi_agent/``).
+    The episode ends when EVERY agent's pole has fallen (or time caps)."""
+
+    def __init__(self, num_agents: int = 2, seed: Optional[int] = None):
+        self.agents = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {
+            aid: CartPoleEnv(seed=None if seed is None else seed + i)
+            for i, aid in enumerate(self.agents)
+        }
+        self.spec = CartPoleEnv.spec
+        self._done: Dict[str, bool] = {}
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs = {}
+        for i, (aid, env) in enumerate(self._envs.items()):
+            obs[aid], _ = env.reset(seed=None if seed is None else seed + i)
+        self._done = {aid: False for aid in self.agents}
+        return obs, {}
+
+    def step(self, action_dict: Dict[str, Any]):
+        obs, rewards, terms, truncs, infos = {}, {}, {}, {}, {}
+        for aid, action in action_dict.items():
+            if self._done.get(aid, True):
+                continue
+            o, r, term, trunc, info = self._envs[aid].step(int(action))
+            rewards[aid] = r
+            terms[aid] = term
+            truncs[aid] = trunc
+            infos[aid] = info
+            if term or trunc:
+                self._done[aid] = True
+            else:
+                obs[aid] = o
+        all_done = all(self._done.values())
+        terms["__all__"] = all_done and not any(truncs.values())
+        truncs["__all__"] = all_done and any(truncs.values())
+        return obs, rewards, terms, truncs, infos
+
+
+class _MultiAgentEpisodeCollector:
+    """Steps N multi-agent env copies, routing each agent through its
+    policy (parity: ``multi_agent_env_runner.py`` episode collection)."""
+
+    def __init__(self, env_creator, n_envs: int, policy_mapping_fn, seed: int):
+        self._envs = [env_creator(seed=seed + i) for i in range(n_envs)]
+        self._map = policy_mapping_fn
+        self._obs = [e.reset(seed=seed + i)[0] for i, e in enumerate(self._envs)]
+        self._returns = [dict() for _ in self._envs]
+        self.completed_returns: Dict[str, List[float]] = {}
+
+    def collect(self, act_fn, rollout_len: int) -> Dict[str, Dict[str, np.ndarray]]:
+        """``act_fn(policy_id, obs_batch) -> (actions, logp, values)``.
+        Returns per-policy batches of T-major transition arrays."""
+        # per policy: lists of transition dicts
+        steps: Dict[str, Dict[str, list]] = {}
+
+        def bucket(pid):
+            return steps.setdefault(
+                pid,
+                {k: [] for k in ("obs", "actions", "logp", "values", "rewards", "dones")},
+            )
+
+        for _ in range(rollout_len):
+            # group live (env_idx, agent_id) pairs by policy
+            by_policy: Dict[str, List[Tuple[int, str]]] = {}
+            for ei, obs in enumerate(self._obs):
+                for aid in obs:
+                    by_policy.setdefault(self._map(aid), []).append((ei, aid))
+            actions_per_env: List[Dict[str, int]] = [dict() for _ in self._envs]
+            pending = {}  # (ei, aid) -> (pid, action, logp, value)
+            for pid, pairs in by_policy.items():
+                batch = np.stack([self._obs[ei][aid] for ei, aid in pairs])
+                actions, logp, values = act_fn(pid, batch)
+                for j, (ei, aid) in enumerate(pairs):
+                    actions_per_env[ei][aid] = int(actions[j])
+                    pending[(ei, aid)] = (pid, batch[j], int(actions[j]),
+                                          float(logp[j]), float(values[j]))
+            for ei, env in enumerate(self._envs):
+                if not actions_per_env[ei]:
+                    continue
+                obs2, rewards, terms, truncs, _ = env.step(actions_per_env[ei])
+                for aid, act in actions_per_env[ei].items():
+                    pid, ob, a, lp, v = pending[(ei, aid)]
+                    done = terms.get(aid, False) or truncs.get(aid, False)
+                    b = bucket(pid)
+                    b["obs"].append(ob)
+                    b["actions"].append(a)
+                    b["logp"].append(lp)
+                    b["values"].append(v)
+                    b["rewards"].append(rewards.get(aid, 0.0))
+                    b["dones"].append(float(done))
+                    ret = self._returns[ei]
+                    ret[aid] = ret.get(aid, 0.0) + rewards.get(aid, 0.0)
+                if terms.get("__all__") or truncs.get("__all__"):
+                    for aid, total in self._returns[ei].items():
+                        self.completed_returns.setdefault(
+                            self._map(aid), []
+                        ).append(total)
+                    self._returns[ei] = {}
+                    obs2, _ = env.reset()
+                self._obs[ei] = obs2
+        return {
+            pid: {k: np.asarray(v, np.float32 if k != "actions" else np.int32)
+                  for k, v in b.items()}
+            for pid, b in steps.items()
+        }
+
+
+class MultiAgentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+        self.gae_lambda = 0.95
+        self.num_epochs = 8
+        self.minibatch_size = 512
+        self.grad_clip = 0.5
+        self.policies: List[str] = []
+        self.policy_mapping_fn: Callable[[str], str] = lambda aid: aid
+
+    def multi_agent(
+        self,
+        policies: List[str],
+        policy_mapping_fn: Optional[Callable[[str], str]] = None,
+    ) -> "MultiAgentPPOConfig":
+        """Parity: ``AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...)``."""
+        self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO(Algorithm):
+    """PPO with one module per policy id (parity: MultiRLModule + the
+    multi-agent learner path)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        super().__init__(config)
+        import jax
+        import optax
+
+        if not config.policies:
+            raise ValueError("use .multi_agent(policies=[...]) first")
+        probe = make_env(config.env) if not callable(config.env) else config.env()
+        spec = probe.spec
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip), optax.adam(config.lr)
+        )
+        # per-policy modules: independent params + optimizer state
+        self.params: Dict[str, Any] = {}
+        self.opt_states: Dict[str, Any] = {}
+        for i, pid in enumerate(config.policies):
+            p = init_mlp_policy(
+                jax.random.PRNGKey(config.seed + i),
+                spec.obs_dim,
+                spec.num_actions,
+                config.hidden,
+            )
+            self.params[pid] = p
+            self.opt_states[pid] = self.optimizer.init(p)
+        self._update = jax.jit(self._make_update())
+        self._act = jax.jit(lambda p, o: apply_mlp_policy(p, o))
+        def _create(seed=None):
+            if callable(config.env):
+                try:
+                    return config.env(seed=seed)
+                except TypeError:
+                    return config.env()
+            return make_env(config.env, seed=seed)
+
+        self._collector = _MultiAgentEpisodeCollector(
+            _create,
+            config.num_envs_per_runner,
+            config.policy_mapping_fn,
+            config.seed,
+        )
+        self._rng = np.random.default_rng(config.seed)
+        self._timesteps = 0
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        optimizer = self.optimizer
+
+        def loss_fn(params, batch):
+            logits, values = apply_mlp_policy(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv,
+            )
+            pi_loss = -jnp.mean(surr)
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            return pi_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    def _act_fn(self, pid: str, obs: np.ndarray):
+        # pad to a power-of-two batch so jit compiles O(log n) programs, not
+        # one per distinct live-agent count (agents die at arbitrary steps)
+        n = len(obs)
+        padded = 1 << (n - 1).bit_length() if n > 1 else 1
+        if padded != n:
+            obs = np.concatenate([obs, np.zeros((padded - n,) + obs.shape[1:], obs.dtype)])
+        logits, values = self._act(self.params[pid], obs)
+        logits = np.asarray(logits)[:n]
+        values = np.asarray(values)[:n]
+        # sample from the categorical policy
+        u = self._rng.gumbel(size=logits.shape)
+        actions = np.argmax(logits + u, axis=1)
+        logp_all = logits - np.log(np.exp(logits).sum(axis=1, keepdims=True))
+        logp = np.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        return actions, logp, np.asarray(values)
+
+    def _gae_flat(self, b: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Per-policy GAE over the flat transition stream: the stream is
+        time-major per (env, agent) lane interleaved, so we treat each
+        transition's ``done`` as the episode boundary in a single pass."""
+        cfg = self.config
+        rewards, values, dones = b["rewards"], b["values"], b["dones"]
+        n = len(rewards)
+        adv = np.zeros(n, np.float32)
+        last_adv = 0.0
+        next_value = 0.0
+        for t in reversed(range(n)):
+            nonterminal = 1.0 - dones[t]
+            delta = rewards[t] + cfg.gamma * next_value * nonterminal - values[t]
+            last_adv = delta + cfg.gamma * cfg.gae_lambda * nonterminal * last_adv
+            adv[t] = last_adv
+            next_value = values[t]
+        returns = adv + values
+        return {
+            "obs": b["obs"],
+            "actions": b["actions"].astype(np.int32),
+            "logp_old": b["logp"],
+            "advantages": (adv - adv.mean()) / (adv.std() + 1e-8),
+            "returns": returns,
+        }
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        per_policy = self._collector.collect(self._act_fn, cfg.rollout_len)
+        metrics: Dict[str, Any] = {}
+        for pid, raw in per_policy.items():
+            batch = self._gae_flat(raw)
+            n = len(batch["obs"])
+            self._timesteps += n
+            loss = 0.0
+            mb = min(cfg.minibatch_size, 256)  # constant => ONE compiled update
+            for _ in range(cfg.num_epochs):
+                perm = self._rng.permutation(n)
+                for start in range(0, n, mb):
+                    idx = perm[start : start + mb]
+                    if len(idx) < mb:
+                        # pad the ragged tail with resampled rows so every
+                        # minibatch shares the compiled shape
+                        idx = np.concatenate(
+                            [idx, self._rng.integers(0, n, mb - len(idx))]
+                        )
+                    mini = {k: v[idx] for k, v in batch.items()}
+                    self.params[pid], self.opt_states[pid], loss = self._update(
+                        self.params[pid], self.opt_states[pid], mini
+                    )
+            metrics[f"{pid}/loss"] = float(loss)
+        returns_all: List[float] = []
+        for pid, rets in self._collector.completed_returns.items():
+            rets[:] = rets[-100:]
+            if rets:
+                metrics[f"{pid}/episode_return_mean"] = float(np.mean(rets))
+                returns_all.extend(rets)
+        metrics["episode_return_mean"] = (
+            float(np.mean(returns_all)) if returns_all else 0.0
+        )
+        metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        return metrics
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": {
+                pid: jax.tree.map(np.asarray, p) for pid, p in self.params.items()
+            },
+            "timesteps": self._timesteps,
+        }
+
+    def set_state(self, state):
+        self.params.update(state["params"])
+        self._timesteps = state.get("timesteps", 0)
+
+    def stop(self):
+        pass

@@ -1,0 +1,225 @@
+"""SAC (discrete actions): maximum-entropy off-policy RL.
+
+Parity: ``rllib/algorithms/sac/`` — twin soft Q networks with polyak target
+tracking, a stochastic (categorical) actor, and auto-tuned entropy
+temperature alpha. Discrete-action formulation per the public soft
+actor-critic literature (exact expectations over the action simplex instead
+of the reparameterization trick). TPU-native: actor + both critics + alpha
+update in ONE jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import _ReplayBuffer
+from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.models import apply_mlp_policy, init_mlp_policy
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.buffer_size = 50_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 128
+        self.tau = 0.01  # polyak coefficient for target critics
+        self.target_entropy_fraction = 0.7  # of max entropy log(|A|)
+        self.initial_alpha = 0.2
+        self.updates_per_iter = 64
+        self.steps_per_iter = 512
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    def __init__(self, config: SACConfig):
+        super().__init__(config)
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        probe = make_env(config.env)
+        spec = probe.spec
+        self._n_actions = spec.num_actions
+        key = jax.random.PRNGKey(config.seed)
+        k_actor, k_q1, k_q2 = jax.random.split(key, 3)
+        # actor: logits head; critics: the pi head doubles as per-action Q
+        self.actor = init_mlp_policy(k_actor, spec.obs_dim, spec.num_actions, config.hidden)
+        self.q1 = init_mlp_policy(k_q1, spec.obs_dim, spec.num_actions, config.hidden)
+        self.q2 = init_mlp_policy(k_q2, spec.obs_dim, spec.num_actions, config.hidden)
+        self.q1_target = self.q1
+        self.q2_target = self.q2
+        self.log_alpha = jnp.log(jnp.asarray(config.initial_alpha, jnp.float32))
+        self.actor_opt = optax.adam(config.lr)
+        self.q_opt = optax.adam(config.lr)
+        self.alpha_opt = optax.adam(config.alpha_lr)
+        self.actor_state = self.actor_opt.init(self.actor)
+        self.q1_state = self.q_opt.init(self.q1)
+        self.q2_state = self.q_opt.init(self.q2)
+        self.alpha_state = self.alpha_opt.init(self.log_alpha)
+        self._target_entropy = config.target_entropy_fraction * float(
+            np.log(spec.num_actions)
+        )
+        self._update = jax.jit(self._make_update())
+        self._policy_logits = jax.jit(lambda p, o: apply_mlp_policy(p, o)[0])
+        self.envs = VectorEnv(config.env, config.num_envs_per_runner, seed=config.seed)
+        self._obs = self.envs.reset()
+        self.buffer = _ReplayBuffer(config.buffer_size, spec.obs_dim)
+        self._rng = np.random.default_rng(config.seed)
+        self._timesteps = 0
+        self._episode_returns: List[float] = []
+        self._running_returns = np.zeros(config.num_envs_per_runner, np.float32)
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        target_entropy = self._target_entropy
+
+        def pi_stats(actor, obs):
+            logits = apply_mlp_policy(actor, obs)[0]
+            logp = jax.nn.log_softmax(logits)
+            return jnp.exp(logp), logp
+
+        def q_loss_fn(q_params, target, batch):
+            q = apply_mlp_policy(q_params, batch["obs"])[0]
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            return jnp.mean((q_taken - target) ** 2)
+
+        def actor_loss_fn(actor, q1, q2, alpha, obs):
+            probs, logp = pi_stats(actor, obs)
+            qmin = jnp.minimum(
+                apply_mlp_policy(q1, obs)[0], apply_mlp_policy(q2, obs)[0]
+            )
+            # E_a~pi [ alpha*logpi - Q ], exact over the simplex
+            loss = jnp.mean(jnp.sum(probs * (alpha * logp - qmin), axis=1))
+            entropy = -jnp.mean(jnp.sum(probs * logp, axis=1))
+            return loss, entropy
+
+        def update(state, batch):
+            (actor, q1, q2, q1_t, q2_t, log_alpha,
+             actor_st, q1_st, q2_st, alpha_st) = state
+            alpha = jnp.exp(log_alpha)
+            # soft targets: r + gamma * E_a'~pi [ Qmin_target - alpha*logpi ]
+            probs_next, logp_next = pi_stats(actor, batch["next_obs"])
+            qmin_next = jnp.minimum(
+                apply_mlp_policy(q1_t, batch["next_obs"])[0],
+                apply_mlp_policy(q2_t, batch["next_obs"])[0],
+            )
+            v_next = jnp.sum(probs_next * (qmin_next - alpha * logp_next), axis=1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * v_next
+            target = jax.lax.stop_gradient(target)
+
+            q1_l, q1_g = jax.value_and_grad(q_loss_fn)(q1, target, batch)
+            q2_l, q2_g = jax.value_and_grad(q_loss_fn)(q2, target, batch)
+            up1, q1_st = self.q_opt.update(q1_g, q1_st, q1)
+            q1 = optax.apply_updates(q1, up1)
+            up2, q2_st = self.q_opt.update(q2_g, q2_st, q2)
+            q2 = optax.apply_updates(q2, up2)
+
+            (a_l, entropy), a_g = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                actor, q1, q2, alpha, batch["obs"]
+            )
+            upa, actor_st = self.actor_opt.update(a_g, actor_st, actor)
+            actor = optax.apply_updates(actor, upa)
+
+            # temperature: drive entropy toward the target
+            def alpha_loss_fn(log_a):
+                return jnp.exp(log_a) * jax.lax.stop_gradient(
+                    entropy - target_entropy
+                )
+
+            al_l, al_g = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+            upal, alpha_st = self.alpha_opt.update(al_g, alpha_st, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, upal)
+
+            # polyak-track the target critics
+            q1_t = jax.tree.map(lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, q1_t, q1)
+            q2_t = jax.tree.map(lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, q2_t, q2)
+            new_state = (actor, q1, q2, q1_t, q2_t, log_alpha,
+                         actor_st, q1_st, q2_st, alpha_st)
+            metrics = {
+                "q1_loss": q1_l,
+                "q2_loss": q2_l,
+                "actor_loss": a_l,
+                "entropy": entropy,
+                "alpha": alpha,
+            }
+            return new_state, metrics
+
+        return update
+
+    def _state_tuple(self):
+        return (self.actor, self.q1, self.q2, self.q1_target, self.q2_target,
+                self.log_alpha, self.actor_state, self.q1_state, self.q2_state,
+                self.alpha_state)
+
+    def _set_state_tuple(self, s):
+        (self.actor, self.q1, self.q2, self.q1_target, self.q2_target,
+         self.log_alpha, self.actor_state, self.q1_state, self.q2_state,
+         self.alpha_state) = s
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n_envs = cfg.num_envs_per_runner
+        metrics: Dict[str, Any] = {}
+        for _ in range(max(1, cfg.steps_per_iter // n_envs)):
+            logits = np.asarray(self._policy_logits(self.actor, self._obs))
+            u = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + u, axis=1)  # sample from pi
+            next_obs, rewards, dones = self.envs.step(actions)
+            self.buffer.add_batch(self._obs, actions, rewards, next_obs, dones)
+            self._running_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._episode_returns.append(float(self._running_returns[i]))
+                    self._running_returns[i] = 0.0
+            self._obs = next_obs
+            self._timesteps += n_envs
+        if self.buffer.size >= cfg.learning_starts:
+            state = self._state_tuple()
+            for _ in range(cfg.updates_per_iter):
+                batch = self.buffer.sample(self._rng, cfg.train_batch_size)
+                state, metrics = self._update(state, batch)
+            self._set_state_tuple(state)
+        self._episode_returns = self._episode_returns[-100:]
+        return {
+            "episode_return_mean": float(np.mean(self._episode_returns))
+            if self._episode_returns else 0.0,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_state(self):
+        import jax
+
+        return {
+            "actor": jax.tree.map(np.asarray, self.actor),
+            "q1": jax.tree.map(np.asarray, self.q1),
+            "q2": jax.tree.map(np.asarray, self.q2),
+            "log_alpha": np.asarray(self.log_alpha),
+            "timesteps": self._timesteps,
+        }
+
+    def set_state(self, state):
+        self.actor = state["actor"]
+        self.q1 = state["q1"]
+        self.q2 = state["q2"]
+        self.q1_target = state["q1"]
+        self.q2_target = state["q2"]
+        self.log_alpha = state["log_alpha"]
+        self._timesteps = state.get("timesteps", 0)
+
+    def stop(self):
+        pass

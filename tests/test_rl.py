@@ -195,3 +195,83 @@ def test_marwil_trains(ray_start_regular):
     for _ in range(10):
         last = algo.train()["total_loss"]
     assert last < first
+
+
+def test_sac_learns_cartpole():
+    """Discrete SAC (twin soft critics + auto-tuned alpha) reaches the
+    tuned-example CartPole threshold (parity: rllib/algorithms/sac)."""
+    from ray_tpu.rl import SACConfig
+
+    cfg = (
+        SACConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(150):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 150:
+            break
+    assert best >= 150, f"SAC failed to reach 150 (best {best})"
+    # the temperature is live (alpha adapted away from its initial value)
+    assert result["alpha"] > 0
+
+
+def test_multi_agent_env_contract():
+    from ray_tpu.rl import MultiAgentCartPole
+
+    env = MultiAgentCartPole(num_agents=3, seed=0)
+    obs, _ = env.reset()
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    obs, rewards, terms, truncs, _ = env.step({a: 0 for a in obs})
+    assert set(rewards) == {"agent_0", "agent_1", "agent_2"}
+    assert "__all__" in terms and "__all__" in truncs
+    # drive until every pole falls: __all__ flips exactly then
+    for _ in range(600):
+        if terms["__all__"] or truncs["__all__"]:
+            break
+        obs, rewards, terms, truncs, _ = env.step({a: 0 for a in obs})
+    assert terms["__all__"] or truncs["__all__"]
+
+
+def test_multi_agent_ppo_two_policies_learn():
+    """Two independent policies (one per agent via policy_mapping_fn) both
+    learn CartPole through the per-policy learner (parity:
+    multi_agent_env_runner + MultiRLModule)."""
+    from ray_tpu.rl import MultiAgentCartPole, MultiAgentPPOConfig
+
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(lambda seed=None: MultiAgentCartPole(num_agents=2, seed=seed))
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=1e-3)
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda aid: "p0" if aid == "agent_0" else "p1",
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    best = {"p0": 0.0, "p1": 0.0}
+    for _ in range(400):
+        result = algo.train()
+        for p in best:
+            best[p] = max(best[p], result.get(f"{p}/episode_return_mean", 0.0))
+        if all(v >= 150.0 for v in best.values()):
+            break
+    assert all(v >= 150.0 for v in best.values()), f"policies stalled: {best}"
+    # the two policies are genuinely distinct modules with distinct weights
+    import jax
+    import numpy as np
+
+    state = algo.get_state()
+    assert state["params"].keys() == {"p0", "p1"}
+    p0_leaves = jax.tree.leaves(state["params"]["p0"])
+    p1_leaves = jax.tree.leaves(state["params"]["p1"])
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(p0_leaves, p1_leaves)
+    ), "p0 and p1 share identical weights"
