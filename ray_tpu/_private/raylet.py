@@ -118,6 +118,8 @@ class NodeDaemon:
         self._lease_done_buf: list = []
         self._lease_started_buf: list = []
         self._lease_idle_since: Dict[WorkerID, float] = {}
+        # highest lease-batch epoch received (acked on heartbeats)
+        self._lease_epoch = 0
         cpu_total = self._total_resources.get("CPU", 1.0)
         self._lease_worker_cap = max(4, int(2 * cpu_total))
         self._lease_last_reap = time.monotonic()
@@ -186,6 +188,7 @@ class NodeDaemon:
         self._lease_done_buf.clear()
         self._lease_started_buf.clear()
         self._lease_idle_since.clear()
+        self._lease_epoch = 0
         self._lease_budget = dict(self._total_resources)
         deadline = time.monotonic() + float(
             getattr(self.config, "daemon_reconnect_timeout_s", 60.0)
@@ -253,6 +256,7 @@ class NodeDaemon:
                             "workers": len(self.workers),
                             "lease_queued": len(self._lease_queue),
                             "lease_running": len(self._lease_running),
+                            "lease_epoch": self._lease_epoch,
                             "pid": os.getpid(),
                         },
                     )
@@ -328,8 +332,12 @@ class NodeDaemon:
                 except Exception:
                     pass
         elif kind == "lease_tasks":
-            # a block of placed normal tasks; FIFO through the local ledger
+            # a block of placed normal tasks; FIFO through the local ledger.
+            # The epoch is acked on heartbeats AFTER the extend, so an ack
+            # proves this batch is queued (the head's reconciler fences on it)
             self._lease_queue.extend(msg[1])
+            if len(msg) > 2:
+                self._lease_epoch = max(self._lease_epoch, int(msg[2]))
         elif kind == "lease_cancel":
             self._lease_cancel(msg[1], msg[2])
         elif kind == "lease_revoke":

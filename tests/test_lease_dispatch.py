@@ -215,3 +215,35 @@ def test_no_resource_leak_under_steal_churn(cluster):
                 f"leaked {k} on node {n['node_id'][:8]}: "
                 f"{n['available'][k]} != {total}"
             )
+
+
+def test_lost_lease_batch_reconciles(cluster, monkeypatch):
+    """A lease_tasks batch that vanishes between head and daemon (conn
+    churn) must be detected by the heartbeat reconciler and requeued —
+    without burning the task's retry budget. A 50-node drain wedged
+    permanently on exactly this failure mode."""
+    from ray_tpu._private.worker import get_runtime
+
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    sch = get_runtime().node.scheduler
+    monkeypatch.setattr(type(sch), "RECONCILE_GRACE_S", 3.0)
+
+    real_send = sch._daemon_send
+    dropped = {"n": 0}
+
+    def lossy_send(node, msg):
+        if msg[0] == "lease_tasks" and dropped["n"] == 0:
+            dropped["n"] += 1
+            return True  # swallowed: the daemon never sees the batch
+        return real_send(node, msg)
+
+    monkeypatch.setattr(sch, "_daemon_send", lossy_send)
+
+    @ray_tpu.remote(max_retries=0)  # reconcile must NOT consume retries
+    def task():
+        return "healed"
+
+    ref = task.remote()
+    assert ray_tpu.get(ref, timeout=120) == "healed"
+    assert dropped["n"] == 1, "the loss was never injected"
