@@ -90,37 +90,9 @@ def main():
     # warm the worker pool so spawn latency isn't measured
     ray_tpu.get([_noop.remote() for _ in range(20)], timeout=60)
 
-    # --- puts / gets (plasma path: value large enough to hit the store) ---
-    small = np.zeros(16 * 1024 // 8)  # 16 KiB, forced out of inline path? no:
-    # inline limit is 100 KiB; use 200 KiB so puts exercise the shm store
-    arr = np.zeros(200 * 1024 // 8)
-
-    name, v = timeit(
-        "single_client_put_calls", lambda: ray_tpu.put(arr), duration=duration
-    )
-    rows.append(report(name, v))
-
-    ref = ray_tpu.put(arr)
-    name, v = timeit(
-        "single_client_get_calls",
-        lambda: ray_tpu.get(ref, timeout=60),
-        duration=duration,
-    )
-    rows.append(report(name, v))
-
-    big = np.zeros(1024 * 1024 * 128 // 8)  # 128 MiB of float64
-    gib = big.nbytes / 1024**3
-
-    def put_big():
-        r = ray_tpu.put(big)
-        del r
-
-    name, v = timeit(
-        "single_client_put_gigabytes", put_big, multiplier=gib, duration=duration
-    )
-    rows.append(report(name, v, unit="GiB/s"))
-
-    # --- tasks ---
+    # --- tasks --- (before the multi-GB object phases: on small hosts
+    # the 128MiB put churn triggers OS memory-compaction stalls that
+    # contaminate the latency-sensitive sync shapes measured after it)
     name, v = timeit(
         "single_client_tasks_sync",
         lambda: ray_tpu.get(_noop.remote(), timeout=60),
@@ -168,6 +140,36 @@ def main():
         "actor_calls_n_n_async", actors_nn, multiplier=25 * n, duration=duration
     )
     rows.append(report(name, v))
+
+    # --- puts / gets (plasma path: value large enough to hit the store) ---
+    small = np.zeros(16 * 1024 // 8)  # 16 KiB, forced out of inline path? no:
+    # inline limit is 100 KiB; use 200 KiB so puts exercise the shm store
+    arr = np.zeros(200 * 1024 // 8)
+
+    name, v = timeit(
+        "single_client_put_calls", lambda: ray_tpu.put(arr), duration=duration
+    )
+    rows.append(report(name, v))
+
+    ref = ray_tpu.put(arr)
+    name, v = timeit(
+        "single_client_get_calls",
+        lambda: ray_tpu.get(ref, timeout=60),
+        duration=duration,
+    )
+    rows.append(report(name, v))
+
+    big = np.zeros(1024 * 1024 * 128 // 8)  # 128 MiB of float64
+    gib = big.nbytes / 1024**3
+
+    def put_big():
+        r = ray_tpu.put(big)
+        del r
+
+    name, v = timeit(
+        "single_client_put_gigabytes", put_big, multiplier=gib, duration=duration
+    )
+    rows.append(report(name, v, unit="GiB/s"))
 
     geo = 1.0
     cnt = 0

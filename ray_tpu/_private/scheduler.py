@@ -1452,7 +1452,11 @@ class Scheduler:
         # rest of the scan is O(pending) of guaranteed failures, turning the
         # whole drain into O(pending^2). Heterogeneous stragglers that a
         # capped scan skips are picked up by the periodic full rescan.
-        fail_cap = None if periodic else 32
+        # the periodic rescan is bounded too: scanning a 100k-deep queue
+        # against a saturated fleet is O(pending x nodes) of guaranteed
+        # placement failures every 0.5s — it crushed 16-50-node drains.
+        # Rotation (below) still gives stragglers eventual coverage.
+        fail_cap = 256 if periodic else 32
         if periodic:
             self._last_full_dispatch = now_d
         deferred = []
@@ -1468,13 +1472,18 @@ class Scheduler:
                 if not placed:
                     deferred.append(task_id)
                     consecutive_fails += 1
-                    if fail_cap is not None and consecutive_fails >= fail_cap:
+                    if consecutive_fails >= fail_cap:
                         break
                 else:
                     consecutive_fails = 0
         finally:
             self._pick_cache = None
         self._pending.extendleft(reversed(deferred))
+        if periodic and consecutive_fails >= fail_cap and len(self._pending) > fail_cap:
+            # start the next periodic scan deeper in: a straggler whose
+            # demand only SOME node satisfies is found within
+            # O(pending / fail_cap) periods instead of never
+            self._pending.rotate(-fail_cap)
         self._flush_lease_batches()
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
