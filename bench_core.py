@@ -142,8 +142,7 @@ def main():
     rows.append(report(name, v))
 
     # --- puts / gets (plasma path: value large enough to hit the store) ---
-    small = np.zeros(16 * 1024 // 8)  # 16 KiB, forced out of inline path? no:
-    # inline limit is 100 KiB; use 200 KiB so puts exercise the shm store
+    # inline limit is 100 KiB: 200 KiB puts exercise the shm store
     arr = np.zeros(200 * 1024 // 8)
 
     name, v = timeit(

@@ -1461,12 +1461,14 @@ class Scheduler:
             self._last_full_dispatch = now_d
         deferred = []
         consecutive_fails = 0
+        task_id = None
         self._pick_cache = {}
         try:
             while self._pending:
                 task_id = self._pending.popleft()
                 rec = self.tasks.get(task_id)
                 if rec is None or rec.state not in ("PENDING",):
+                    task_id = None
                     continue
                 placed = self._try_dispatch(rec)
                 if not placed:
@@ -1476,9 +1478,14 @@ class Scheduler:
                         break
                 else:
                     consecutive_fails = 0
+                task_id = None
         finally:
             self._pick_cache = None
-        self._pending.extendleft(reversed(deferred))
+            # an exception from _try_dispatch must not orphan the popped
+            # task or the deferred scan — losing them wedges the drain
+            if task_id is not None and task_id not in deferred:
+                deferred.append(task_id)
+            self._pending.extendleft(reversed(deferred))
         if periodic and consecutive_fails >= fail_cap and len(self._pending) > fail_cap:
             # start the next periodic scan deeper in: a straggler whose
             # demand only SOME node satisfies is found within

@@ -83,7 +83,6 @@ def start_dashboard(port: int = 8765) -> int:
                     # device-trace capture (parity role: the reporter agent's
                     # py-spy/memray profiling endpoints; on TPU the profile of
                     # record is jax.profiler's XPlane trace)
-                    from urllib.parse import parse_qs, urlparse
 
                     import jax
 
