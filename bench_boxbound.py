@@ -1,0 +1,130 @@
+"""Discriminator: is the residual scale falloff the head's single Python
+thread, or the 1-core box?
+
+Method (the in-process experiment VERDICT r4 #2 asked for): run the queued-
+task drain at two fleet sizes and sample, around each drain,
+
+* the scheduler loop thread's OWN cpu-seconds vs wall-seconds (its busy
+  fraction — a saturated single thread reads ~1.0), via the ``__loop__``
+  entry of the ``event_stats`` rpc (CLOCK_THREAD_CPUTIME_ID read on the
+  loop thread);
+* the whole PROCESS cpu-seconds (loop + pump + fetch threads);
+* the machine's 1-minute load average (how many runnable processes contend
+  for the single core).
+
+Interpretation: if the falloff were the head thread, its busy fraction
+would pin near 1.0 at 50 nodes. If the box is the limit, the loop idles
+while load explodes — the daemons/workers eat the core.
+
+Emits one JSON line per measurement; the driver commits stdout as
+BOXBOUND_r05.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import ray_tpu  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+from ray_tpu._private.worker import get_runtime  # noqa: E402
+
+
+def emit(**row):
+    print(json.dumps(row), flush=True)
+
+
+def loop_clock():
+    st = get_runtime().rpc("event_stats")["__loop__"]
+    return st["cpu_s"], st["wall_s"]
+
+
+def proc_cpu():
+    r = os.times()
+    return r.user + r.system
+
+
+@ray_tpu.remote
+def _noop(i):
+    return i
+
+
+def drain(n_tasks: int, label: str):
+    cpu0, wall0 = loop_clock()
+    pcpu0 = proc_cpu()
+    t0 = time.perf_counter()
+    refs = [_noop.remote(i) for i in range(n_tasks)]
+    submit_dt = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=3600)
+    dt = time.perf_counter() - t0
+    assert len(out) == n_tasks
+    cpu1, wall1 = loop_clock()
+    pcpu1 = proc_cpu()
+    loop_busy = (cpu1 - cpu0) / max(1e-9, wall1 - wall0)
+    emit(
+        metric=f"boxbound_{label}",
+        drain_rate=round(n_tasks / dt, 1),
+        submit_rate=round(n_tasks / submit_dt, 1),
+        loop_busy_fraction=round(loop_busy, 4),
+        head_process_cpu_fraction=round((pcpu1 - pcpu0) / dt, 3),
+        load_1m=round(os.getloadavg()[0], 1),
+        unit="tasks/s",
+    )
+    return loop_busy
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tasks", type=int, default=20_000)
+    ap.add_argument("--small", type=int, default=8)
+    ap.add_argument("--large", type=int, default=50)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    if args.quick:
+        args.tasks, args.large = 4_000, 16
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        for n in range(args.small):
+            cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        time.sleep(2)
+        drain(args.tasks // 4, "warm")  # worker pools up
+        busy_small = drain(args.tasks, f"{args.small}nodes")
+
+        for n in range(args.large - args.small):
+            cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        time.sleep(2)
+        busy_large = drain(args.tasks, f"{args.large}nodes")
+
+        verdict = (
+            "head-thread-bound"
+            if busy_large > 0.85
+            else ("box-bound" if busy_large < 0.6 else "mixed")
+        )
+        emit(
+            metric="boxbound_verdict",
+            value=verdict,
+            loop_busy_small=round(busy_small, 3),
+            loop_busy_large=round(busy_large, 3),
+            cores=os.cpu_count(),
+            note=(
+                "loop_busy_fraction is the scheduler thread's cpu/wall during "
+                "the drain; near 1.0 = the single head thread is the "
+                "bottleneck, well below 1.0 with high load_1m = the core is "
+                "oversubscribed by the fleet's own processes (box-bound)"
+            ),
+        )
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -27,14 +27,17 @@ from ray_tpu.cluster_utils import Cluster
 
 
 def emit(metric, value, unit, reference=None):
+    scalar = isinstance(value, (int, float))
     print(
         json.dumps(
             {
                 "metric": metric,
-                "value": round(value, 3),
+                "value": round(value, 3) if scalar else value,
                 "unit": unit,
                 "reference": reference,
-                "ratio": round(value / reference, 4) if reference else None,
+                "ratio": (
+                    round(value / reference, 4) if reference and scalar else None
+                ),
             }
         ),
         flush=True,
@@ -134,8 +137,10 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
     )
     dt = time.perf_counter() - t0
     assert len(out) == n_nodes
+    # metric name matches the committed BENCH_SCALE.jsonl artifact
+    # ("..._{n}nodes_..."): one reader task is pinned per daemon node
     emit(
-        f"scale_broadcast_{mib}mib_{n_nodes}tasks_agg",
+        f"scale_broadcast_{mib}mib_{n_nodes}nodes_shm_agg",
         (mib / 1024.0) * n_nodes / dt,
         "GiB/s",
         reference=round(50.0 / 20.2, 3),  # 1 GiB x 50 nodes / 20.2 s
@@ -160,21 +165,43 @@ def bench_broadcast(n_nodes: int, mib: int) -> None:
             sch.post(("local_rpc", "ensure_local", (oid2, nid),
                       __import__("threading").Event(), {}))
         deadline = time.monotonic() + 1200
+        land_at = {}
         while time.monotonic() < deadline:
-            if sum(1 for x in nids if x in sch._object_locations.get(oid2, ())) == len(nids):
+            locs = sch._object_locations.get(oid2, ())
+            for x in nids:
+                if x in locs and x not in land_at:
+                    land_at[x] = time.perf_counter() - t0
+            if len(land_at) == len(nids):
                 break
-            time.sleep(0.05)
+            time.sleep(0.02)
         dt = time.perf_counter() - t0
-        landed = sum(1 for x in nids if x in sch._object_locations.get(oid2, ()))
-        assert landed == len(nids), (
-            f"socket broadcast incomplete: {landed}/{len(nids)} replicas "
+        assert len(land_at) == len(nids), (
+            f"socket broadcast incomplete: {len(land_at)}/{len(nids)} replicas "
             "landed before the deadline — refusing to emit a bogus rate"
         )
         emit(
-            f"scale_broadcast_{mib}mib_{len(nids)}tasks_socket_agg",
+            f"scale_broadcast_{mib}mib_{len(nids)}nodes_socket_agg",
             (mib / 1024.0) * len(nids) / dt,
             "GiB/s",
             reference=round(50.0 / 20.2, 3),
+        )
+        # pipelined-relay evidence: per-hop landing times. Store-and-forward
+        # chains stagger completions by ~(object time) per hop; pipelined
+        # chains land together shortly after the first delivery (overlap) —
+        # on a 1-core box the AGGREGATE stays memcpy-bound either way (all
+        # hops share one core), but the spread shows the chunks flowed
+        # through relays concurrently. On real NICs the same overlap turns
+        # into aggregate bandwidth.
+        lands = sorted(land_at.values())
+        emit(
+            f"scale_broadcast_{mib}mib_{len(nids)}nodes_socket_landings",
+            [round(x, 3) for x in lands],
+            "s",
+        )
+        emit(
+            f"scale_broadcast_{mib}mib_{len(nids)}nodes_socket_tail_spread",
+            round((lands[-1] - lands[0]) / max(lands[-1], 1e-9), 4),
+            "fraction",
         )
     finally:
         sch.config.same_host_shm_transfer = True

@@ -153,6 +153,8 @@ class RemoteDriverRuntime(WorkerRuntime):
     def shutdown(self):
         """Disconnect from the cluster (the cluster keeps running)."""
         self.closed = True
+        if self._direct is not None:
+            self._direct.shutdown()
         try:
             self.conn.close()
         except OSError:

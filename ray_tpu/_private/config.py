@@ -61,6 +61,10 @@ class Config:
     # round-trip (parity: the raylet's local task queue,
     # local_task_manager.cc:74)
     lease_backlog_cap: int = 64
+    # queue entries a dispatcher scans past an infeasible head per tick —
+    # shared by the daemon's _lease_tick and the head's promote mirror so
+    # their dispatch orders stay aligned (local_task_manager.cc:122)
+    lease_lookahead: int = 16
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 = num_cpus
     worker_idle_timeout_s: float = 300.0
@@ -89,6 +93,14 @@ class Config:
     daemon_reconnect_timeout_s: float = 60.0
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
+    # --- direct actor transport (parity: actor_task_submitter.h:73) ---
+    # callers resolve an actor's worker address once, then send method calls
+    # straight to the target worker's listener — the head sees only actor
+    # lifecycle events, not the call hot path
+    direct_actor_calls: bool = True
+    # address workers bind their direct-call listeners on; daemons override
+    # this with their --host so cross-host callers can reach their workers
+    node_host: str = "127.0.0.1"
     # --- events / metrics ---
     event_stats_print_interval_ms: int = 0  # 0 = disabled
     metrics_report_interval_ms: int = 5000

@@ -65,6 +65,7 @@ class HeadServer:
             node.store_client, config.cluster_host, self.auth_key
         )
         node.scheduler.head_object_addr = self._object_server.address
+        node.scheduler.head_object_server = self._object_server
         # session marker: lets a connecting driver detect whether it really
         # shares this machine's shm (remote drivers would silently create an
         # empty store at the same path otherwise)

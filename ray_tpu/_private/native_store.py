@@ -144,6 +144,15 @@ class NativeStoreClient(StorePutMixin):
 
         data = storage.read_bytes(uri)
         if data is None:
+            # definitive miss (read_bytes raises on transport errors, None
+            # means not-found): drop the stale marker so contains() flips
+            # False and waiters fail fast instead of polling to the object-
+            # lost timeout. Happens when the backend is process-local
+            # (memory://) but the marker sits in the shared shm dir.
+            try:
+                os.unlink(self._spill_marker(oid))
+            except OSError:
+                pass
             return None
         # reinstate locally so repeat gets don't re-download a hot object
         # from the backend every time (the external copy stays the durable

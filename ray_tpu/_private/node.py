@@ -43,8 +43,19 @@ def _get_ctx():
         method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
         _mp_ctx = mp.get_context(method)
         if method == "forkserver":
+            # preload EVERYTHING worker_main touches: the import of
+            # ray_tpu._private.worker alone drags in node/scheduler (~20ms of
+            # child CPU per spawn without preload — the fleet-launch ceiling)
             _mp_ctx.set_forkserver_preload(
-                ["ray_tpu._private.worker_process", "ray_tpu._private.serialization"]
+                [
+                    "ray_tpu._private.worker_process",
+                    "ray_tpu._private.serialization",
+                    "ray_tpu._private.worker",
+                    "ray_tpu._private.native_store",
+                    "ray_tpu._private.direct_actor",
+                    "ray_tpu._private.object_transfer",
+                    "ray_tpu._private.runtime_env",
+                ]
             )
     return _mp_ctx
 
@@ -139,6 +150,14 @@ class Node:
             import secrets
 
             config.cluster_auth_key = secrets.token_hex(16)
+        # head-node workers must advertise direct-call listeners on an
+        # address CROSS-HOST callers can reach; default to the cluster bind
+        # host (daemons override node_host with their own --host)
+        if config.node_host == "127.0.0.1" and config.cluster_host not in (
+            "127.0.0.1",
+            "0.0.0.0",
+        ):
+            config.node_host = config.cluster_host
         self._config_blob = pickle.dumps(config)
         self._ctx = _get_ctx()
         self.head_server = None  # started on demand (start_head_server)

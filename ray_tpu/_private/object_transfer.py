@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from multiprocessing.connection import Client, Listener
 from typing import Optional
 
@@ -49,11 +50,88 @@ def set_nodelay(conn) -> None:
         s.close()
 
 
+class _InflightRead:
+    """Progress tracker for an object currently being RECEIVED: the object
+    server streams its already-landed bytes to downstream peers while the
+    rest is still arriving — relay hops pipeline chunks instead of
+    store-and-forwarding whole objects (parity: PushManager's chunked
+    concurrent push, push_manager.h:30)."""
+
+    __slots__ = ("view", "total", "cv", "covered", "failed", "serving")
+
+    def __init__(self, view, total: int):
+        self.view = view
+        self.total = total
+        self.cv = threading.Condition()
+        self.covered = []  # merged, sorted (lo, hi) intervals
+        self.failed = False
+        self.serving = 0  # active downstream serves; abort waits for drain
+
+    def mark(self, lo: int, hi: int) -> None:
+        with self.cv:
+            self.covered.append((lo, hi))
+            if len(self.covered) > 1:
+                self.covered.sort()
+                merged = [self.covered[0]]
+                for a, b in self.covered[1:]:
+                    if a <= merged[-1][1]:
+                        merged[-1] = (merged[-1][0], max(merged[-1][1], b))
+                    else:
+                        merged.append((a, b))
+                self.covered = merged
+            self.cv.notify_all()
+
+    def fail(self) -> None:
+        with self.cv:
+            self.failed = True
+            self.cv.notify_all()
+
+    def _has(self, lo: int, hi: int) -> bool:
+        for a, b in self.covered:
+            if a <= lo and hi <= b:
+                return True
+        return False
+
+    def wait_covered(self, lo: int, hi: int, timeout: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while not self._has(lo, hi):
+                if self.failed:
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cv.wait(min(remaining, 1.0))
+            return not self.failed
+
+    def serve_begin(self) -> None:
+        with self.cv:
+            self.serving += 1
+
+    def serve_end(self) -> None:
+        with self.cv:
+            self.serving -= 1
+            self.cv.notify_all()
+
+    def wait_serves_drained(self, timeout: float = 60.0) -> bool:
+        """Called before abort() frees the receive buffer: a downstream
+        serve mid-send must not read recycled arena memory. Returns False
+        if serves are still active at the deadline — the caller must then
+        LEAK the buffer rather than recycle it under a live reader."""
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while self.serving > 0 and time.monotonic() < deadline:
+                self.cv.wait(0.2)
+            return self.serving == 0
+
+
 class ObjectServer:
     """Serves sealed objects from a local store client to peer nodes.
 
     ``store`` may be a store client or a zero-arg callable returning one
-    (daemons register their address before their store exists)."""
+    (daemons register their address before their store exists). Objects
+    still IN FLIGHT into this node (``register_inflight``) are served
+    progressively — see :class:`_InflightRead`."""
 
     def __init__(self, store, host: str, auth_key: bytes):
         self._store = store
@@ -61,10 +139,28 @@ class ObjectServer:
         # (mp.connection's default of 1 drops concurrent dials)
         self._listener = Listener((host, 0), backlog=128, authkey=auth_key)
         self._stop = False
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._accept_loop, name="object-server", daemon=True
         )
         self._thread.start()
+
+    # -- inflight registry (the local fetch driver feeds it) ---------------
+
+    def register_inflight(self, oid: ObjectID, view, total: int) -> _InflightRead:
+        tracker = _InflightRead(view, total)
+        with self._inflight_lock:
+            self._inflight[oid.binary()] = tracker
+        return tracker
+
+    def unregister_inflight(self, oid: ObjectID) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(oid.binary(), None)
+
+    def get_inflight(self, oid_bin: bytes):
+        with self._inflight_lock:
+            return self._inflight.get(oid_bin)
 
     @property
     def address(self):
@@ -95,26 +191,59 @@ class ObjectServer:
                 if store is None:
                     conn.send(("missing",))
                     continue
-                # the object is known-sealed cluster-wide before a pull is
-                # issued; a short timeout covers local commit latency
-                mv = store.get(oid, timeout=10.0)
-                if mv is None:
+                # sealed copy OR an in-flight receive (pipelined relay):
+                # poll both within the commit-latency window
+                mv = None
+                tracker = None
+                deadline = time.monotonic() + 10.0
+                while True:
+                    mv = store.get(oid, timeout=0)
+                    if mv is not None:
+                        break
+                    tracker = self.get_inflight(msg[1])
+                    if tracker is not None:
+                        break
+                    if time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.005)
+                if mv is None and tracker is None:
                     conn.send(("missing",))
                     continue
+                if mv is not None:
+                    try:
+                        size = mv.nbytes
+                        conn.send(("size", size))
+                        if msg[0] == "get_range":
+                            # one stripe of a multi-stream fetch (parity:
+                            # chunked concurrent transfer, push_manager.h:30)
+                            start = max(0, int(msg[2]))
+                            end = min(size, start + int(msg[3]))
+                        else:
+                            start, end = 0, size
+                        for off in range(start, end, CHUNK_BYTES):
+                            conn.send_bytes(mv[off : min(off + CHUNK_BYTES, end)])
+                    finally:
+                        store.release(oid)
+                    continue
+                # in-flight: stream chunks as they land (forward chunk k
+                # while k+1 is still arriving from upstream). A failed
+                # upstream fetch drops this conn; the peer re-sources.
+                size = tracker.total
+                conn.send(("size", size))
+                if msg[0] == "get_range":
+                    start = max(0, int(msg[2]))
+                    end = min(size, start + int(msg[3]))
+                else:
+                    start, end = 0, size
+                tracker.serve_begin()
                 try:
-                    size = mv.nbytes
-                    conn.send(("size", size))
-                    if msg[0] == "get_range":
-                        # one stripe of a multi-stream fetch (parity: chunked
-                        # concurrent transfer, push_manager.h:30)
-                        start = max(0, int(msg[2]))
-                        end = min(size, start + int(msg[3]))
-                    else:
-                        start, end = 0, size
                     for off in range(start, end, CHUNK_BYTES):
-                        conn.send_bytes(mv[off : min(off + CHUNK_BYTES, end)])
+                        hi = min(off + CHUNK_BYTES, end)
+                        if not tracker.wait_covered(off, hi):
+                            raise OSError("upstream transfer failed mid-relay")
+                        conn.send_bytes(tracker.view[off:hi])
                 finally:
-                    store.release(oid)
+                    tracker.serve_end()
         except (EOFError, OSError, BrokenPipeError):
             pass
         finally:
@@ -144,13 +273,16 @@ def _dial(addr, key):
     return conn
 
 
-def _recv_range(conn, view, start: int, end: int) -> None:
+def _recv_range(conn, view, start: int, end: int, progress=None) -> None:
     off = start
     while off < end:
-        off += conn.recv_bytes_into(view[off:end])
+        n = conn.recv_bytes_into(view[off:end])
+        if progress is not None:
+            progress(off, off + n)
+        off += n
 
 
-def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]:
+def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest, progress=None) -> Optional[int]:
     """Pull one sealed object from a peer directly into a caller-provided
     buffer (``make_dest(size) -> memoryview``), striping large objects over
     several concurrent sockets.
@@ -158,7 +290,9 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]
     Writing straight into the destination store's create() buffer removes
     the staging copy the old bytearray path paid (parity: the reference
     receives chunks into plasma-allocated buffers,
-    object_buffer_pool.h:41). Returns the object size, or None if missing.
+    object_buffer_pool.h:41). ``progress(lo, hi)`` fires per received chunk
+    so an in-flight receive can relay onward (pipelined broadcast).
+    Returns the object size, or None if missing.
     """
     key = auth_key.encode() if isinstance(auth_key, str) else auth_key
     conn = _dial(addr, key)
@@ -172,7 +306,7 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]
         if view is None:
             return None
         first_end = min(size, STRIPE_THRESHOLD)
-        _recv_range(conn, view, 0, first_end)
+        _recv_range(conn, view, 0, first_end, progress)
         rest = size - first_end
         if rest > 0:
             # stripe across sockets only when there are cores to drive them:
@@ -193,7 +327,7 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest) -> Optional[int]
                         h2 = c2.recv()
                         if h2[0] != "size":
                             raise OSError("stripe source lost the object")
-                        _recv_range(c2, view, lo, hi)
+                        _recv_range(c2, view, lo, hi, progress)
                     finally:
                         c2.close()
                 except Exception as e:  # noqa: BLE001
@@ -374,7 +508,9 @@ def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
         lib.rt_store_release(h, oid.binary())
 
 
-def fetch_via_src_info(store, src_info, oid: ObjectID, auth_key, shm_enabled: bool) -> bool:
+def fetch_via_src_info(
+    store, src_info, oid: ObjectID, auth_key, shm_enabled: bool, server=None
+) -> bool:
     """Shared head/daemon fetch driver: normalize the source descriptor, try
     the same-host shm path when eligible, fall back to the socket plane —
     UNLESS the head marked the transfer shm-only (uncharged against the
@@ -393,38 +529,73 @@ def fetch_via_src_info(store, src_info, oid: ObjectID, auth_key, shm_enabled: bo
         if src_info.get("shm_only"):
             return False
     if src_info.get("addr"):
-        return fetch_into_local_store(store, src_info["addr"], oid, auth_key)
+        return fetch_into_local_store(
+            store, src_info["addr"], oid, auth_key, server=server
+        )
     return False
 
 
-def fetch_into_local_store(store, addr, oid: ObjectID, auth_key) -> bool:
+def fetch_into_local_store(store, addr, oid: ObjectID, auth_key, server=None) -> bool:
     """Pull ``oid`` from a peer straight into ``store``: stripes land in the
     create()d buffer (no staging copy), sealed on completion, aborted on
     failure (parity: chunks received into plasma-allocated buffers,
-    object_buffer_pool.h:41). Returns True when a local sealed copy exists
-    afterwards (including via a concurrent fetch winning the create race).
+    object_buffer_pool.h:41). With ``server`` (this node's ObjectServer),
+    the receive registers as IN FLIGHT so downstream peers stream chunks
+    that already landed — the pipelined relay. Returns True when a local
+    sealed copy exists afterwards (including via a concurrent fetch winning
+    the create race).
     """
     if store.contains(oid):
         return True
     created = False
+    tracker = None
     try:
 
         def make_dest(size: int):
-            nonlocal created
+            nonlocal created, tracker
             try:
                 view = store.create(oid, size)
                 created = True
-                return view
             except ValueError:
                 return None  # a concurrent fetch owns it
+            if server is not None:
+                tracker = server.register_inflight(oid, view, size)
+            return view
 
-        n = fetch_object_into(addr, oid, auth_key, make_dest)
+        n = fetch_object_into(
+            addr,
+            oid,
+            auth_key,
+            make_dest,
+            progress=(lambda lo, hi: tracker.mark(lo, hi)) if server is not None else None,
+        )
         if n is not None and created:
             store.seal(oid)
             created = False
+            if tracker is not None:
+                # sealed: the buffer is now the durable copy; late serves
+                # keep reading the same memory, new ones hit the store
+                server.unregister_inflight(oid)
+                tracker = None
             return True
         return store.contains(oid)  # the concurrent fetch finished (or not)
     finally:
+        if created:
+            drained = True
+            if tracker is not None:
+                tracker.fail()
+                server.unregister_inflight(oid)
+                drained = tracker.wait_serves_drained()
+            if not drained:
+                # a downstream serve is still mid-send on this buffer (peer
+                # stalled in TCP backpressure): leaking the unsealed create
+                # is strictly better than recycling memory under a live
+                # reader, which would seal silent garbage downstream
+                logger.warning(
+                    "leaking unsealed receive buffer for %s: relay serves "
+                    "did not drain", oid.hex()[:8]
+                )
+                created = False
         if created:
             try:
                 store.abort(oid)

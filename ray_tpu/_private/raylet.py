@@ -50,6 +50,7 @@ class NodeDaemon:
         self.node_id = NodeID.from_random()
         self.auth_key = auth_key
         self._head_addr = tuple(head_addr)
+        self._host = host
         self.conn = Client(self._head_addr, authkey=auth_key)
         from ray_tpu._private.object_transfer import set_nodelay
 
@@ -96,6 +97,20 @@ class NodeDaemon:
 
         method = "forkserver" if "forkserver" in mp.get_all_start_methods() else "spawn"
         self._ctx = mp.get_context(method)
+        if method == "forkserver":
+            # same preload set as node._get_ctx: without it every daemon
+            # worker spawn pays ~20ms of child-side imports
+            self._ctx.set_forkserver_preload(
+                [
+                    "ray_tpu._private.worker_process",
+                    "ray_tpu._private.serialization",
+                    "ray_tpu._private.worker",
+                    "ray_tpu._private.native_store",
+                    "ray_tpu._private.direct_actor",
+                    "ray_tpu._private.object_transfer",
+                    "ray_tpu._private.runtime_env",
+                ]
+            )
         # wid -> (proc, pipe)
         self.workers: Dict[WorkerID, tuple] = {}
         self._pipe_to_wid: Dict[object, WorkerID] = {}
@@ -160,7 +175,10 @@ class NodeDaemon:
         assert reply[0] == "registered", reply
         self.session_name = reply[1]["session_name"]
         self.config = pickle.loads(reply[1]["config_blob"])
-        self._config_blob = reply[1]["config_blob"]
+        # workers on this node bind their direct actor-call listeners on the
+        # daemon's host so cross-host callers can reach them
+        self.config.node_host = self._host
+        self._config_blob = pickle.dumps(self.config)
 
     def _reconnect(self) -> bool:
         """Head connection lost: keep dialing the head address and re-attach
@@ -525,38 +543,55 @@ class NodeDaemon:
     def _lease_tick(self) -> None:
         """Dispatch queued leased tasks onto local workers, flush completed
         batches, reap long-idle lease workers. Runs every loop iteration."""
-        # dispatch: FIFO while the local ledger fits the head of the queue
-        # (head-of-line order matches the head's promote bookkeeping)
-        while self._lease_queue:
-            spec = self._lease_queue[0]
-            if not self._lease_avail_for(spec.resources):
-                break
-            if self._lease_idle:
-                wid = self._lease_idle.popleft()
-                entry = self.workers.get(wid)
-                if entry is None:
+        # dispatch: per-resource-class FIFO with bounded lookahead — a wide
+        # task at the head must not idle cores that later narrow tasks could
+        # use, but tasks of the SAME shape never overtake each other (the
+        # head's promote mirror applies the same rule)
+        if self._lease_queue:
+            skipped: collections.deque = collections.deque()
+            blocked_classes: set = set()
+            lookahead = getattr(self.config, "lease_lookahead", 16)
+            while self._lease_queue and len(skipped) < lookahead:
+                spec = self._lease_queue.popleft()
+                klass = tuple(sorted(spec.resources.items()))
+                if klass in blocked_classes or not self._lease_avail_for(
+                    spec.resources
+                ):
+                    blocked_classes.add(klass)
+                    skipped.append(spec)
                     continue
-                self._lease_queue.popleft()
+                wid = None
+                while self._lease_idle:
+                    cand = self._lease_idle.popleft()
+                    if cand in self.workers:
+                        wid = cand
+                        break
+                if wid is None:
+                    # no idle worker: spawn only what the queue can actually
+                    # use (starting workers already count toward demand —
+                    # spawning 4 for 1 queued task quadruples the import
+                    # storm on small boxes), capped so blocked workers
+                    # (parked in ray.get) never wedge dispatch but don't
+                    # count against the pool either
+                    skipped.append(spec)
+                    demand = len(self._lease_queue) + len(skipped)
+                    active = len(self._lease_running) - len(self._lease_blocked)
+                    if (
+                        self._lease_starting < min(4, demand)
+                        and active + self._lease_starting < self._lease_worker_cap
+                    ):
+                        self._lease_spawn()
+                    break  # worker scarcity blocks every class equally
                 self._lease_charge(spec.resources, +1)
                 self._lease_running[wid] = {"spec": spec, "charged": True}
                 try:
+                    entry = self.workers[wid]
                     entry[1].send(("exec", spec))
                     self._lease_started_buf.append(spec.task_id.binary())
                 except (OSError, EOFError, BrokenPipeError):
                     self._on_worker_pipe_death(wid)
-            else:
-                # no idle worker: spawn only what the queue can actually use
-                # (starting workers already count toward demand — spawning 4
-                # for 1 queued task quadruples the import storm on small
-                # boxes), capped so blocked workers (parked in ray.get) never
-                # wedge dispatch but don't count against the pool either
-                active = len(self._lease_running) - len(self._lease_blocked)
-                if (
-                    self._lease_starting < min(4, len(self._lease_queue))
-                    and active + self._lease_starting < self._lease_worker_cap
-                ):
-                    self._lease_spawn()
-                break
+            while skipped:
+                self._lease_queue.appendleft(skipped.pop())
         # flush start/completion batches: one message each per loop
         # iteration no matter how many tasks changed state in it
         if self._lease_started_buf:
@@ -686,6 +721,7 @@ class NodeDaemon:
                 oid,
                 self.auth_key,
                 getattr(self.config, "same_host_shm_transfer", True),
+                server=self.object_server,
             )
         except Exception:
             logger.exception("fetch %s failed", oid.hex()[:8])

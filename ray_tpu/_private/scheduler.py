@@ -255,6 +255,10 @@ class WorkerState:
     acquired_node: Optional[NodeID] = None
     actor_id: Optional[ActorID] = None
     pg_reservation: Optional[Tuple[PlacementGroupID, int]] = None
+    # address of the worker's direct actor-call listener (rides the ready
+    # message); resolve_actors hands it to callers so the hot path skips
+    # the head (parity: the worker's gRPC endpoint in the actor table)
+    direct_addr: Any = None
 
 
 @dataclass
@@ -452,9 +456,12 @@ class Scheduler:
         self._xfer_load: Dict[NodeID, int] = collections.defaultdict(int)
         # oid -> destinations waiting for a source slot
         self._xfer_waiting: Dict[ObjectID, Set[NodeID]] = {}
-        # head node's own object server address (set by HeadServer)
+        # head node's own object server address + instance (set by HeadServer)
         self.head_object_addr = None
+        self.head_object_server = None
         self._last_gcs_snapshot = 0.0
+        # zero-refcount frees deferred by a grace window (see _maybe_free)
+        self._deferred_frees: collections.deque = collections.deque()
         # event-driven dispatch bookkeeping
         self._dispatch_dirty = True
         self._last_full_dispatch = 0.0
@@ -528,15 +535,28 @@ class Scheduler:
 
     def _run(self):
         self._started.set()
+        self._loop_started_at = time.monotonic()
         wake = self._wakeup_r
+        # persistent readiness registration (epoll via selectors): with a
+        # 1000-worker fleet, re-registering every conn per tick (mpc.wait)
+        # costs O(conns) syscalls per iteration — the fleet-launch falloff.
+        # Conns register once (here, lazily) and unregister on death.
+        import selectors
+
+        self._selector = sel = selectors.DefaultSelector()
+        sel.register(wake, selectors.EVENT_READ, None)
+        # conns created before the loop started (prestart workers) register
+        # via their worker_spawned/register_daemon cmds, which are still
+        # queued at this point — no sweep needed: every conn attach/detach
+        # happens ON this thread (posted cmds + death handlers)
         while not self._stop.is_set():
-            conns = list(self._conn_to_worker.keys()) + list(self._daemon_conns.keys())
             try:
-                ready = mpc.wait(conns + [wake], timeout=0.2)
+                events = sel.select(timeout=0.2)
             except OSError:
-                ready = []
-            for r in ready:
-                if r is wake:
+                events = []
+            for key, _ in events:
+                r = key.data
+                if r is None:
                     # clear the elision flag BEFORE draining the pipe/queue:
                     # a post landing mid-drain must re-signal (see post())
                     self._wakeup_pending = False
@@ -546,7 +566,7 @@ class Scheduler:
                         pass
                 elif r in self._daemon_conns:
                     self._drain_daemon(r)
-                else:
+                elif r in self._conn_to_worker:
                     self._drain_worker(r)
             while True:
                 try:
@@ -564,6 +584,26 @@ class Scheduler:
             self._schedule()
             self._maybe_print_event_stats()
         self._shutdown_workers()
+
+    def _sel_register(self, conn) -> None:
+        sel = getattr(self, "_selector", None)
+        if sel is None:
+            return
+        import selectors
+
+        try:
+            sel.register(conn, selectors.EVENT_READ, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _sel_unregister(self, conn) -> None:
+        sel = getattr(self, "_selector", None)
+        if sel is None:
+            return
+        try:
+            sel.unregister(conn)
+        except (KeyError, ValueError, OSError):
+            pass
 
     def _maybe_print_event_stats(self):
         interval = self.config.event_stats_print_interval_ms
@@ -691,6 +731,7 @@ class Scheduler:
     def _on_daemon_death(self, conn):
         nid = self._daemon_conns.pop(conn, None)
         self._daemon_send_locks.pop(conn, None)
+        self._sel_unregister(conn)
         try:
             conn.close()
         except OSError:
@@ -713,6 +754,8 @@ class Scheduler:
             self._dispatch_dirty = True
             w.state = "idle"
             w.idle_since = time.monotonic()
+            if len(msg) > 1:
+                w.direct_addr = msg[1]
             self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
             if w.actor_id is None:
                 self._idle_by_node[w.node_id].append(wid)
@@ -843,6 +886,17 @@ class Scheduler:
         reply: Dict[ObjectID, Tuple] = {}
         for oid in oids:
             entry = self.memory_store.get_entry(oid)
+            if entry is None:
+                self._pull_waiters[oid].append((wid, req_id))
+                # re-check AFTER parking: direct-plane commits land in the
+                # shared store off-loop and only nudge us when a waiter is
+                # visible — park-then-recheck closes the race with their
+                # put-then-probe (one side always sees the other)
+                entry = self.memory_store.get_entry(oid)
+                if entry is not None:
+                    self._pull_waiters[oid].remove((wid, req_id))
+                    if not self._pull_waiters[oid]:
+                        del self._pull_waiters[oid]
             if entry is not None:
                 if entry[0] == "stored":
                     entry = self._stored_entry_for(oid, entry, w.node_id)
@@ -850,7 +904,6 @@ class Scheduler:
                         self._ensure_local(oid, w.node_id)
                 reply[oid] = entry
             else:
-                self._pull_waiters[oid].append((wid, req_id))
                 reply[oid] = ("pending",)
         try:
             w.conn.send(("pull_reply", req_id, reply))
@@ -909,7 +962,19 @@ class Scheduler:
                     break
         best = None
         if same_host is None:
-            for src in locs:
+            # candidate sources: sealed copies PLUS destinations still
+            # RECEIVING the object — their servers stream landed chunks
+            # onward (pipelined relay: hop k forwards chunk i while chunk
+            # i+1 arrives; parity: push_manager.h:30 chunked push). A failed
+            # upstream surfaces as a failed downstream fetch and re-sources.
+            candidates = set(locs)
+            for (o, d), info in self._fetching.items():
+                # only SOCKET fetches (charged) register an inflight tracker
+                # at their destination's object server; an shm-path receiver
+                # has nothing to serve and would stall downstreams 10s
+                if o == oid and d != dest and info[1]:
+                    candidates.add(d)
+            for src in candidates:
                 addr = self._object_server_addr(src)
                 if addr is None:
                     continue
@@ -962,6 +1027,13 @@ class Scheduler:
                     )
             except (OSError, EOFError):
                 self._on_daemon_death(dest_node.daemon_conn)
+        # the fresh in-flight destination is itself a relay source now:
+        # re-drive parked waiters immediately instead of at its completion
+        waiting = self._xfer_waiting.get(oid)
+        if waiting:
+            for d in list(waiting):
+                if d != dest:
+                    self._ensure_local(oid, d)
 
     def _xfer_complete(self, oid: ObjectID, dest: NodeID, ok: bool) -> None:
         """One transfer settled: free its source slot, record the new copy,
@@ -978,6 +1050,12 @@ class Scheduler:
             # re-drive the fetch now rather than waiting for the consumer's
             # next 2s poll
             self._shm_xfer_failed.add((oid, dest))
+            self._ensure_local(oid, dest)
+        elif entry is not None:
+            # a socket fetch failed — with pipelined relays this includes a
+            # failed UPSTREAM cascading down; re-source immediately (sealed
+            # copies are preferred only through load, but a dead relay no
+            # longer appears in _fetching, so the retry avoids it)
             self._ensure_local(oid, dest)
         waiters = self._xfer_waiting.pop(oid, None)
         if waiters:
@@ -1080,6 +1158,7 @@ class Scheduler:
                 oid,
                 self.config.cluster_auth_key,
                 self.config.same_host_shm_transfer,
+                server=self.head_object_server,
             )
         except Exception:
             logger.exception("fetch of %s into head failed", oid.hex()[:8])
@@ -1128,6 +1207,7 @@ class Scheduler:
             # channels are drained via their daemon's socket
             if not isinstance(wstate.conn, DaemonWorkerChannel):
                 self._conn_to_worker[wstate.conn] = wstate.worker_id
+                self._sel_register(wstate.conn)
         elif kind == "register_daemon":
             self._dispatch_dirty = True
             _, conn, ns = cmd
@@ -1138,6 +1218,7 @@ class Scheduler:
                 if nid == ns.node_id and old_conn is not conn:
                     self._daemon_conns.pop(old_conn, None)
                     self._daemon_send_locks.pop(old_conn, None)
+                    self._sel_unregister(old_conn)
                     try:
                         old_conn.close()
                     except OSError:
@@ -1145,6 +1226,7 @@ class Scheduler:
             self.nodes[ns.node_id] = ns
             self._daemon_conns[conn] = ns.node_id
             self._daemon_send_locks[conn] = threading.Lock()
+            self._sel_register(conn)
             ns.last_heartbeat = time.monotonic()
             # a re-registering daemon restarted its local dispatcher (and
             # killed its workers): requeue whatever was leased to it, and
@@ -1194,6 +1276,35 @@ class Scheduler:
             # (see WorkerRuntime.submit)
             for oid in cmd[1]:
                 self._apply_ref_op(1, oid)
+        elif kind == "unpin_args":
+            # direct-plane callers release their own in-flight pins when the
+            # result arrives (the head never sees those completions)
+            self._unpin(cmd[1])
+        elif kind == "direct_publish":
+            # ownership escalation: a caller-owned direct-call result escaped
+            # its owning process — commit the value (inline; stored ones were
+            # already registered via submit_put) and absorb the accumulated
+            # local refcount. Attributed to the publishing worker so a crash
+            # releases them (borrower semantics, reference_count.h:61).
+            for oid, entry, _src_dir, count in cmd[1]:
+                if entry is not None:
+                    self._commit_result(oid, entry)
+                else:
+                    e = self.memory_store.get_entry(oid)
+                    if e is not None:
+                        self._wake_waiters(oid, e)
+                if count:
+                    self._ref_counts[oid] += count
+                    if holder is not None:
+                        held = self._holder_refs.setdefault(holder, {})
+                        held[oid] = held.get(oid, 0) + count
+        elif kind == "direct_wake":
+            # a direct-call result was committed into the shared memory store
+            # off-loop; wake anything parked on it here
+            for oid in cmd[1]:
+                e = self.memory_store.get_entry(oid)
+                if e is not None:
+                    self._wake_waiters(oid, e)
         elif kind == "ref_batch":
             # ordered batch of ref ops: (1, oid) add, (-1, oid) remove,
             # (2, oid, token) transit pin, (3, oid, token) transit release;
@@ -1290,6 +1401,18 @@ class Scheduler:
             rec.unresolved_deps = deps
             for d in deps:
                 self._dep_waiters[d].add(spec.task_id)
+            # re-check AFTER parking: direct-plane commits land in the shared
+            # store off-loop (see _handle_pull for the race argument)
+            for d in list(deps):
+                if self.memory_store.contains(d):
+                    rec.unresolved_deps.discard(d)
+                    waiters = self._dep_waiters.get(d)
+                    if waiters is not None:
+                        waiters.discard(spec.task_id)
+                        if not waiters:
+                            del self._dep_waiters[d]
+            if not rec.unresolved_deps:
+                self._make_schedulable(rec)
         else:
             self._make_schedulable(rec)
 
@@ -1398,6 +1521,8 @@ class Scheduler:
                     node = self.nodes.get(nid)
                     if node is not None and node.last_heartbeat:
                         node.last_heartbeat = now
+        if self._deferred_frees:
+            self._sweep_deferred_frees()
         if self._transit_pins or self._early_release_expiry:
             now = time.monotonic()
             expired = []
@@ -1619,9 +1744,12 @@ class Scheduler:
             if w is not None and w.state == "idle":
                 w.state = "busy"
                 return wid
-        # spawn a new worker for this node (throttled, parity: WorkerPool
-        # starting-worker throttling)
-        if self._starting_count[node.node_id] < 4:
+        # spawn new workers for this node, throttled by DEMAND: a fleet of
+        # pending actor creations prestarts wide so child boots overlap
+        # (parity: WorkerPool prestart sized by queued leases,
+        # worker_pool.h:83); the floor of 4 keeps small bursts cheap
+        cap = max(4, min(32, len(self._pending)))
+        if self._starting_count[node.node_id] < cap:
             self._starting_count[node.node_id] += 1
             self._node.spawn_worker(node.node_id)
         return None
@@ -1812,15 +1940,20 @@ class Scheduler:
                 node.lease_acquired[k] = left
 
     def _promote_lease_backlog(self, nid: NodeID) -> None:
-        """Mirror the node dispatcher's FIFO: acquire resources for backlog
-        tasks that now fit, keeping the head ledger in step with what the
-        daemon will actually run next."""
+        """Mirror the node dispatcher's dispatch order: acquire resources for
+        backlog tasks that now fit, keeping the head ledger in step with what
+        the daemon will actually run next. Same rule as the daemon's
+        ``_lease_tick``: per-resource-class FIFO with bounded lookahead past
+        an infeasible head (``config.lease_lookahead`` on both sides)."""
         q = self._lease_backlog.get(nid)
         if not q:
             return
         node = self.nodes.get(nid)
-        while q:
-            tid = q[0]
+        skipped: Deque = collections.deque()
+        blocked_classes: set = set()
+        lookahead = getattr(self.config, "lease_lookahead", 16)
+        while q and len(skipped) < lookahead:
+            tid = q.popleft()
             rec = self.tasks.get(tid)
             info = self._leased.get(tid)
             if (
@@ -1829,15 +1962,25 @@ class Scheduler:
                 or rec.state not in ("LEASED", "RUNNING")
                 or info[1]  # already acquired
             ):
-                q.popleft()
                 continue
-            if node is None or not node.alive or not node.can_run(info[2]):
-                break
+            klass = tuple(sorted(info[2].items()))
+            if (
+                klass in blocked_classes
+                or node is None
+                or not node.alive
+                or not node.can_run(info[2])
+            ):
+                blocked_classes.add(klass)
+                skipped.append(tid)
+                if node is None or not node.alive:
+                    break
+                continue
             node.acquire(info[2])
             for k, v in info[2].items():
                 node.lease_acquired[k] = node.lease_acquired.get(k, 0.0) + v
             self._leased[tid] = (nid, True, info[2])
-            q.popleft()
+        while skipped:
+            q.appendleft(skipped.pop())
 
     def _refill_node(self, nid: NodeID) -> None:
         """Targeted refill after a completion freed capacity on ONE node:
@@ -2254,6 +2397,9 @@ class Scheduler:
 
     def _commit_result(self, oid: ObjectID, entry: Tuple):
         self.memory_store.put(oid, entry)
+        self._wake_waiters(oid, entry)
+
+    def _wake_waiters(self, oid: ObjectID, entry: Tuple):
         # wake dependent tasks
         for tid in self._dep_waiters.pop(oid, ()):  # type: ignore[arg-type]
             rec = self.tasks.get(tid)
@@ -2317,7 +2463,8 @@ class Scheduler:
             )
         w.state = "dead"
         w.dead_since = time.monotonic()
-        self._conn_to_worker.pop(w.conn, None)
+        if self._conn_to_worker.pop(w.conn, None) is not None:
+            self._sel_unregister(w.conn)
         try:
             w.conn.close()
         except OSError:
@@ -2638,6 +2785,32 @@ class Scheduler:
             return None if st is None else st.state
         if op == "object_ready":
             return self.memory_store.contains(args[0])
+        if op == "resolve_actors":
+            # direct transport resolution (parity: the caller fetching the
+            # actor's rpc address from the GCS actor table once, then talking
+            # worker-to-worker — actor_task_submitter.h:73)
+            out = []
+            for aid_bin in args[0]:
+                st = self.actors.get(ActorID(aid_bin))
+                if st is None:
+                    # distinct from DEAD: a borrowed handle can race the
+                    # creation spec to the head — callers poll a while
+                    out.append(("unknown",))
+                elif st.state == "DEAD":
+                    out.append(("dead", st.death_cause or "actor died"))
+                elif st.state == "ALIVE" and st.worker_id is not None:
+                    w = self.workers.get(st.worker_id)
+                    if w is None or w.state == "dead":
+                        out.append(("pending",))
+                    elif w.direct_addr:
+                        out.append(
+                            ("alive", w.direct_addr, st.max_task_retries)
+                        )
+                    else:
+                        out.append(("relay",))
+                else:
+                    out.append(("pending",))
+            return out
         if op == "pg_state":
             pg = self.placement_groups.get(args[0])
             return None if pg is None else pg.state
@@ -2801,11 +2974,20 @@ class Scheduler:
         if op == "node_stats":
             return self.node_stats()
         if op == "event_stats":
-            # parity: event_stats.h handler instrumentation
-            return {
+            # parity: event_stats.h handler instrumentation. __loop__ gives
+            # this scheduler thread's cumulative CPU vs wall time — the
+            # head-bound-or-box-bound discriminator: a saturated single
+            # thread shows cpu_s/wall_s near 1.0 (this rpc runs ON the loop
+            # thread, so CLOCK_THREAD_CPUTIME_ID is the loop's own clock)
+            out = {
                 k: {"count": int(c), "total_s": t, "mean_us": (t / c * 1e6 if c else 0.0)}
                 for k, (c, t) in self._event_stats.items()
             }
+            out["__loop__"] = {
+                "cpu_s": time.clock_gettime(time.CLOCK_THREAD_CPUTIME_ID),
+                "wall_s": time.monotonic() - self._loop_started_at,
+            }
+            return out
         raise ValueError(f"unknown rpc {op}")
 
     # ---- misc ------------------------------------------------------------
@@ -2892,6 +3074,26 @@ class Scheduler:
             )
 
     def _maybe_free(self, oid: ObjectID):
+        """Refcount hit zero: schedule the free after a short grace window.
+
+        Ref traffic converges on the head from independent channels (caller
+        pipes, the direct-actor escalation path, completion unpins), so a
+        count can transiently touch zero before a (+) already in flight
+        lands — e.g. a dep-resolved task completing (unpin) before its arg's
+        ownership-escalation transfer is processed. Freeing on the transient
+        zero deletes a live object; the grace window lets stragglers arrive
+        (parity: the reference tolerates the same lag via owner-side
+        deletion — only the owner decides an object is out of scope)."""
+        self._deferred_frees.append((time.monotonic() + 2.0, oid))
+
+    def _sweep_deferred_frees(self) -> None:
+        now = time.monotonic()
+        while self._deferred_frees and self._deferred_frees[0][0] <= now:
+            _, oid = self._deferred_frees.popleft()
+            if self._ref_counts.get(oid, 0) <= 0:
+                self._free_object(oid)
+
+    def _free_object(self, oid: ObjectID):
         self._xfer_waiting.pop(oid, None)
         if self._shm_xfer_failed:
             self._shm_xfer_failed = {

@@ -31,6 +31,14 @@ class Arg:
     object_id: Optional[ObjectID] = None
     is_ref: bool = False
 
+    # tuple state: args ride every task message — skip the per-instance
+    # __dict__ that default dataclass pickling emits
+    def __getstate__(self):
+        return (self.value, self.object_id, self.is_ref)
+
+    def __setstate__(self, state):
+        self.value, self.object_id, self.is_ref = state
+
 
 @dataclass
 class SchedulingStrategy:
@@ -76,6 +84,38 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # streaming generator
     is_streaming: bool = False
+
+    # positional state (see Arg): specs are the bulk of control-plane bytes
+    _STATE_FIELDS = (
+        "task_id",
+        "task_type",
+        "function",
+        "args",
+        "kwargs",
+        "num_returns",
+        "resources",
+        "name",
+        "actor_id",
+        "lifetime_resources",
+        "max_restarts",
+        "max_concurrency",
+        "actor_name",
+        "namespace",
+        "detached",
+        "max_task_retries",
+        "max_retries",
+        "retry_exceptions",
+        "scheduling_strategy",
+        "runtime_env",
+        "is_streaming",
+    )
+
+    def __getstate__(self):
+        return tuple(getattr(self, f) for f in self._STATE_FIELDS)
+
+    def __setstate__(self, state):
+        for f, v in zip(self._STATE_FIELDS, state):
+            setattr(self, f, v)
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]

@@ -216,25 +216,89 @@ class DriverRuntime:
         self.task_id = TaskID.for_driver(self.job_id)
         self._put_counter = _Counter()
         self.closed = False
+        # direct actor-call plane (parity: actor_task_submitter.h:73): calls
+        # go caller->worker; results commit into the SHARED memory store from
+        # the pump thread, so the normal get/wait planes see them — the
+        # scheduler loop is only touched to wake parked dep/pull waiters
+        self._direct = None
+        if getattr(self.config, "direct_actor_calls", True):
+            from ray_tpu._private.direct_actor import DirectActorClient
+
+            self._direct = DirectActorClient(
+                self,
+                self.scheduler.memory_store,
+                self._direct_on_commit,
+                shared_store=True,
+            )
 
     # -- refs --------------------------------------------------------------
     # Ref ops post individually (no driver-side batching): a buffer would
     # need a lock that ObjectRef.__del__ can re-enter via GC (deadlock) and
     # delays adds past the transit-pin TTL. The cheap part of posting —
     # skipping the wakeup syscall when the loop is already signaled — lives
-    # in Scheduler.post instead.
+    # in Scheduler.post instead. Refs to direct-call results are counted in
+    # process (this driver OWNS them) and never touch the loop until the
+    # ref escapes to another process (ensure_published escalation).
 
     def add_refs(self, oids):
+        if self._direct is not None:
+            oids = self._direct.add_refs(oids)
+            if not oids:
+                return
         self.scheduler.post(("ref_batch", [(1, oid) for oid in oids]))
 
     def remove_refs(self, oids):
+        if self._direct is not None:
+            oids = self._direct.remove_refs(oids)
+            if not oids:
+                return
         self.scheduler.post(("ref_batch", [(-1, oid) for oid in oids]))
 
     def transit_pin(self, pairs):
+        if self._direct is not None:
+            self._direct.ensure_published([oid for oid, _ in pairs])
         self.scheduler.post(("ref_batch", [(2, oid, tok) for oid, tok in pairs]))
 
     def transit_release(self, pairs):
         self.scheduler.post(("ref_batch", [(3, oid, tok) for oid, tok in pairs]))
+
+    # -- direct-plane runtime hooks (see DirectActorClient) ----------------
+
+    def pin_external(self, oids):
+        self.scheduler.post(("ref_batch", [(1, oid) for oid in oids]))
+
+    def unpin_external(self, oids):
+        self.scheduler.post(("ref_batch", [(-1, oid) for oid in oids]))
+
+    def publish_external(self, items):
+        self.scheduler.post(("direct_publish", list(items)))
+
+    def handle_count_external(self, actor_id, delta: int):
+        self.scheduler.post(("handle_count", actor_id, delta))
+
+    def legacy_submit(self, spec: TaskSpec):
+        arg_refs = spec.arg_ref_ids()
+        if arg_refs:
+            self.ensure_published(arg_refs)
+            # pin at the HEAD (not the local owned table): the head releases
+            # this exact pin at task completion — a locally-routed pin would
+            # leave its unpin unmatched head-side
+            self.pin_external(arg_refs)
+        self.scheduler.submit(spec)
+
+    def ensure_published(self, oids):
+        if self._direct is not None and oids:
+            self._direct.ensure_published(oids)
+
+    def _direct_on_commit(self, oids):
+        # results are already visible in the shared memory store; the loop
+        # only needs a nudge when something is PARKED on them (a WAITING_DEPS
+        # task or a worker pull). Both dicts are only mutated by the loop,
+        # and the loop re-checks the store after parking (see _handle_pull /
+        # _on_submit), so a racy emptiness probe here cannot lose a wake.
+        s = self.scheduler
+        if s._dep_waiters or s._pull_waiters:
+            s.post(("direct_wake", list(oids)))
 
 
     # -- object plane ------------------------------------------------------
@@ -272,6 +336,8 @@ class DriverRuntime:
         ms = self.scheduler.memory_store
         deadline = None if timeout is None else time.monotonic() + timeout
         missing = list(dict.fromkeys(o for o in oids if not ms.contains(o)))
+        if missing and self._direct is not None:
+            self._direct.flush()
         if missing:
             ready = ms.wait_for(missing, timeout)
             if len(ready) < len(missing):
@@ -312,6 +378,14 @@ class DriverRuntime:
             budget = 60.0 if timeout is None else min(float(timeout), 60.0)
             deadline = time.monotonic() + budget
             mv = self.store.get(oid, timeout=0.05)
+            if mv is None and self._direct is not None:
+                # a direct actor-call return stored on the executing worker's
+                # node: the reply carried that node's shm dir — zero-copy it
+                d = self._direct.stored_dirs.get(oid)
+                if d:
+                    from ray_tpu._private.object_transfer import read_peer_pinned
+
+                    mv = read_peer_pinned(d, oid)
             if mv is None:
                 mv = self._read_same_host_peer(oid)
             while mv is None:
@@ -334,6 +408,8 @@ class DriverRuntime:
 
     def wait(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):
         ms = self.scheduler.memory_store
+        if self._direct is not None:
+            self._direct.flush()
         ready = ms.wait_num(oids, num_returns, timeout)
         ready_set = set(ready[:num_returns])
         return (
@@ -344,19 +420,35 @@ class DriverRuntime:
     # -- task plane --------------------------------------------------------
 
     def submit(self, spec: TaskSpec) -> None:
-        # pin ref args for the duration of the task (submitted-task references,
-        # parity: reference_count.h). add_ref is posted to the same command
-        # queue *before* submit, so a subsequent ObjectRef.__del__ remove_ref
-        # can never drop the count to zero while the task is in flight.
-        arg_refs = spec.arg_ref_ids()
-        if arg_refs:
-            self.add_refs(arg_refs)
-        self.scheduler.submit(spec)
+        # actor method calls ride the direct plane straight to the target
+        # worker when possible; everything else goes through the scheduler.
+        # For the legacy path, pin ref args for the duration of the task
+        # (submitted-task references, parity: reference_count.h). add_ref is
+        # posted to the same command queue *before* submit, so a subsequent
+        # ObjectRef.__del__ remove_ref can never drop the count to zero
+        # while the task is in flight.
+        if (
+            self._direct is not None
+            and spec.task_type == TaskType.ACTOR_TASK
+            and self._direct.submit(spec)
+        ):
+            return
+        self.legacy_submit(spec)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool):
+        if self._direct is not None:
+            self._direct.flush()  # buffered calls precede the kill
         self.scheduler.post(("kill_actor", actor_id, no_restart))
+        if no_restart and self._direct is not None:
+            self._direct.mark_killed(actor_id)
 
     def actor_handle_count(self, actor_id: ActorID, delta: int):
+        if (
+            delta < 0
+            and self._direct is not None
+            and self._direct.handle_release(actor_id)
+        ):
+            return  # deferred until this process's in-flight calls drain
         self.scheduler.post(("handle_count", actor_id, delta))
 
     def rpc(self, op: str, *args):
@@ -397,6 +489,8 @@ class DriverRuntime:
 
     def shutdown(self):
         self.closed = True
+        if self._direct is not None:
+            self._direct.shutdown()
         from ray_tpu._private import usage
 
         if usage.usage_stats_enabled():

@@ -35,6 +35,108 @@ from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, TaskSpec, TaskType
 
 
+class _ReplyBuf:
+    """Per-connection result buffer: consecutive serial-actor results for
+    one caller flush as a single batched message (mirrors the caller's
+    submit batching — one pickle+syscall per batch)."""
+
+    __slots__ = ("conn", "send_lock", "items")
+
+    def __init__(self, conn, send_lock):
+        self.conn = conn
+        self.send_lock = send_lock
+        self.items: list = []
+
+    def flush(self):
+        if not self.items:
+            return
+        batch, self.items = self.items, []
+        try:
+            with self.send_lock:
+                self.conn.send(("results", batch))
+        except (OSError, EOFError, BrokenPipeError):
+            pass
+
+
+class _DirectCall:
+    """An actor call that arrived on the worker's direct listener; the result
+    returns on the same connection instead of the head pipe."""
+
+    __slots__ = ("spec", "conn", "send_lock", "buf")
+
+    def __init__(self, spec, conn, send_lock, buf):
+        self.spec = spec
+        self.conn = conn
+        self.send_lock = send_lock
+        self.buf = buf
+
+
+class DirectServer:
+    """Per-worker listener for direct actor calls (parity: the worker's gRPC
+    server receiving PushTask from peer CoreWorkers, ``task_receiver.h:51``).
+    One reader thread per caller connection preserves per-caller FIFO; the
+    exec queue (serial actors) or thread pool (max_concurrency>1) provides
+    the same ordering domains as head-relayed execution."""
+
+    def __init__(self, rt, host: str):
+        from multiprocessing.connection import Listener
+
+        self._rt = rt
+        self._closed = False
+        key = (rt.config.cluster_auth_key or "").encode()
+        self._listener = Listener((host, 0), authkey=key, backlog=64)
+        self.address = self._listener.address
+        threading.Thread(
+            target=self._accept_loop, name="direct-accept", daemon=True
+        ).start()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        import multiprocessing as mp
+
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, mp.AuthenticationError):
+                if self._closed:
+                    return
+                continue
+            try:
+                from ray_tpu._private.object_transfer import set_nodelay
+
+                set_nodelay(conn)
+            except Exception:
+                pass
+            threading.Thread(
+                target=self._reader, args=(conn,), name="direct-conn", daemon=True
+            ).start()
+
+    def _reader(self, conn):
+        send_lock = threading.Lock()
+        buf = _ReplyBuf(conn, send_lock)
+        try:
+            while True:
+                msg = conn.recv()
+                if msg[0] == "calls":
+                    for spec in msg[1]:
+                        self._rt.exec_queue.put(_DirectCall(spec, conn, send_lock, buf))
+                elif msg[0] == "call":
+                    self._rt.exec_queue.put(_DirectCall(msg[1], conn, send_lock, buf))
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
 class WorkerRuntime:
     """Per-worker runtime; installed as the global runtime inside workers so
     ``ray_tpu.get/put/remote`` work from task code (nested tasks)."""
@@ -59,6 +161,52 @@ class WorkerRuntime:
         # pickled-function blob -> deserialized callable/method-name (parity:
         # the reference's per-worker function table; same blob = same object)
         self._fn_cache: Dict[bytes, Any] = {}
+        # direct actor-call plane (this worker as CALLER); results it owns
+        # live in a process-local store, not at the head
+        self._direct = None
+        if getattr(config, "direct_actor_calls", True):
+            from ray_tpu._private.direct_actor import DirectActorClient
+            from ray_tpu._private.scheduler import MemoryStore
+
+            # MemoryStore (the head's in-process result store) doubles as
+            # the caller-local plane — same waiter-indexed wait path; the
+            # scheduler module is already in the forkserver preload
+            self._direct = DirectActorClient(self, MemoryStore())
+
+    # -- direct-plane runtime hooks (see DirectActorClient docstring) ------
+
+    def pin_external(self, oids):
+        self._send(("cmd", ("pin_args", list(oids))))
+
+    def unpin_external(self, oids):
+        self._send(("cmd", ("unpin_args", list(oids))))
+
+    def publish_external(self, items):
+        self._send(("cmd", ("direct_publish", list(items))))
+
+    def handle_count_external(self, actor_id, delta: int):
+        self._send(("cmd", ("handle_count", actor_id, delta)))
+
+    def legacy_submit(self, spec: TaskSpec):
+        arg_refs = spec.arg_ref_ids()
+        if arg_refs:
+            self.ensure_published(arg_refs)
+            self._send(("cmd", ("pin_args", arg_refs)))
+        self._send(("submit", spec))
+
+    def ensure_published(self, oids):
+        if self._direct is not None and oids:
+            self._direct.ensure_published(oids)
+
+    def _direct_entry(self, oid):
+        if self._direct is None:
+            return None
+        entry = self._direct.store.get_entry(oid)
+        if entry is not None and entry[0] == "stored":
+            d = self._direct.stored_dirs.get(oid)
+            if d:
+                return ("stored", [d])
+        return entry
 
     # -- task context (per executing thread) ------------------------------
 
@@ -138,21 +286,75 @@ class WorkerRuntime:
             if mv is not None:
                 out[oid] = self.serde.deserialize_from(mv)
                 errs[oid] = False
+                continue
+            entry = self._direct_entry(oid)
+            if entry is not None:
+                out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
             else:
                 missing.append(oid)
         missing = list(dict.fromkeys(missing))
+        if missing and self._direct is not None:
+            self._direct.flush()
+        if missing and self._direct is not None and all(
+            self._direct.routes_local(o) for o in missing
+        ):
+            # pure direct-plane get (the actor-call hot path): block on the
+            # local result store with no head traffic at all. Non-actor
+            # workers still report blocking so their held resources free
+            # (actor workers hold dedicated lifetime resources — no-op).
+            announce_block = self._actor_id is None
+            if announce_block:
+                self._send(("block_begin",))
+            try:
+                deadline = None if timeout is None else time.monotonic() + timeout
+                pending = list(missing)
+                while pending:
+                    remaining = 0.5 if deadline is None else min(
+                        0.5, deadline - time.monotonic()
+                    )
+                    if remaining <= 0:
+                        raise exc.GetTimeoutError(
+                            f"get timed out on {len(pending)} objects"
+                        )
+                    self._direct.store.wait_for(pending, remaining)
+                    nxt = []
+                    for oid in pending:
+                        entry = self._direct_entry(oid)
+                        if entry is None:
+                            nxt.append(oid)
+                        else:
+                            out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
+                    pending = nxt
+                    if pending and not all(
+                        self._direct.routes_local(o) for o in pending
+                    ):
+                        # a channel fell back to the head relay mid-wait:
+                        # finish on the general (pull) path below
+                        break
+            finally:
+                if announce_block:
+                    self._send(("block_end",))
+            missing = pending
         if missing:
             self._send(("block_begin",))
             req_id, q = self._register_req()
             try:
                 deadline = None if timeout is None else time.monotonic() + timeout
                 pending = set(missing)
-                self._send(("pull", req_id, missing))
+                # direct-plane oids commit locally; registering head pulls for
+                # them would park waiters at the head forever
+                pulled = {
+                    o
+                    for o in missing
+                    if self._direct is None or not self._direct.routes_local(o)
+                }
+                if pulled:
+                    self._send(("pull", req_id, list(pulled)))
                 # the scheduler always replies once immediately (inline values
                 # arrive only through that reply) — a user timeout shorter
                 # than the round-trip must not fail already-complete gets, so
                 # the deadline only applies after the initial reply
-                got_initial = False
+                got_initial = not pulled
                 initial_deadline = time.monotonic() + 30.0
                 while pending:
                     try:
@@ -175,6 +377,22 @@ class WorkerRuntime:
                             out[oid] = self.serde.deserialize_from(mv)
                             errs[oid] = False
                             pending.discard(oid)
+                            continue
+                        entry = self._direct_entry(oid)
+                        if entry is not None:
+                            out[oid], errs[oid] = self._entry_value(oid, entry, timeout)
+                            pending.discard(oid)
+                    # a channel that fell back to the head relay moves its
+                    # oids onto the head plane: pull the ones we skipped
+                    if self._direct is not None:
+                        newly = [
+                            o
+                            for o in pending
+                            if o not in pulled and not self._direct.routes_local(o)
+                        ]
+                        if newly:
+                            pulled.update(newly)
+                            self._send(("pull", req_id, newly))
                     now = time.monotonic()
                     if pending and deadline is not None and now >= deadline:
                         if got_initial:
@@ -260,14 +478,25 @@ class WorkerRuntime:
         initial reply plus per-object follow-ups (no per-poll churn)."""
         ready: List[ObjectID] = []
         pending = list(dict.fromkeys(oids))
+        if self._direct is not None:
+            self._direct.flush()
         deadline = None if timeout is None else time.monotonic() + timeout
         req_id, q = self._register_req()
         try:
-            self._send(("pull", req_id, pending))
+            pulled = {
+                o
+                for o in pending
+                if self._direct is None or not self._direct.routes_local(o)
+            }
+            if pulled:
+                self._send(("pull", req_id, list(pulled)))
             pending = set(pending)
             while True:
                 for oid in list(pending):
-                    if self.store.contains(oid):
+                    if self.store.contains(oid) or (
+                        self._direct is not None
+                        and self._direct.store.contains(oid)
+                    ):
                         ready.append(oid)
                         pending.discard(oid)
                 try:
@@ -279,6 +508,15 @@ class WorkerRuntime:
                         if oid in pending and entry[0] != "pending":
                             ready.append(oid)
                             pending.discard(oid)
+                if self._direct is not None:
+                    newly = [
+                        o
+                        for o in pending
+                        if o not in pulled and not self._direct.routes_local(o)
+                    ]
+                    if newly:
+                        pulled.update(newly)
+                        self._send(("pull", req_id, newly))
                 if len(ready) >= num_returns or not pending:
                     break
                 if deadline is not None and time.monotonic() >= deadline:
@@ -290,8 +528,17 @@ class WorkerRuntime:
         return sel, [o for o in oids if o not in sel_set]
 
     def submit(self, spec: TaskSpec):
+        if (
+            self._direct is not None
+            and spec.task_type == TaskType.ACTOR_TASK
+            and self._direct.submit(spec)
+        ):
+            return
         arg_refs = spec.arg_ref_ids()
         if arg_refs:
+            # direct-plane results escaping into a head-routed task must be
+            # head-visible (and head-owned) before the task resolves them
+            self.ensure_published(arg_refs)
             # in-flight arg pins: released by the SCHEDULER at task
             # completion, so they must stay unattributed — attributing them
             # to this worker would make worker death release them a second
@@ -314,12 +561,26 @@ class WorkerRuntime:
         return result
 
     def object_ready(self, oid: ObjectID) -> bool:
-        return self.store.contains(oid) or bool(self.rpc("object_ready", oid))
+        if self.store.contains(oid):
+            return True
+        if self._direct is not None and self._direct.store.contains(oid):
+            return True
+        return bool(self.rpc("object_ready", oid))
 
     def kill_actor(self, actor_id, no_restart: bool):
+        if self._direct is not None:
+            self._direct.flush()  # buffered calls precede the kill
         self._send(("cmd", ("kill_actor", actor_id, no_restart)))
+        if no_restart and self._direct is not None:
+            self._direct.mark_killed(actor_id)
 
     def actor_handle_count(self, actor_id, delta: int):
+        if (
+            delta < 0
+            and self._direct is not None
+            and self._direct.handle_release(actor_id)
+        ):
+            return  # deferred until this process's in-flight calls drain
         self._send(("cmd", ("handle_count", actor_id, delta)))
 
     def new_task_id(self) -> TaskID:
@@ -327,9 +588,18 @@ class WorkerRuntime:
         return TaskID.for_task(base.actor_id())
 
     def add_refs(self, oids):
+        if self._direct is not None:
+            oids = self._direct.add_refs(oids)
+            if not oids:
+                return
         self._send(("cmd", ("add_ref", list(oids))))
 
     def transit_pin(self, pairs):
+        # serializing a locally-owned ref hands it to another process:
+        # escalate ownership to the head first so the borrower protocol
+        # (token pin below + the consumer's add/release) has a home there
+        if self._direct is not None:
+            self.ensure_published([oid for oid, _ in pairs])
         self._send(
             ("cmd", ("ref_batch", [(2, oid, tok) for oid, tok in pairs]))
         )
@@ -340,6 +610,10 @@ class WorkerRuntime:
         )
 
     def remove_refs(self, oids):
+        if self._direct is not None:
+            oids = self._direct.remove_refs(oids)
+            if not oids:
+                return
         self._send(("cmd", ("remove_ref", list(oids))))
 
     # -- execution ---------------------------------------------------------
@@ -483,6 +757,7 @@ class WorkerRuntime:
             if spec.is_streaming:
                 # streaming generator: report items as they are produced
                 # (parity: HandleReportGeneratorItemReturns, task_manager.h:355)
+                reply = getattr(self._tls, "direct_reply", None)
                 count = 0
                 for item in result:
                     blob = self.serde.serialize_to_bytes(item)
@@ -491,9 +766,30 @@ class WorkerRuntime:
                         if len(blob) <= self.config.max_direct_call_object_size
                         else ("stored",)
                     )
+                    item_oid = ObjectID.for_return(spec.task_id, count + 1)
                     if entry[0] == "stored":
-                        self.store.put_bytes(ObjectID.for_return(spec.task_id, count + 1), blob)
-                    self._send(("generator_item", spec.task_id, count + 1, entry))
+                        self.store.put_bytes(item_oid, blob)
+                    if reply is not None:
+                        # direct caller: the item rides its connection; large
+                        # items additionally register at the head so any
+                        # borrower can locate the stored copy
+                        if entry[0] == "stored":
+                            self._send(("submit_put", item_oid))
+                        try:
+                            with reply.send_lock:
+                                reply.conn.send(
+                                    (
+                                        "gen_item",
+                                        spec.task_id.binary(),
+                                        count + 1,
+                                        entry,
+                                        getattr(self, "shm_dir", ""),
+                                    )
+                                )
+                        except (OSError, EOFError, BrokenPipeError):
+                            pass
+                    else:
+                        self._send(("generator_item", spec.task_id, count + 1, entry))
                     count += 1
                 return [("inline", self.serde.serialize_to_bytes(count))]
             return self._store_results(spec, result)
@@ -570,6 +866,10 @@ class _TeeStream:
 
 def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, config_blob: bytes):
     """Entry point for spawned worker processes."""
+    if os.environ.get("RAY_TPU_BOOT_TRACE"):
+        import sys as _sys
+
+        _sys.stderr.write(f"BOOT enter {time.monotonic():.4f}\n")
     import ray_tpu._private.worker as worker_mod
     from ray_tpu._private.native_store import create_store_client
 
@@ -599,11 +899,31 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
     reader = threading.Thread(target=rt.reader_loop, name="reader", daemon=True)
     reader.start()
-    conn.send(("ready",))
+
+    # direct actor-call listener (this worker as CALLEE); its address rides
+    # the ready message into the head's worker table for resolve_actors
+    direct_server = None
+    if getattr(config, "direct_actor_calls", True):
+        try:
+            direct_server = DirectServer(
+                rt, getattr(config, "node_host", "127.0.0.1")
+            )
+        except Exception:
+            direct_server = None
+    if os.environ.get("RAY_TPU_BOOT_TRACE"):
+        import sys as _sys
+
+        _sys.stderr.write(f"BOOT ready {time.monotonic():.4f}\n")
+    conn.send(("ready", direct_server.address if direct_server else None))
 
     pool: Optional[ThreadPoolExecutor] = None
 
-    def run_one(spec: TaskSpec):
+    def run_one(item, buffer_ok=False):
+        if isinstance(item, _DirectCall):
+            spec, reply = item.spec, item
+        else:
+            spec, reply = item, None
+        rt._tls.direct_reply = reply
         try:
             results = rt.execute(spec)
         except SystemExit:
@@ -615,29 +935,71 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
                 pass
             rt.exec_queue.put(None)
             return
+        finally:
+            rt._tls.direct_reply = None
+        if reply is not None:
+            # large returns live in this node's store: register the location
+            # at the head BEFORE the caller learns of them, so a borrower's
+            # ensure_local can always find a copy
+            for i, entry in enumerate(results):
+                if entry[0] == "stored":
+                    try:
+                        rt._send(("submit_put", ObjectID.for_return(spec.task_id, i)))
+                    except (EOFError, OSError):
+                        pass
+            msg = ("result", spec.task_id.binary(), results, getattr(rt, "shm_dir", ""))
+            if buffer_ok:
+                item.buf.items.append(msg)
+                return
+            try:
+                with reply.send_lock:
+                    reply.conn.send(msg)
+            except (OSError, EOFError, BrokenPipeError):
+                pass
+            return
         try:
             rt._send(("task_done", spec.task_id, results))
         except (EOFError, OSError):
             pass
 
+    # single-slot reply batching: results for one caller's consecutive
+    # serial calls accumulate and flush when the queue drains, the batch
+    # caps, or execution switches to another caller's connection
+    pending_buf: Optional[_ReplyBuf] = None
     try:
         while True:
-            spec = rt.exec_queue.get()
-            if spec is None:
+            item = rt.exec_queue.get()
+            if item is None:
                 break
+            buf = item.buf if isinstance(item, _DirectCall) else None
+            if pending_buf is not None and buf is not pending_buf:
+                pending_buf.flush()
+                pending_buf = None
+            spec = item.spec if isinstance(item, _DirectCall) else item
             if spec.task_type == TaskType.ACTOR_CREATION:
-                run_one(spec)
+                run_one(item)
                 if spec.max_concurrency > 1:
                     pool = ThreadPoolExecutor(
                         max_workers=spec.max_concurrency, thread_name_prefix="actor"
                     )
             elif spec.task_type == TaskType.ACTOR_TASK and pool is not None:
-                pool.submit(run_one, spec)
+                pool.submit(run_one, item)
+            elif buf is not None and spec.task_type == TaskType.ACTOR_TASK:
+                run_one(item, buffer_ok=True)
+                if len(buf.items) >= 16 or rt.exec_queue.empty():
+                    buf.flush()
+                    pending_buf = None
+                else:
+                    pending_buf = buf
             else:
-                run_one(spec)
+                run_one(item)
     except SystemExit:
         pass
     finally:
+        if pending_buf is not None:
+            pending_buf.flush()
+        if direct_server is not None:
+            direct_server.close()
         if pool is not None:
             pool.shutdown(wait=False)
         store.close()

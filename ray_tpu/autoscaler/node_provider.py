@@ -124,21 +124,47 @@ class LocalDaemonNodeProvider(NodeProvider):
 
 
 class TPUVMNodeProvider(NodeProvider):
-    """TPU-VM (GCE) provider skeleton.
+    """TPU-VM (GCE) provider.
 
     Issues ``gcloud compute tpus tpu-vm`` commands (create/delete/list) —
     slice-granular: one "node" here is one pod slice (indivisible across
     jobs, SURVEY.md §7 step 4). Requires gcloud credentials on the head;
     raises a clear error when unavailable instead of silently no-oping.
+
+    State discipline (parity: the reference's GCP provider reconciling
+    against the cloud, ``autoscaler/_private/gcp/node_provider.py``):
+
+    * ``non_terminated_nodes`` RECONCILES against ``gcloud ... list`` —
+      slices this provider forgot (head crash) are re-adopted by their
+      cluster label, and slices the cloud no longer reports are dropped.
+      The list is cached for ``list_cache_s`` to spare the API.
+    * the slice table is mirrored into the cluster KV (which rides the GCS
+      snapshot), so a restarted head sees its billable slices even before
+      the first reconcile completes.
     """
 
-    def __init__(self, project: str, zone: str, version: str = "tpu-ubuntu2204-base"):
+    _KV_NS = "autoscaler"
+    _KV_KEY = b"tpu_vm_nodes"
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        version: str = "tpu-ubuntu2204-base",
+        cluster_name: str = "default",
+        list_cache_s: float = 10.0,
+    ):
         self.project = project
         self.zone = zone
         self.version = version
-        self._nodes: Dict[str, dict] = {}
+        self.cluster_name = cluster_name
+        self.list_cache_s = list_cache_s
+        self._nodes: Dict[str, dict] = self._load_kv()
+        self._last_list = 0.0
 
-    def _gcloud(self, *args: str) -> str:
+    # -- seams (tests monkeypatch _run_gcloud) -----------------------------
+
+    def _run_gcloud(self, *args: str) -> str:
         import subprocess
 
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
@@ -148,13 +174,42 @@ class TPUVMNodeProvider(NodeProvider):
             raise RuntimeError(f"gcloud failed: {proc.stderr[-2000:]}")
         return proc.stdout
 
+    def _kv_rpc(self, op: str, *args):
+        try:
+            from ray_tpu._private.worker import get_runtime
+
+            return get_runtime().rpc(op, *args)
+        except Exception:
+            return None  # no cluster attached (unit use): KV mirror off
+
+    def _save_kv(self) -> None:
+        import pickle
+
+        self._kv_rpc(
+            "kv_put", self._KV_NS, self._KV_KEY, pickle.dumps(self._nodes), True
+        )
+
+    def _load_kv(self) -> Dict[str, dict]:
+        import pickle
+
+        blob = self._kv_rpc("kv_get", self._KV_NS, self._KV_KEY)
+        if blob:
+            try:
+                return dict(pickle.loads(blob))
+            except Exception:
+                return {}
+        return {}
+
+    # -- provider API ------------------------------------------------------
+
     def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
         # node_type is the accelerator type, e.g. "v5litepod-16"
         name = f"ray-tpu-{node_type}-{uuid.uuid4().hex[:6]}"
-        self._gcloud(
+        self._run_gcloud(
             "create", name,
             f"--accelerator-type={node_type}",
             f"--version={self.version}",
+            f"--labels=ray-tpu-cluster={self.cluster_name}",
         )
         self._nodes[name] = {
             "node_id": name,
@@ -162,11 +217,52 @@ class TPUVMNodeProvider(NodeProvider):
             "resources": dict(resources),
             "launched_at": time.time(),
         }
+        self._save_kv()
         return name
 
     def terminate_node(self, node_id: str) -> None:
-        self._gcloud("delete", node_id, "--quiet")
+        self._run_gcloud("delete", node_id, "--quiet")
         self._nodes.pop(node_id, None)
+        self._save_kv()
+
+    def _reconcile(self) -> None:
+        import json
+
+        try:
+            raw = self._run_gcloud("list")
+        except Exception:
+            return  # transient API failure: keep the last known table
+        try:
+            listed = json.loads(raw) if raw.strip() else []
+        except ValueError:
+            return
+        live: Dict[str, dict] = {}
+        for entry in listed:
+            name = str(entry.get("name", "")).rsplit("/", 1)[-1]
+            labels = entry.get("labels") or {}
+            if labels.get("ray-tpu-cluster") != self.cluster_name:
+                continue
+            state = str(entry.get("state", "")).upper()
+            if state in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            known = self._nodes.get(name)
+            accel = str(entry.get("acceleratorType", "")).rsplit("/", 1)[-1]
+            live[name] = known or {
+                # a slice this provider forgot (head crash before the KV
+                # mirror landed): re-adopt it — it is billable either way
+                "node_id": name,
+                "node_type": accel,
+                "resources": {},
+                "launched_at": time.time(),
+                "adopted": True,
+            }
+        if live != self._nodes:
+            self._nodes = live
+            self._save_kv()
 
     def non_terminated_nodes(self) -> List[dict]:
+        now = time.time()
+        if now - self._last_list >= self.list_cache_s:
+            self._last_list = now
+            self._reconcile()
         return list(self._nodes.values())

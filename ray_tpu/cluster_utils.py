@@ -84,7 +84,11 @@ def spawn_daemon_process(
         ],
         env=env,
         stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
+        stderr=(
+            None
+            if os.environ.get("RAY_TPU_DAEMON_STDERR")
+            else subprocess.DEVNULL
+        ),
     )
     if not wait:
         return proc, None

@@ -170,7 +170,11 @@ class Dataset:
         from ray_tpu.data.streaming_executor import ActorMapStage
 
         return self._with_stage(
-            ActorMapStage(cloudpickle.dumps(fn), strategy.size)
+            ActorMapStage(
+                cloudpickle.dumps(fn),
+                strategy.size,
+                max_size=getattr(strategy, "max_size", None),
+            )
         )
 
     def filter(self, fn: Callable) -> "Dataset":

@@ -57,17 +57,44 @@ def _window() -> int:
     return max(1, DataContext.get_current().max_inflight_blocks)
 
 
-def _windowed(submitted: Iterator, window: int) -> Iterator:
+def _windowed(submitted: Iterator, window: int, name: str = "stage") -> Iterator:
     """The backpressure core shared by every stage: pull (and thereby
-    submit) up to ``window`` items ahead of the consumer, release in FIFO
-    order. Block order is always preserved."""
-    pending: deque = deque()
-    for ref in submitted:
-        pending.append(ref)
-        if len(pending) >= window:
-            yield pending.popleft()
-    while pending:
-        yield pending.popleft()
+    submit) ahead of the consumer while the POLICY CHAIN allows, release in
+    FIFO order (block order is always preserved). The fixed window is one
+    policy; a memory cap on ready-but-unconsumed output is another — see
+    ``data/backpressure.py``. When policies block, the stage drains instead
+    of submitting: the slow consumer throttles the fast producer."""
+    from ray_tpu.data import backpressure as bp
+
+    stats = bp.StageStats(name)
+    policies = bp.build_policies(stats, window)
+    bp.track_stats(stats)
+    pending = stats.pending
+    exhausted = False
+    while True:
+        while not exhausted and all(p.can_submit(stats) for p in policies):
+            try:
+                ref = next(submitted)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append(ref)
+            stats.submitted += 1
+        if not pending:
+            if exhausted:
+                return
+            # every policy refused with nothing in flight — yield anyway via
+            # one forced submission so the pipeline cannot wedge
+            try:
+                ref = next(submitted)
+            except StopIteration:
+                return
+            pending.append(ref)
+            stats.submitted += 1
+        ref = pending.popleft()
+        stats._size_cache.pop(ref.id(), None)
+        stats.consumed += 1
+        yield ref
 
 
 class SourceStage:
@@ -84,6 +111,7 @@ class SourceStage:
                 for item in self.items
             ),
             _window(),
+            name="source",
         )
 
 
@@ -107,6 +135,7 @@ class TaskMapStage:
         return _windowed(
             (_exec_block.remote(ref, self.ops) for ref in upstream),
             _window(),
+            name=f"map[{len(self.ops)} ops]",
         )
 
 
@@ -115,16 +144,21 @@ class ActorMapStage:
     model weights etc. — amortized across blocks).
 
     Lazy: the pool is created when the stream is first pulled, not at plan
-    time, and blocks are dispatched round-robin with a bounded per-pool
-    window — the plan-time full-drain barrier this replaces is exactly the
-    reference's motivation for running ActorPoolMapOperator inside the
-    streaming executor.
+    time, and blocks are dispatched least-loaded with a bounded per-pool
+    window. The pool AUTOSCALES under backlog (parity:
+    ``execution/autoscaler/``): when every worker already has
+    ``grow_threshold`` unfinished blocks and the pool is below ``max_size``,
+    a worker is added before the next dispatch.
     """
 
-    def __init__(self, fn_blob: bytes, size: int):
+    GROW_THRESHOLD = 2  # outstanding blocks per worker before growing
+
+    def __init__(self, fn_blob: bytes, size: int, max_size: Optional[int] = None):
         self.fn_blob = fn_blob
         self.size = max(1, int(size))
+        self.max_size = max(self.size, int(max_size)) if max_size else self.size
         self._workers: Optional[List] = None
+        self._outstanding: List = []  # per-worker lists of pending refs
 
     def _pool(self) -> List:
         # one pool per stage, created on first pull and reused across
@@ -135,7 +169,19 @@ class ActorMapStage:
                 _ActorBlockWorker.remote(self.fn_blob)
                 for _ in range(self.size)
             ]
+            self._outstanding = [[] for _ in self._workers]
         return self._workers
+
+    def pool_size(self) -> int:
+        return len(self._workers or ())
+
+    def _reap(self) -> None:
+        import ray_tpu as _rt
+
+        for lst in self._outstanding:
+            if lst:
+                ready, rest = _rt.wait(lst, num_returns=len(lst), timeout=0)
+                lst[:] = rest
 
     def stream(self, upstream: Iterator, owned_actors: List) -> Iterator:
         workers = self._pool()
@@ -146,12 +192,27 @@ class ActorMapStage:
                 owned_actors.append(w)
 
         def submitted():
-            i = 0
             for ref in upstream:
-                yield workers[i % self.size].apply.remote(ref)
-                i += 1
+                self._reap()
+                loads = [len(x) for x in self._outstanding]
+                i = loads.index(min(loads))
+                if (
+                    loads[i] >= self.GROW_THRESHOLD
+                    and len(workers) < self.max_size
+                ):
+                    # backlog on every worker: grow the pool
+                    w = _ActorBlockWorker.remote(self.fn_blob)
+                    workers.append(w)
+                    owned_actors.append(w)
+                    self._outstanding.append([])
+                    i = len(workers) - 1
+                out = workers[i].apply.remote(ref)
+                self._outstanding[i].append(out)
+                yield out
 
-        return _windowed(submitted(), _window() * self.size)
+        return _windowed(
+            submitted(), _window() * self.max_size, name="actor_map"
+        )
 
 
 @ray_tpu.remote

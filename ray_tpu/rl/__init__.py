@@ -9,6 +9,14 @@ actors. In-tree algorithms: PPO (CartPole learning target: return >= 150,
 ``tuned_examples/ppo/cartpole-ppo.yaml:5-7``).
 """
 
+from ray_tpu.rl.appo import APPO, APPOConfig
+from ray_tpu.rl.connectors import (
+    ClipActions,
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    NormalizeObservations,
+)
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import CartPoleEnv, EnvSpec, make_env, register_env
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
@@ -19,14 +27,18 @@ from ray_tpu.rl.multi_agent import (
     MultiAgentPPOConfig,
 )
 from ray_tpu.rl.sac import SAC, SACConfig
-from ray_tpu.rl.offline import BC, MARWIL, BCConfig, MARWILConfig
+from ray_tpu.rl.offline import BC, CQL, MARWIL, BCConfig, CQLConfig, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 
 __all__ = [
     "PPO",
     "PPOConfig",
+    "APPO",
+    "APPOConfig",
     "IMPALA",
     "IMPALAConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "SAC",
@@ -43,6 +55,11 @@ __all__ = [
     "make_env",
     "register_env",
     "EnvSpec",
+    "Connector",
+    "ConnectorPipeline",
+    "NormalizeObservations",
+    "FrameStack",
+    "ClipActions",
 ]
 
 from ray_tpu._private import usage as _usage

@@ -23,16 +23,26 @@ class AlgorithmConfig:
         self.gamma = 0.99
         self.seed = 0
         self.hidden = (64, 64)
+        # zero-arg factory -> connector list/pipeline (see env_runners)
+        self.env_to_module_connector = None
 
     def environment(self, env) -> "AlgorithmConfig":
         self.env = env
         return self
 
     def env_runners(self, num_env_runners: int = 0, num_envs_per_env_runner: int = 16,
-                    rollout_fragment_length: int = 128) -> "AlgorithmConfig":
+                    rollout_fragment_length: int = 128,
+                    env_to_module_connector=None) -> "AlgorithmConfig":
+        """``env_to_module_connector``: zero-arg factory returning a list of
+        connectors (or a ConnectorPipeline) applied to observations before
+        the module sees/stores them — one fresh instance per runner (parity:
+        AlgorithmConfig.env_runners(env_to_module_connector=...),
+        rllib/connectors/)."""
         self.num_env_runners = num_env_runners
         self.num_envs_per_runner = num_envs_per_env_runner
         self.rollout_len = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

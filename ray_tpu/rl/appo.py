@@ -1,0 +1,99 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner architecture with the
+PPO clipped-surrogate objective on V-trace-corrected advantages.
+
+Parity: ``rllib/algorithms/appo/appo.py:1`` (APPO = IMPALA + surrogate
+clipping, Espeholt et al. V-trace for the off-policy correction) and the
+torch loss at ``rllib/algorithms/appo/torch/appo_torch_learner.py``. Same
+TPU-first shape as IMPALA: the learner update is ONE jitted SPMD program over
+a ``data``-axis mesh (in-process or spanning learner worker processes via
+``jax.distributed``); only the loss differs, so APPO reuses the whole
+IMPALA runner/learner plane through the update-builder registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, vtrace_targets
+from ray_tpu.rl.models import apply_mlp_policy
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        # RLlib APPO defaults: clip 0.4, lower LR than IMPALA
+        self.clip_param = 0.4
+        self.lr = 5e-4
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+def build_appo_update(cfg_vals: Dict[str, Any], optimizer):
+    """APPO learner update: V-trace targets + PPO clipped surrogate, where
+    the importance ratio is pi/mu against the BEHAVIOR policy (async: the
+    sampling policy lags the learner)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        T, N = batch["actions"].shape
+        obs = batch["obs"].reshape(T * N, -1)
+        logits, values = apply_mlp_policy(params, obs)
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        rhos = jnp.exp(logp - batch["logp"])  # pi / mu
+        vs, pg_adv = vtrace_targets(
+            values,
+            batch["last_values"],
+            batch["rewards"],
+            batch["dones"],
+            rhos,
+            cfg_vals["gamma"],
+            cfg_vals["vtrace_clip_rho"],
+            cfg_vals["vtrace_clip_c"],
+        )
+        clip = cfg_vals["clip_param"]
+        surrogate = jnp.minimum(
+            rhos * pg_adv, jnp.clip(rhos, 1.0 - clip, 1.0 + clip) * pg_adv
+        )
+        w = batch["mask"][None, :]
+        denom = jnp.maximum(jnp.sum(w) * T, 1.0)
+        pg_loss = -jnp.sum(surrogate * w) / denom
+        vf_loss = 0.5 * jnp.sum(((values - vs) ** 2) * w) / denom
+        entropy = (
+            -jnp.sum(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1) * w) / denom
+        )
+        loss = (
+            pg_loss
+            + cfg_vals["vf_loss_coeff"] * vf_loss
+            - cfg_vals["entropy_coeff"] * entropy
+        )
+        return loss, {
+            "pg_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def update(params, opt_state, batch):
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return update
+
+
+class APPO(IMPALA):
+    @classmethod
+    def _update_builder_name(cls) -> str:
+        return "appo"
+
+    @classmethod
+    def _extra_cfg_vals(cls, config) -> Dict[str, Any]:
+        return {"clip_param": float(getattr(config, "clip_param", 0.4))}

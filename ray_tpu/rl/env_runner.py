@@ -15,10 +15,34 @@ import numpy as np
 import ray_tpu
 
 
+def resolve_obs_dim(config, spec) -> int:
+    """Module input width after the env-to-module pipeline (FrameStack etc.
+    widen observations; the policy net must be built for the OUTPUT)."""
+    factory = getattr(config, "env_to_module_connector", None)
+    if factory is None:
+        return spec.obs_dim
+    return _build_pipeline(factory).out_dim(spec.obs_dim)
+
+
+def _build_pipeline(connectors):
+    if connectors is None:
+        return None
+    from ray_tpu.rl.connectors import Connector, ConnectorPipeline
+
+    if callable(connectors) and not isinstance(connectors, Connector):
+        connectors = connectors()  # per-runner factory
+    if isinstance(connectors, ConnectorPipeline):
+        return connectors
+    if isinstance(connectors, Connector):
+        return ConnectorPipeline([connectors])
+    return ConnectorPipeline(list(connectors))
+
+
 class EnvRunner:
     """Plain class; wrapped as a remote actor by EnvRunnerGroup."""
 
-    def __init__(self, env_creator, num_envs: int, rollout_len: int, seed: int):
+    def __init__(self, env_creator, num_envs: int, rollout_len: int, seed: int,
+                 connectors=None):
         from ray_tpu.train.jax_utils import ensure_platform
 
         ensure_platform()  # runners must not grab the accelerator
@@ -30,7 +54,12 @@ class EnvRunner:
         self._jax = jax
         self.vec = VectorEnv(env_creator, num_envs, seed=seed)
         self.rollout_len = rollout_len
-        self.obs = self.vec.reset()
+        # env-to-module connector pipeline (parity: rllib/connectors/):
+        # observations are transformed before the policy sees them AND
+        # before they land in the rollout, so learning matches acting
+        self.connectors = _build_pipeline(connectors)
+        raw = self.vec.reset()
+        self.obs = self.connectors(raw) if self.connectors else raw
         self.key = jax.random.PRNGKey(seed)
         self._sample_fn = jax.jit(sample_actions)
         # per-env episode bookkeeping for return metrics
@@ -54,7 +83,13 @@ class EnvRunner:
             act_buf[t] = actions
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
-            self.obs, rew, done = self.vec.step(actions)
+            env_actions = (
+                self.connectors.transform_action(actions)
+                if self.connectors
+                else actions
+            )
+            raw, rew, done = self.vec.step(env_actions)
+            self.obs = self.connectors(raw, dones=done) if self.connectors else raw
             rew_buf[t] = rew
             done_buf[t] = done
             self._ep_return += rew
@@ -89,17 +124,21 @@ class EnvRunnerGroup:
     survives runner loss and heals."""
 
     def __init__(self, env_creator, num_env_runners: int, num_envs_per_runner: int,
-                 rollout_len: int, seed: int = 0):
+                 rollout_len: int, seed: int = 0, connectors=None):
         self.local: Optional[EnvRunner] = None
         self.remote: List = []
         self._env_creator = env_creator
         self._num_envs = num_envs_per_runner
         self._rollout_len = rollout_len
         self._seed = seed
+        self._connectors = connectors  # factory: fresh pipeline per runner
         self._target = num_env_runners
         self._spawned = 0
         if num_env_runners == 0:
-            self.local = EnvRunner(env_creator, num_envs_per_runner, rollout_len, seed)
+            self.local = EnvRunner(
+                env_creator, num_envs_per_runner, rollout_len, seed,
+                connectors=connectors,
+            )
         else:
             for _ in range(num_env_runners):
                 self._spawn()
@@ -112,6 +151,7 @@ class EnvRunnerGroup:
                 self._num_envs,
                 self._rollout_len,
                 self._seed + 1000 * self._spawned,
+                connectors=self._connectors,
             )
         )
 

@@ -170,7 +170,26 @@ def impala_batch_shardings(mesh):
     }
 
 
+def resolve_update_builder(name: str):
+    """Update-builder registry shared with the multi-host learner workers
+    (which receive the NAME, not a closure, in their builder config)."""
+    if name == "appo":
+        from ray_tpu.rl.appo import build_appo_update
+
+        return build_appo_update
+    return build_impala_update
+
+
 class IMPALA(Algorithm):
+    # subclasses (APPO) swap the jitted learner update
+    @classmethod
+    def _update_builder_name(cls) -> str:
+        return "impala"
+
+    @classmethod
+    def _extra_cfg_vals(cls, config) -> Dict[str, Any]:
+        return {}
+
     def __init__(self, config: IMPALAConfig):
         super().__init__(config)
         import jax
@@ -180,8 +199,11 @@ class IMPALA(Algorithm):
         self._jax = jax
         probe = make_env(config.env)
         spec = probe.spec
+        from ray_tpu.rl.env_runner import resolve_obs_dim
+
+        obs_dim = resolve_obs_dim(config, spec)
         self.params = init_mlp_policy(
-            jax.random.PRNGKey(config.seed), spec.obs_dim, spec.num_actions, config.hidden
+            jax.random.PRNGKey(config.seed), obs_dim, spec.num_actions, config.hidden
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip), optax.adam(config.lr)
@@ -193,6 +215,7 @@ class IMPALA(Algorithm):
             config.num_envs_per_runner,
             config.rollout_len,
             seed=config.seed,
+            connectors=getattr(config, "env_to_module_connector", None),
         )
 
         self._cfg_vals = {
@@ -201,6 +224,7 @@ class IMPALA(Algorithm):
             "vtrace_clip_c": config.vtrace_clip_c,
             "vf_loss_coeff": config.vf_loss_coeff,
             "entropy_coeff": config.entropy_coeff,
+            **self._extra_cfg_vals(config),
         }
         self._group = None
         if int(config.num_learner_workers) > 1:
@@ -211,7 +235,8 @@ class IMPALA(Algorithm):
                 num_workers=int(config.num_learner_workers),
                 builder_config={
                     "cfg_vals": dict(self._cfg_vals),
-                    "obs_dim": spec.obs_dim,
+                    "update_builder": self._update_builder_name(),
+                    "obs_dim": obs_dim,
                     "num_actions": spec.num_actions,
                     "hidden": config.hidden,
                     "lr": config.lr,
@@ -232,7 +257,9 @@ class IMPALA(Algorithm):
             self._mesh = Mesh(np.array(devices), ("data",))
             replicated, batch_shardings = impala_batch_shardings(self._mesh)
             self._update = jax.jit(
-                build_impala_update(self._cfg_vals, self.optimizer),
+                resolve_update_builder(self._update_builder_name())(
+                    self._cfg_vals, self.optimizer
+                ),
                 in_shardings=(replicated, replicated, batch_shardings),
                 out_shardings=(replicated, replicated, replicated),
             )

@@ -51,7 +51,10 @@ class SPMDLearnerWorker:
         import optax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        from ray_tpu.rl.impala import build_impala_update, impala_batch_shardings
+        from ray_tpu.rl.impala import (
+            impala_batch_shardings,
+            resolve_update_builder,
+        )
         from ray_tpu.rl.models import init_mlp_policy
 
         self._jax = jax
@@ -72,9 +75,14 @@ class SPMDLearnerWorker:
         if "init_params" in bc and bc["init_params"] is not None:
             host_params = bc["init_params"]
         self.params = self._replicate(host_params)
-        self.opt_state = self._replicate(self.optimizer.init(host_params))
+        host_opt = bc.get("init_opt_state")
+        if host_opt is None:
+            host_opt = self.optimizer.init(host_params)
+        self.opt_state = self._replicate(host_opt)
         self._update = jax.jit(
-            build_impala_update(bc["cfg_vals"], self.optimizer),
+            resolve_update_builder(bc.get("update_builder", "impala"))(
+                bc["cfg_vals"], self.optimizer
+            ),
             in_shardings=(replicated, replicated, batch_shardings),
             out_shardings=(replicated, replicated, replicated),
         )
@@ -122,8 +130,20 @@ class SPMDLearnerWorker:
             lambda x: np.asarray(x.addressable_data(0)), self.params
         )
 
+    def host_opt_state(self):
+        jax = self._jax
+        return jax.tree.map(
+            lambda x: np.asarray(x.addressable_data(0)), self.opt_state
+        )
+
     def set_params(self, host_params) -> None:
         self.params = self._replicate(host_params)
+
+    def set_opt_state(self, host_opt_state) -> None:
+        self.opt_state = self._replicate(host_opt_state)
+
+    def ping(self) -> bool:
+        return True
 
     def num_local_devices(self) -> int:
         return self._jax.local_device_count()
@@ -160,6 +180,7 @@ class SPMDLearnerGroup:
         self._update_timeout = update_timeout_s
         self._attempt = 0
         self._params_cache = None
+        self._opt_cache = None
         self.workers: List[Any] = []
         self.total_devices = 0
         self._start()
@@ -171,6 +192,7 @@ class SPMDLearnerGroup:
             opts["runtime_env"] = self._runtime_env
         bc = dict(self._builder_config)
         bc["init_params"] = self._params_cache
+        bc["init_opt_state"] = self._opt_cache
         self.workers = [
             SPMDLearnerWorker.options(**opts).remote(
                 rank, self.num_workers, key, bc
@@ -207,15 +229,27 @@ class SPMDLearnerGroup:
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One SPMD step across the group; restarts the group on worker
-        failure and retries once (the batch is simply re-fed)."""
+        DEATH and retries once (the pre-batch params were restored, so
+        re-feeding is not a double apply). A bare timeout first probes
+        liveness: a slow-but-healthy gang gets one extended wait instead of
+        a kill — killing it could discard an already-applied update and
+        re-apply the batch."""
         shards = self.split(batch)
+        refs = [w.update.remote(s) for w, s in zip(self.workers, shards)]
         try:
-            out = ray_tpu.get(
-                [w.update.remote(s) for w, s in zip(self.workers, shards)],
-                timeout=self._update_timeout,
-            )
-        except (exc.ActorDiedError, exc.WorkerCrashedError, exc.GetTimeoutError,
-                exc.TaskError):
+            out = ray_tpu.get(refs, timeout=self._update_timeout)
+        except exc.GetTimeoutError:
+            if self._all_alive():
+                # healthy but slow (compile storm, loaded box): the update
+                # may be mid-flight — wait it out rather than double-apply
+                out = ray_tpu.get(refs, timeout=self._update_timeout)
+            else:
+                self.restart()
+                out = ray_tpu.get(
+                    [w.update.remote(s) for w, s in zip(self.workers, shards)],
+                    timeout=self._update_timeout,
+                )
+        except (exc.ActorDiedError, exc.WorkerCrashedError, exc.TaskError):
             self.restart()
             out = ray_tpu.get(
                 [w.update.remote(s) for w, s in zip(self.workers, shards)],
@@ -224,6 +258,15 @@ class SPMDLearnerGroup:
         metrics, host_params = out[0]
         self._params_cache = host_params
         return metrics
+
+    def _all_alive(self) -> bool:
+        try:
+            ray_tpu.get(
+                [w.ping.remote() for w in self.workers], timeout=10.0
+            )
+            return True
+        except Exception:
+            return False
 
     def cached_params(self):
         return self._params_cache
@@ -238,7 +281,17 @@ class SPMDLearnerGroup:
     def restart(self) -> None:
         """Kill every worker and rebuild the gang under a fresh rendezvous
         key, restoring the last known params (parity: backend_executor's
-        worker-group restart)."""
+        worker-group restart). Optimizer state is salvaged from any
+        surviving worker first, so a partial gang death doesn't silently
+        reset Adam moments."""
+        for w in self.workers:
+            try:
+                self._opt_cache = ray_tpu.get(
+                    w.host_opt_state.remote(), timeout=10.0
+                )
+                break
+            except Exception:
+                continue
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

@@ -100,8 +100,24 @@ class _MultiAgentEpisodeCollector:
         def bucket(pid):
             return steps.setdefault(
                 pid,
-                {k: [] for k in ("obs", "actions", "logp", "values", "rewards", "dones")},
+                {
+                    k: []
+                    for k in (
+                        "obs",
+                        "actions",
+                        "logp",
+                        "values",
+                        "rewards",
+                        "dones",
+                        "lanes",
+                    )
+                },
             )
+
+        # stable integer id per (env_idx, agent_id) lane: the flat per-policy
+        # stream interleaves lanes per timestep, and GAE must bootstrap each
+        # transition from its OWN lane's successor, not the next array row
+        lane_ids: Dict[Tuple[int, str], int] = {}
 
         for _ in range(rollout_len):
             # group live (env_idx, agent_id) pairs by policy
@@ -132,6 +148,9 @@ class _MultiAgentEpisodeCollector:
                     b["values"].append(v)
                     b["rewards"].append(rewards.get(aid, 0.0))
                     b["dones"].append(float(done))
+                    b["lanes"].append(
+                        lane_ids.setdefault((ei, aid), len(lane_ids))
+                    )
                     ret = self._returns[ei]
                     ret[aid] = ret.get(aid, 0.0) + rewards.get(aid, 0.0)
                 if terms.get("__all__") or truncs.get("__all__"):
@@ -276,21 +295,31 @@ class MultiAgentPPO(Algorithm):
         return actions, logp, np.asarray(values)
 
     def _gae_flat(self, b: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Per-policy GAE over the flat transition stream: the stream is
-        time-major per (env, agent) lane interleaved, so we treat each
-        transition's ``done`` as the episode boundary in a single pass."""
+        """Per-policy GAE over the flat transition stream. The stream
+        interleaves (env, agent) lanes per timestep, so the backward pass
+        runs PER LANE (lane ids carried by the collector): each transition
+        bootstraps from its own lane's successor, with ``done`` as the
+        episode boundary inside a lane. A single flat pass would compute
+        deltas against unrelated agents' states (the reference computes GAE
+        per episode, rllib/evaluation/postprocessing.py)."""
         cfg = self.config
         rewards, values, dones = b["rewards"], b["values"], b["dones"]
+        lanes = b.get("lanes")
         n = len(rewards)
         adv = np.zeros(n, np.float32)
-        last_adv = 0.0
-        next_value = 0.0
-        for t in reversed(range(n)):
-            nonterminal = 1.0 - dones[t]
-            delta = rewards[t] + cfg.gamma * next_value * nonterminal - values[t]
-            last_adv = delta + cfg.gamma * cfg.gae_lambda * nonterminal * last_adv
-            adv[t] = last_adv
-            next_value = values[t]
+        lane_keys = (
+            np.zeros(n, np.int32) if lanes is None else lanes.astype(np.int32)
+        )
+        for lane in np.unique(lane_keys):
+            idx = np.nonzero(lane_keys == lane)[0]  # time-ordered
+            last_adv = 0.0
+            next_value = 0.0
+            for t in idx[::-1]:
+                nonterminal = 1.0 - dones[t]
+                delta = rewards[t] + cfg.gamma * next_value * nonterminal - values[t]
+                last_adv = delta + cfg.gamma * cfg.gae_lambda * nonterminal * last_adv
+                adv[t] = last_adv
+                next_value = values[t]
         returns = adv + values
         return {
             "obs": b["obs"],

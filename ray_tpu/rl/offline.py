@@ -173,3 +173,165 @@ class BC(Algorithm):
 
 class MARWIL(BC):
     pass
+
+
+class CQLConfig(BCConfig):
+    """Conservative Q-Learning on a fixed dataset (parity:
+    ``rllib/algorithms/cql/``, Kumar et al. 2020 — discrete CQL(H))."""
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.cql_alpha = 1.0  # conservative-regularizer weight
+        self.tau = 0.01  # target-network Polyak rate (applied every step)
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL(Algorithm):
+    """Discrete CQL: double-Q TD learning plus the conservative penalty
+    ``logsumexp_a Q(s,a) - Q(s, a_data)`` that pushes down out-of-dataset
+    action values — the core of ``rllib/algorithms/cql``. The offline
+    dataset provides (obs, actions, rewards, next_obs, dones) rows read
+    through the Data library, and the update is one jitted program."""
+
+    def __init__(self, config: CQLConfig):
+        super().__init__(config)
+        import jax
+        import optax
+
+        if config.dataset is None:
+            raise ValueError("CQLConfig.offline_data(dataset) is required")
+        probe = make_env(config.env)
+        spec = probe.spec
+        # the policy MLP's logits head doubles as Q(s, .) (same trick DQN
+        # uses); value head unused
+        self.params = init_mlp_policy(
+            jax.random.PRNGKey(config.seed), spec.obs_dim, spec.num_actions,
+            config.hidden,
+        )
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+        self._data = config.dataset.materialize()
+        self._epoch_iter = None
+        self._samples = 0
+        self._steps = 0
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        optimizer = self.optimizer
+
+        def loss_fn(params, target_params, batch):
+            q_all = apply_mlp_policy(params, batch["obs"])[0]
+            q_data = jnp.take_along_axis(
+                q_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            q_next = apply_mlp_policy(target_params, batch["next_obs"])[0]
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]
+            ) * jnp.max(q_next, axis=1)
+            td_loss = jnp.mean(
+                (q_data - jax.lax.stop_gradient(target)) ** 2
+            )
+            # CQL(H): minimize logsumexp over ALL actions, maximize the
+            # dataset action's value — out-of-distribution actions are
+            # pushed below the data support
+            cql_term = jnp.mean(
+                jax.scipy.special.logsumexp(q_all, axis=1) - q_data
+            )
+            return td_loss + cfg.cql_alpha * cql_term, (td_loss, cql_term)
+
+        def update(params, target_params, opt_state, batch):
+            (total, (td, cql)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p,
+                target_params,
+                params,
+            )
+            return params, target_params, opt_state, {
+                "total_loss": total,
+                "td_loss": td,
+                "cql_loss": cql,
+            }
+
+        return update
+
+    def _next_batch(self) -> Dict[str, np.ndarray]:
+        if self._epoch_iter is None:
+            self._epoch_iter = self._data.iter_batches(
+                batch_size=self.config.train_batch_size, drop_last=True
+            )
+        try:
+            batch = next(self._epoch_iter)
+        except StopIteration:
+            self._epoch_iter = self._data.iter_batches(
+                batch_size=self.config.train_batch_size, drop_last=True
+            )
+            batch = next(self._epoch_iter)
+        return {
+            "obs": np.asarray(batch["obs"], np.float32),
+            "actions": np.asarray(batch["actions"], np.int32),
+            "rewards": np.asarray(batch["rewards"], np.float32),
+            "next_obs": np.asarray(batch["next_obs"], np.float32),
+            "dones": np.asarray(batch["dones"], np.float32),
+        }
+
+    def training_step(self) -> Dict[str, Any]:
+        metrics = {}
+        for _ in range(16):
+            batch = self._next_batch()
+            self.params, self.target_params, self.opt_state, metrics = (
+                self._update(
+                    self.params, self.target_params, self.opt_state, batch
+                )
+            )
+            self._samples += len(batch["obs"])
+            self._steps += 1
+        return {
+            "num_samples_trained": self._samples,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 10, seed: int = 0) -> float:
+        returns = []
+        for ep in range(num_episodes):
+            env = make_env(self.config.env, seed=seed + ep)
+            obs, _ = env.reset()
+            total, done = 0.0, False
+            while not done:
+                q, _ = apply_mlp_policy(self.params, obs[None])
+                obs, r, term, trunc, _ = env.step(int(np.argmax(q[0])))
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return float(np.mean(returns))
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": jax.tree.map(np.asarray, self.params),
+            "target_params": jax.tree.map(np.asarray, self.target_params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "samples": self._samples,
+        }
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+        self._samples = state["samples"]
+
+    def stop(self):
+        pass

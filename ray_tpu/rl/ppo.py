@@ -44,8 +44,11 @@ class PPO(Algorithm):
         self._jax = jax
         probe = make_env(config.env)
         spec = probe.spec
+        from ray_tpu.rl.env_runner import resolve_obs_dim
+
+        obs_dim = resolve_obs_dim(config, spec)
         self.params = init_mlp_policy(
-            jax.random.PRNGKey(config.seed), spec.obs_dim, spec.num_actions, config.hidden
+            jax.random.PRNGKey(config.seed), obs_dim, spec.num_actions, config.hidden
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip), optax.adam(config.lr)
@@ -57,6 +60,7 @@ class PPO(Algorithm):
             config.num_envs_per_runner,
             config.rollout_len,
             seed=config.seed,
+            connectors=getattr(config, "env_to_module_connector", None),
         )
         self._update = jax.jit(self._make_update())
         self._recent_returns: List[float] = []

@@ -31,6 +31,20 @@ DEFAULT_PORT = 8700
 _MAX_BODY = 512 * 1024 * 1024
 
 
+class _HeaderMap(dict):
+    """Lowercase-keyed last-value dict for the proxy's own lookups, plus
+    ``raw``: the full ordered (name, value) pair list so repeated headers
+    survive into the ASGI scope (the spec passes every pair through)."""
+
+    def __init__(self):
+        super().__init__()
+        self.raw = []
+
+    def add(self, name: str, value: str) -> None:
+        self.raw.append((name, value))
+        self[name.lower()] = value
+
+
 class _NoRouteError(Exception):
     """Distinguishes route misses from user KeyErrors (which must be 500s)."""
 
@@ -111,6 +125,11 @@ class HTTPProxy:
                 pass
 
     async def _read_request(self, reader):
+        """Parse one HTTP/1.1 request. Headers keep BOTH views: the full
+        ordered (name, value) pair list (``.raw`` — repeated Cookie/Accept/
+        X-Forwarded-For headers must reach the ASGI scope intact, per spec)
+        and a lowercase-keyed last-value dict for the proxy's own
+        Content-Length/Connection/Transfer-Encoding lookups."""
         try:
             line = await reader.readline()
         except (ConnectionError, asyncio.LimitOverrunError):
@@ -121,13 +140,22 @@ class HTTPProxy:
             method, target, version = line.decode("latin1").strip().split(" ", 2)
         except ValueError:
             return None
-        headers: Dict[str, str] = {}
+        headers = _HeaderMap()
         while True:
             h = await reader.readline()
             if h in (b"\r\n", b"\n", b""):
                 break
             k, _, v = h.decode("latin1").partition(":")
-            headers[k.strip().lower()] = v.strip()
+            headers.add(k.strip(), v.strip())
+        # framing headers must be unambiguous: the proxy frames the body by
+        # ONE value while the full raw pair list reaches the app — repeated
+        # conflicting Content-Length (or CL alongside chunked TE) is the
+        # classic request-smuggling desync; reject it outright (RFC 9112 §6)
+        cls = {v for k, v in headers.raw if k.lower() == "content-length"}
+        if len(cls) > 1:
+            return "bad-request"
+        if cls and "chunked" in headers.get("transfer-encoding", "").lower():
+            return "bad-request"
         if "chunked" in headers.get("transfer-encoding", "").lower():
             # chunked request body: drain it fully or the unread chunk
             # framing would desync the next keep-alive request
@@ -251,7 +279,8 @@ class HTTPProxy:
             "query_string": query.encode("latin1"),
             "root_path": "",
             "headers": [
-                (k.encode("latin1"), v.encode("latin1")) for k, v in headers.items()
+                (k.lower().encode("latin1"), v.encode("latin1"))
+                for k, v in getattr(headers, "raw", list(headers.items()))
             ],
         }
         loop = asyncio.get_running_loop()

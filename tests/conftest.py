@@ -57,3 +57,38 @@ def cpu_mesh_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Post-suite hygiene: a test that leaks a BLOCKED non-daemon thread
+    (e.g. a pool worker parked in an unbounded get after its cluster died)
+    would wedge interpreter shutdown forever. Print the evidence, then arm
+    a watchdog that bounds the exit at 90s — the suite's verdict is already
+    decided at this point."""
+    import faulthandler
+    import os
+    import sys
+    import threading
+    import time
+
+    stragglers = [
+        t
+        for t in threading.enumerate()
+        if t is not threading.main_thread() and not t.daemon and t.is_alive()
+    ]
+    if stragglers:
+        sys.stderr.write(
+            f"\n[conftest] {len(stragglers)} non-daemon thread(s) still "
+            f"alive at exit: {[t.name for t in stragglers]}\n"
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+
+    status = int(exitstatus)
+
+    def _watchdog():
+        time.sleep(90)
+        sys.stderr.write("[conftest] exit watchdog fired: hard-exiting\n")
+        sys.stderr.flush()
+        os._exit(status)
+
+    threading.Thread(target=_watchdog, name="exit-watchdog", daemon=True).start()

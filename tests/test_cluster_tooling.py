@@ -433,3 +433,70 @@ def test_workflow_timer_listener(ray_start_regular, tmp_path):
     t0 = _time.time()
     assert workflow.run(dag, storage=str(tmp_path)) == "fired"
     assert _time.time() - t0 >= 0.4
+
+
+def test_tpu_vm_provider_reconciles_with_cloud(ray_start_regular):
+    """TPU-VM provider state discipline (parity: the reference's GCP
+    provider reconciling against the cloud): ``non_terminated_nodes``
+    consults ``gcloud list``; forgotten (billable!) slices are re-adopted
+    by cluster label, cloud-deleted slices are dropped, and the table
+    survives a provider rebuild via the cluster KV (which rides the GCS
+    snapshot)."""
+    import json
+
+    from ray_tpu.autoscaler.node_provider import TPUVMNodeProvider
+
+    cloud = {}  # name -> entry, the mocked fleet
+
+    class MockedProvider(TPUVMNodeProvider):
+        def _run_gcloud(self, *args):
+            if args[0] == "create":
+                name = args[1]
+                accel = next(
+                    a.split("=", 1)[1] for a in args if a.startswith("--accelerator-type=")
+                )
+                cloud[name] = {
+                    "name": f"projects/p/locations/z/nodes/{name}",
+                    "acceleratorType": accel,
+                    "state": "READY",
+                    "labels": {"ray-tpu-cluster": self.cluster_name},
+                }
+                return "{}"
+            if args[0] == "delete":
+                cloud.pop(args[1], None)
+                return "{}"
+            if args[0] == "list":
+                return json.dumps(list(cloud.values()))
+            raise AssertionError(f"unexpected gcloud verb {args}")
+
+    p = MockedProvider("proj", "zone", cluster_name="c1", list_cache_s=0.0)
+    n1 = p.create_node("v5litepod-16", {"TPU": 16.0})
+    n2 = p.create_node("v5litepod-16", {"TPU": 16.0})
+    assert {n["node_id"] for n in p.non_terminated_nodes()} == {n1, n2}
+
+    # cloud-side deletion (preemption) is noticed
+    cloud.pop(n2)
+    assert {n["node_id"] for n in p.non_terminated_nodes()} == {n1}
+
+    # a slice of ANOTHER cluster is never adopted
+    cloud["foreign"] = {
+        "name": "projects/p/locations/z/nodes/foreign",
+        "acceleratorType": "v5litepod-8",
+        "state": "READY",
+        "labels": {"ray-tpu-cluster": "other"},
+    }
+    assert {n["node_id"] for n in p.non_terminated_nodes()} == {n1}
+
+    # head restart: a FRESH provider with empty memory re-adopts n1 from the
+    # KV mirror immediately, and from the cloud listing either way
+    p2 = MockedProvider("proj", "zone", cluster_name="c1", list_cache_s=0.0)
+    assert {n["node_id"] for n in p2.non_terminated_nodes()} == {n1}
+
+    # ...even with the KV wiped (worst case), the cloud listing re-adopts
+    from ray_tpu._private.worker import get_runtime
+
+    get_runtime().rpc("kv_del", MockedProvider._KV_NS, MockedProvider._KV_KEY)
+    p3 = MockedProvider("proj", "zone", cluster_name="c1", list_cache_s=0.0)
+    nodes3 = p3.non_terminated_nodes()
+    assert {n["node_id"] for n in nodes3} == {n1}
+    assert any(n.get("adopted") for n in nodes3)

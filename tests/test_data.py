@@ -1,6 +1,7 @@
 """Dataset tests. Parity: ``python/ray/data/tests`` patterns (SURVEY.md §4)."""
 
 import csv
+import time
 import json
 import os
 
@@ -506,3 +507,62 @@ def test_lazy_reads_bounded_submission(ray_start_regular, tmp_path):
         for v in np.asarray(b["x"])
     )
     assert got == list(range(60))
+
+
+def test_backpressure_memory_cap_throttles_source(ray_start_regular):
+    """OutputMemoryPolicy (parity: StreamingOutputBackpressurePolicy): with
+    a byte cap on ready-but-unconsumed output, a slow sink holds the fast
+    source to a bounded submission lead instead of letting it sprint ahead."""
+    import numpy as np
+
+    from ray_tpu.data import backpressure as bp
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    saved_bytes, saved_blocks = ctx.max_inflight_bytes, ctx.max_inflight_blocks
+    ctx.max_inflight_bytes = 512 * 1024  # ~1 block of 64Ki float64 rows
+    ctx.max_inflight_blocks = 64  # wide window: the MEMORY policy must bind
+    bp.last_execution_stats.clear()
+    try:
+        ds = ray_tpu.data.range(20, num_blocks=20).map_batches(
+            lambda b: {"x": np.ones((len(b["id"]), 64 * 1024))}  # ~512KiB/blk
+        )
+        seen = 0
+        max_lead = 0
+        for _ in ds.iter_batches(batch_size=1):
+            seen += 1
+            time.sleep(0.05)  # the slow sink
+            for st in bp.last_execution_stats:
+                if st.name.startswith("map"):
+                    max_lead = max(max_lead, st.submitted - st.consumed)
+        assert seen == 20
+        # without the memory policy the 64-block window would let the map
+        # stage sprint ~20 blocks ahead; the cap holds the lead to a handful
+        # (liveness is proven by seen == 20; the lead may sample as 0 when
+        # the cap serializes to one block at a time)
+        assert max_lead <= 6, f"map lead {max_lead} not memory-bounded"
+    finally:
+        ctx.max_inflight_bytes = saved_bytes
+        ctx.max_inflight_blocks = saved_blocks
+
+
+def test_actor_pool_grows_under_backlog(ray_start_regular):
+    """ActorPoolStrategy(size, max_size): the pool adds workers when every
+    member is backlogged (parity: execution/autoscaler op autoscaling)."""
+    import time as _time
+
+    from ray_tpu.data.context import ActorPoolStrategy
+
+    class Slow:
+        def __call__(self, batch):
+            _time.sleep(0.15)
+            return batch
+
+    ds = ray_tpu.data.range(12, num_blocks=12).map_batches(
+        Slow, compute=ActorPoolStrategy(size=1, max_size=3)
+    )
+    assert ds.count() == 12
+    from ray_tpu.data.streaming_executor import ActorMapStage
+
+    stages = [s for s in ds._stages if isinstance(s, ActorMapStage)]
+    assert stages and stages[0].pool_size() > 1, "pool never grew"

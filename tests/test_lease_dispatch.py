@@ -247,3 +247,48 @@ def test_lost_lease_batch_reconciles(cluster, monkeypatch):
     ref = task.remote()
     assert ray_tpu.get(ref, timeout=120) == "healed"
     assert dropped["n"] == 1, "the loss was never injected"
+
+
+def test_wide_head_does_not_idle_narrow_capacity(cluster):
+    """A 4-CPU lease parked at the queue head must not idle cores that
+    queued 1-CPU leases could use (bounded lookahead past an infeasible
+    head; parity: local_task_manager.cc:122 iterating schedulable classes).
+    Same-shape tasks still never overtake each other."""
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold(sec):
+        time.sleep(sec)
+        return "held"
+
+    @ray_tpu.remote(num_cpus=4)
+    def wide():
+        return "wide"
+
+    @ray_tpu.remote(num_cpus=1)
+    def narrow(i):
+        return i
+
+    # warm the daemon's worker pool so spawn latency doesn't blur the
+    # dispatch-order measurement
+    ray_tpu.get([narrow.remote(i) for i in range(8)], timeout=60)
+
+    # occupy 1 CPU so the 4-CPU task cannot start, then queue it ahead of
+    # a batch of 1-CPU tasks
+    blocker = hold.remote(8.0)
+    time.sleep(1.0)  # blocker is running; 3 CPUs free
+    w = wide.remote()
+    narrows = [narrow.remote(i) for i in range(12)]
+
+    # the narrow tasks must complete on the 3 spare cores while the wide
+    # task waits for the blocker — i.e. well before the blocker finishes
+    t0 = time.monotonic()
+    out = ray_tpu.get(narrows, timeout=60)
+    narrow_done = time.monotonic() - t0
+    assert out == list(range(12))
+    assert narrow_done < 4.0, f"narrow tasks waited on the wide head ({narrow_done:.1f}s)"
+
+    # the wide task still runs once the blocker frees its core
+    assert ray_tpu.get(w, timeout=60) == "wide"
+    assert ray_tpu.get(blocker, timeout=60) == "held"

@@ -275,3 +275,107 @@ def test_multi_agent_ppo_two_policies_learn():
     assert any(
         not np.array_equal(a, b) for a, b in zip(p0_leaves, p1_leaves)
     ), "p0 and p1 share identical weights"
+
+
+def test_appo_learns_cartpole(ray_start_regular):
+    """APPO (IMPALA architecture + PPO clipped surrogate on V-trace
+    advantages; parity: rllib/algorithms/appo) reaches the CartPole
+    threshold with the same learner plane as IMPALA."""
+    from ray_tpu.rl import APPOConfig
+
+    cfg = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                     rollout_fragment_length=64)
+        .training(lr=1e-3, entropy_coeff=0.005, clip_param=0.3)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(400):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 150:
+            break
+    assert best >= 150, f"APPO best return {best}"
+
+
+def _transition_cartpole_dataset(n_episodes=30, seed=0, noise=0.3):
+    """(obs, action, reward, next_obs, done) rows from a decent-but-noisy
+    behavior policy — the offline-RL setting CQL is built for."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for ep in range(n_episodes):
+        env = CartPoleEnv(seed=seed + ep)
+        obs, _ = env.reset()
+        done = False
+        while not done:
+            expert = 1 if (obs[2] + 0.25 * obs[3]) > 0 else 0
+            a = int(rng.integers(0, 2)) if rng.random() < noise else expert
+            nobs, r, term, trunc, _ = env.step(a)
+            done = term or trunc
+            rows.append(
+                {
+                    "obs": obs.copy(),
+                    "actions": a,
+                    "rewards": r,
+                    "next_obs": nobs.copy(),
+                    "dones": float(done),
+                }
+            )
+            obs = nobs
+    return ray_tpu.data.from_items(rows)
+
+
+def test_cql_conservative_offline(ray_start_regular):
+    """CQL (parity: rllib/algorithms/cql, discrete CQL(H)): trains from a
+    fixed transition dataset, the conservative term keeps out-of-dataset
+    action values below data support, and the greedy policy beats the
+    noisy behavior policy's return."""
+    from ray_tpu.rl import CQLConfig
+
+    ds = _transition_cartpole_dataset()
+    algo = (
+        CQLConfig().environment("CartPole-v1").offline_data(ds).debugging(seed=0)
+    ).build()
+    for _ in range(40):
+        result = algo.train()
+    assert np.isfinite(result["total_loss"])
+    # the conservative regularizer must actually bind: logsumexp-Q minus
+    # data-action Q stays small (OOD actions are not overestimated)
+    assert result["cql_loss"] < 1.5, result
+    ret = algo.evaluate(num_episodes=5)
+    assert ret >= 120, f"CQL policy return {ret}"
+
+
+def test_connector_pipeline_env_to_module(ray_start_regular):
+    """Connector pipelines (parity: rllib/connectors ConnectorV2):
+    observations flow through NormalizeObservations + FrameStack before the
+    module sees or stores them; the policy net is sized for the pipeline
+    OUTPUT, and PPO still learns CartPole through the transformed stream."""
+    from ray_tpu.rl import FrameStack, NormalizeObservations, PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=0,
+            num_envs_per_env_runner=16,
+            env_to_module_connector=lambda: [
+                NormalizeObservations(),
+                FrameStack(k=2),
+            ],
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    # module input is widened by the stack: 4 obs dims * k=2
+    assert algo.params["w0"].shape[0] == 8 if "w0" in algo.params else True
+    best = 0.0
+    for _ in range(120):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 150:
+            break
+    assert best >= 150, f"PPO-with-connectors best return {best}"
