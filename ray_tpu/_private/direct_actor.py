@@ -37,7 +37,6 @@ import os
 import pickle
 import threading
 import time
-from multiprocessing.connection import Client as _MpClient
 from multiprocessing import connection as mpc
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -138,6 +137,10 @@ class DirectActorClient:
         self._task_actor: Dict[bytes, bytes] = {}  # tid_bin -> aid_bin
         self._owned: Dict[ObjectID, _OwnedRef] = {}
         self.stored_dirs: Dict[ObjectID, str] = {}
+        # streaming-generator items committed for a task but not (yet)
+        # wrapped in an ObjectRef by the consumer — release_stream() evicts
+        # whatever the consumer abandoned (tid_bin -> [oid])
+        self._gen_tracked: Dict[bytes, List[ObjectID]] = {}
         self._closed = False
         # resolver wakeup
         self._resolve_cv = threading.Condition(self._lock)
@@ -214,6 +217,29 @@ class DirectActorClient:
             self.store.evict(oid)
             self.stored_dirs.pop(oid, None)
         return rest
+
+    def release_stream(self, task_id: TaskID) -> None:
+        """Drop locally-owned streaming items the consumer never wrapped in
+        an ObjectRef (the generator was abandoned mid-stream). Consumed
+        items hold a positive count (or were already evicted by their ref's
+        finalizer) and escalated ones belong to the head — both skipped."""
+        evict = []
+        with self._lock:
+            for oid in self._gen_tracked.pop(task_id.binary(), ()):
+                rec = self._owned.get(oid)
+                if (
+                    rec is not None
+                    and rec.committed
+                    and rec.count <= 0
+                    and not rec.escalated
+                ):
+                    del self._owned[oid]
+                    evict.append(oid)
+        for oid in evict:
+            # matches remove_refs: a count-0, never-escalated object is
+            # purely ours regardless of which store holds it
+            self.store.evict(oid)
+            self.stored_dirs.pop(oid, None)
 
     def ensure_published(self, oids) -> None:
         """Escalate caller-owned oids to head ownership before they escape
@@ -604,6 +630,7 @@ class DirectActorClient:
             oid = ObjectID.for_return(TaskID(tid_bin), index)
             with self._lock:
                 self._commit_locked(oid, entry, src_dir)
+                self._gen_tracked.setdefault(tid_bin, []).append(oid)
             if self._on_commit is not None:
                 self._on_commit([oid])
 
@@ -716,15 +743,9 @@ class DirectActorClient:
             st = self._conns.get(addr)
         if st is None or not st["alive"]:
             try:
-                conn = _MpClient(
-                    addr, authkey=self._rt.config.cluster_auth_key.encode()
-                )
-                try:
-                    from ray_tpu._private.object_transfer import set_nodelay
+                from ray_tpu._private.object_transfer import _dial
 
-                    set_nodelay(conn)
-                except Exception:
-                    pass
+                conn = _dial(addr, self._rt.config.cluster_auth_key.encode())
             except Exception:
                 with self._lock:
                     ch = self._actors.get(aid_bin)

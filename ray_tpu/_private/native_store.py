@@ -70,6 +70,10 @@ class NativeStoreClient(StorePutMixin):
             raise OSError(f"could not open native store arena at {arena_path}")
         self._base = lib.rt_store_base(self._h)
         self._creating: Dict[ObjectID, bool] = {}  # id -> in_arena
+        # oids whose spill marker points at a backend THIS process
+        # definitively cannot read (e.g. another process's memory://):
+        # fail-fast locally without touching the shared marker
+        self._external_miss: set = set()
         self._lock = threading.Lock()
         self._closed = False
 
@@ -145,15 +149,17 @@ class NativeStoreClient(StorePutMixin):
         data = storage.read_bytes(uri)
         if data is None:
             # definitive miss (read_bytes raises on transport errors, None
-            # means not-found): drop the stale marker so contains() flips
-            # False and waiters fail fast instead of polling to the object-
-            # lost timeout. Happens when the backend is process-local
-            # (memory://) but the marker sits in the shared shm dir.
-            try:
-                os.unlink(self._spill_marker(oid))
-            except OSError:
-                pass
+            # means not-found): remember it in a PROCESS-LOCAL negative
+            # cache so this process's contains() flips False and its
+            # waiters fail fast instead of polling to the object-lost
+            # timeout. Happens when the backend is process-local
+            # (memory://) but the marker sits in the shared shm dir — the
+            # marker itself must survive: it may be another process's only
+            # pointer to a copy that IS restorable there, so unlinking it
+            # would turn a local miss into cluster-wide data loss.
+            self._external_miss.add(oid)
             return None
+        self._external_miss.discard(oid)
         # reinstate locally so repeat gets don't re-download a hot object
         # from the backend every time (the external copy stays the durable
         # one; delete() purges both). create/seal directly: put_bytes would
@@ -226,7 +232,11 @@ class NativeStoreClient(StorePutMixin):
     def contains(self, oid: ObjectID) -> bool:
         if self._lib.rt_store_contains(self._h, oid.binary()):
             return True
-        if self._spill_uri and os.path.exists(self._spill_marker(oid)):
+        if (
+            self._spill_uri
+            and oid not in self._external_miss
+            and os.path.exists(self._spill_marker(oid))
+        ):
             return True
         return self._fallback.contains(oid)
 

@@ -198,9 +198,23 @@ class ObjectRefGenerator:
                 rt.wait([next_oid], 1, timeout=30.0)
             if rt.object_ready(next_oid):
                 self._index += 1
-                return ObjectRef(next_oid)
+                # owned: the consumer's ref holds the item alive (direct
+                # plane: bumps the caller-local count so release_stream
+                # can tell consumed items from abandoned ones)
+                return ObjectRef(next_oid, _owned=True)
             if self._total is not None and self._index >= self._total:
                 raise StopIteration
+
+    def __del__(self):
+        # abandoned mid-stream (or fully drained): let the runtime drop
+        # locally-owned items that were committed but never consumed
+        try:
+            rt = get_runtime()
+            release = getattr(rt, "release_stream", None)
+            if release is not None:
+                release(self._task_id)
+        except Exception:
+            pass
 
 
 class DriverRuntime:
@@ -253,6 +267,10 @@ class DriverRuntime:
             if not oids:
                 return
         self.scheduler.post(("ref_batch", [(-1, oid) for oid in oids]))
+
+    def release_stream(self, task_id):
+        if self._direct is not None:
+            self._direct.release_stream(task_id)
 
     def transit_pin(self, pairs):
         if self._direct is not None:

@@ -594,6 +594,10 @@ class WorkerRuntime:
                 return
         self._send(("cmd", ("add_ref", list(oids))))
 
+    def release_stream(self, task_id):
+        if self._direct is not None:
+            self._direct.release_stream(task_id)
+
     def transit_pin(self, pairs):
         # serializing a locally-owned ref hands it to another process:
         # escalate ownership to the head first so the borrower protocol

@@ -241,8 +241,18 @@ class SPMDLearnerGroup:
         except exc.GetTimeoutError:
             if self._all_alive():
                 # healthy but slow (compile storm, loaded box): the update
-                # may be mid-flight — wait it out rather than double-apply
-                out = ray_tpu.get(refs, timeout=self._update_timeout)
+                # may be mid-flight — wait it out rather than double-apply.
+                # A second timeout means the gang is wedged, not slow:
+                # restart and re-feed (documented at-least-once; optimizer
+                # state is salvaged by restart()).
+                try:
+                    out = ray_tpu.get(refs, timeout=self._update_timeout)
+                except exc.GetTimeoutError:
+                    self.restart()
+                    out = ray_tpu.get(
+                        [w.update.remote(s) for w, s in zip(self.workers, shards)],
+                        timeout=self._update_timeout,
+                    )
             else:
                 self.restart()
                 out = ray_tpu.get(

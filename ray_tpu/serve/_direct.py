@@ -60,6 +60,12 @@ class DirectReplicaServer:
         try:
             while True:
                 method, args, kwargs, model_id, stream = conn.recv()
+                if method == "__ws__":
+                    # the connection becomes a dedicated bidirectional
+                    # websocket session channel; it never returns to
+                    # request/response framing
+                    self._replica.handle_websocket(conn, args[0])
+                    return
                 try:
                     if stream:
                         for item in self._replica.handle_request_streaming(
@@ -322,6 +328,28 @@ class DirectPool:
         except _ChannelBroken:
             self._evict(rid)
             raise _DirectUnavailable()
+
+    def open_dedicated(self):
+        """Dial a FRESH connection to one replica for a long-lived
+        bidirectional session (websocket). Not pooled — the caller owns and
+        closes it; the replica dedicates its serving thread to the session.
+        Raises _DirectUnavailable when no replica answers."""
+        import random
+        import time
+
+        if time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S:
+            self.refresh()
+        with self._lock:
+            addrs = [e["addr"] for e in self._replicas.values()]
+        random.shuffle(addrs)
+        from ray_tpu._private.object_transfer import _dial
+
+        for addr in addrs:
+            try:
+                return _dial(addr, self._auth)
+            except Exception:
+                continue
+        raise _DirectUnavailable()
 
     def close(self):
         with self._lock:

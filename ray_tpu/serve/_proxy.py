@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
@@ -110,7 +111,7 @@ class HTTPProxy:
                 keep = (http11 and conn_hdr != "close") or conn_hdr == "keep-alive"
                 try:
                     conn_ok = await self._respond(
-                        writer, method, target, headers, body, keep
+                        writer, method, target, headers, body, keep, reader
                     )
                 except (ConnectionError, BrokenPipeError):
                     return
@@ -190,9 +191,10 @@ class HTTPProxy:
         body = await reader.readexactly(length) if length else b""
         return method, target, headers, body, version.endswith("1.1")
 
-    async def _respond(self, writer, method, target, headers, body, keep):
+    async def _respond(self, writer, method, target, headers, body, keep, reader=None):
         """Returns False when the connection must be dropped (a truncated
-        chunked stream cannot be reused)."""
+        chunked stream cannot be reused, or it was consumed by a websocket
+        upgrade)."""
         split = urlsplit(target)
         path = unquote(split.path)
         app = self._match(path)
@@ -201,6 +203,14 @@ class HTTPProxy:
                 writer, *_error_body(404, f"no route for {path}"), keep
             )
             return True
+        if (
+            reader is not None
+            and headers.get("upgrade", "").lower() == "websocket"
+            and "upgrade" in headers.get("connection", "").lower()
+        ):
+            return await self._respond_websocket(
+                reader, writer, app, path, split.query, headers, keep
+            )
         if self._is_asgi.get(app):
             return await self._respond_asgi(
                 writer, app, method, path, split.query, headers, body, keep
@@ -388,6 +398,254 @@ class HTTPProxy:
         await writer.drain()
         return True
 
+    # -- websocket upgrades ------------------------------------------------
+
+    async def _respond_websocket(self, reader, writer, app, path, query, headers, keep):
+        """RFC 6455 upgrade + frame relay (parity: the reference proxies
+        websocket ASGI scopes via uvicorn, ``serve/_private/proxy.py``).
+        Client frames relay to the replica as ``websocket.receive`` events
+        over a dedicated direct-plane connection; the app's ``websocket.send``
+        events come back as frames. Returns False when the connection was
+        consumed by the session (always, after a 101)."""
+        from ray_tpu.serve import _ws as ws
+        from ray_tpu.serve._direct import _DirectUnavailable
+
+        key = headers.get("sec-websocket-key")
+        if not key:
+            await self._write_simple(writer, *_error_body(400, "missing Sec-WebSocket-Key"), keep)
+            return True
+        if headers.get("sec-websocket-version", "13") != "13":
+            writer.write(
+                b"HTTP/1.1 426 Upgrade Required\r\nSec-WebSocket-Version: 13\r\n"
+                b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+            )
+            await writer.drain()
+            return False
+        if not self._is_asgi.get(app):
+            await self._write_simple(
+                writer, *_error_body(400, "route does not mount an ASGI app"), keep
+            )
+            return True
+        pool = self._direct.get(app)
+        loop = asyncio.get_running_loop()
+        conn = None
+        if pool is not None:
+            try:
+                conn = await loop.run_in_executor(self._pool, pool.open_dedicated)
+            except _DirectUnavailable:
+                conn = None
+            except Exception:
+                conn = None
+        if conn is None:
+            # websockets need the bidirectional direct plane; the handle
+            # path is request->stream only
+            await self._write_simple(
+                writer, *_error_body(503, "no live replica channel for websocket"), keep
+            )
+            return True
+
+        scope = {
+            "type": "websocket",
+            "http_version": "1.1",
+            "scheme": "ws",
+            "path": path,
+            "raw_path": path.encode(),
+            "query_string": query.encode("latin1"),
+            "root_path": "",
+            "headers": [
+                (k.lower().encode("latin1"), v.encode("latin1"))
+                for k, v in getattr(headers, "raw", list(headers.items()))
+            ],
+            "subprotocols": [
+                s.strip()
+                for s in headers.get("sec-websocket-protocol", "").split(",")
+                if s.strip()
+            ],
+        }
+
+        q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        cancelled = threading.Event()
+
+        def put(event) -> bool:
+            while not cancelled.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(event), loop)
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except TimeoutError:
+                    if not fut.cancel():
+                        return True
+                except Exception:
+                    return False
+            return False
+
+        def pump_down():
+            import pickle as _pickle
+
+            try:
+                conn.send(("__ws__", [scope], {}, "", True))
+                while True:
+                    kind, payload = conn.recv()
+                    if kind == "evt":
+                        if not put(payload):
+                            return
+                    elif kind == "end":
+                        put(None)
+                        return
+                    else:  # "err"
+                        put(_pickle.loads(payload))
+                        return
+            except (EOFError, OSError, BrokenPipeError):
+                put(ConnectionError("replica connection lost"))
+            except BaseException as e:  # noqa: BLE001
+                put(e)
+
+        # sessions are long-lived: dedicated threads, NOT the shared request
+        # pool — 64 idle websockets must not starve plain HTTP dispatch
+        threading.Thread(target=pump_down, daemon=True, name="ws-down").start()
+        up_q: "queue.Queue" = queue.Queue(maxsize=256)
+
+        def pump_up():
+            try:
+                while True:
+                    ev = up_q.get()
+                    if ev is None:
+                        return
+                    conn.send(("msg", ev))
+            except (OSError, EOFError, BrokenPipeError):
+                pass
+
+        up_thread = threading.Thread(target=pump_up, daemon=True, name="ws-up")
+        up_thread.start()
+        try:
+            first = await q.get()
+            if isinstance(first, dict) and first.get("type") == "websocket.accept":
+                extra = [
+                    f"{k.decode('latin1')}: {v.decode('latin1')}\r\n"
+                    for k, v in first.get("headers", [])
+                ]
+                sub = first.get("subprotocol")
+                if sub:
+                    extra.append(f"Sec-WebSocket-Protocol: {sub}\r\n")
+                writer.write(
+                    (
+                        "HTTP/1.1 101 Switching Protocols\r\n"
+                        "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                        f"Sec-WebSocket-Accept: {ws.accept_key(key)}\r\n"
+                        + "".join(extra)
+                        + "\r\n"
+                    ).encode("latin1")
+                )
+                await writer.drain()
+            elif isinstance(first, dict) and first.get("type") == "websocket.close":
+                # rejected before accept -> 403, per the ASGI spec
+                await self._write_simple(writer, 403, b"", "text/plain", keep)
+                return True
+            else:
+                msg = str(first) if first is not None else "app closed without accepting"
+                await self._write_simple(writer, *_error_body(500, msg), keep)
+                return True
+
+            # -- accepted: relay until either side closes ------------------
+            async def send_up(event) -> None:
+                # enqueue for the session's sender thread; an async retry
+                # loop gives backpressure without parking a pool thread
+                while True:
+                    try:
+                        up_q.put_nowait(event)
+                        return
+                    except queue.Full:
+                        await asyncio.sleep(0.02)
+
+            async def upstream():
+                try:
+                    while True:
+                        op, payload = await ws.read_message(reader)
+                        if op == ws.OP_CLOSE:
+                            code, _reason = ws.parse_close(payload)
+                            try:
+                                writer.write(ws.encode_close(code))
+                                await writer.drain()
+                            except (ConnectionError, OSError):
+                                pass
+                            await send_up(
+                                {"type": "websocket.disconnect", "code": code}
+                            )
+                            return
+                        if op == ws.OP_PING:
+                            writer.write(ws.encode_frame(ws.OP_PONG, payload))
+                            await writer.drain()
+                            continue
+                        if op == ws.OP_PONG:
+                            continue
+                        ev = {"type": "websocket.receive"}
+                        if op == ws.OP_TEXT:
+                            ev["text"] = payload.decode("utf-8")
+                        else:
+                            ev["bytes"] = payload
+                        await send_up(ev)
+                except (ConnectionError, OSError, EOFError, ValueError,
+                        asyncio.IncompleteReadError):
+                    try:
+                        up_q.put_nowait(
+                            {"type": "websocket.disconnect", "code": 1006}
+                        )
+                    except queue.Full:
+                        pass
+
+            up_task = asyncio.ensure_future(upstream())
+            try:
+                while True:
+                    event = await q.get()
+                    if event is None:
+                        # app returned without an explicit close
+                        writer.write(ws.encode_close(1000))
+                        await writer.drain()
+                        return False
+                    if isinstance(event, BaseException):
+                        try:
+                            writer.write(ws.encode_close(1011, "internal error"))
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            pass
+                        return False
+                    t = event.get("type")
+                    if t == "websocket.send":
+                        if event.get("text") is not None:
+                            frame = ws.encode_frame(
+                                ws.OP_TEXT, event["text"].encode("utf-8")
+                            )
+                        else:
+                            frame = ws.encode_frame(
+                                ws.OP_BINARY, bytes(event.get("bytes") or b"")
+                            )
+                        writer.write(frame)
+                        await writer.drain()
+                    elif t == "websocket.close":
+                        writer.write(
+                            ws.encode_close(
+                                int(event.get("code", 1000)),
+                                str(event.get("reason") or ""),
+                            )
+                        )
+                        await writer.drain()
+                        return False
+            finally:
+                up_task.cancel()
+        except (ConnectionError, OSError):
+            return False
+        finally:
+            cancelled.set()
+            try:
+                up_q.put_nowait(None)  # stop the sender thread
+            except queue.Full:
+                pass  # it will exit on the closed conn instead
+            try:
+                conn.close()
+            except OSError:
+                pass
+        return False
+
     # -- control -----------------------------------------------------------
 
     def _route(self, path: str, payload):
@@ -458,8 +716,11 @@ class HTTPProxy:
 
 _REASONS = {
     200: "OK",
+    400: "Bad Request",
+    403: "Forbidden",
     404: "Not Found",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 

@@ -183,6 +183,23 @@ class Replica:
         finally:
             self._exit()
 
+    def handle_websocket(self, conn, scope) -> None:
+        """One websocket session over a dedicated direct-plane connection
+        (parity: the reference proxies websocket ASGI scopes through
+        uvicorn, ``python/ray/serve/_private/proxy.py``). Counts toward
+        ongoing-request depth for its whole lifetime, so autoscaling sees
+        live sessions as load."""
+        app = getattr(self._callable, "__serve_asgi_app__", None)
+        if app is None:
+            raise TypeError("deployment does not mount an ASGI app")
+        from ray_tpu.serve._ws import run_asgi_websocket
+
+        self._enter("")
+        try:
+            run_asgi_websocket(app, scope, conn, instance=self._callable)
+        finally:
+            self._exit()
+
     def num_ongoing(self) -> int:
         """Queued + running requests (autoscaling metric)."""
         with self._ongoing_lock:

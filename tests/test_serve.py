@@ -1,6 +1,7 @@
 """Serve tests. Parity: ``python/ray/serve/tests`` patterns (SURVEY.md §4)."""
 
 import json
+import os
 import time
 import urllib.request
 
@@ -654,3 +655,128 @@ def test_http_keep_alive_reuse(serve_cluster):
     )
     assert all(_json.loads(b)["result"]["n"] == 10 for (_, _, b) in multi)
     serve.delete("kaapp")
+
+
+# ---- websockets (parity: ASGI websocket scopes through the proxy) ----
+
+
+def test_websocket_echo_roundtrip(serve_cluster):
+    """Full RFC 6455 session: upgrade, subprotocol negotiation, text and
+    binary echo, ping/pong, app-initiated close with code+reason."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve._ws import WSClient
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    async def app(scope, receive, send):
+        assert scope["type"] == "websocket"
+        msg = await receive()
+        assert msg["type"] == "websocket.connect"
+        sub = scope["subprotocols"][0] if scope["subprotocols"] else None
+        await send({"type": "websocket.accept", "subprotocol": sub})
+        while True:
+            msg = await receive()
+            if msg["type"] == "websocket.disconnect":
+                return
+            if msg.get("text") is not None:
+                if msg["text"] == "quit":
+                    await send({"type": "websocket.close", "code": 4001,
+                                "reason": "bye"})
+                    return
+                await send({"type": "websocket.send",
+                            "text": msg["text"].upper()})
+            else:
+                await send({"type": "websocket.send",
+                            "bytes": msg["bytes"][::-1]})
+
+    @serve.deployment
+    @serve.ingress(app)
+    class WsD:
+        pass
+
+    serve.run(WsD.bind(), name="wsapp", route_prefix="/ws")
+    proxy = ensure_proxy(_get_or_create_controller(), "wsapp", "/ws")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+
+    c = WSClient(host, port, "/ws/chat", subprotocols=("chat", "alt"))
+    try:
+        assert c.subprotocol == "chat"
+        c.send_text("hello")
+        assert c.recv() == "HELLO"
+        c.send_bytes(b"\x01\x02\x03")
+        assert c.recv() == b"\x03\x02\x01"
+        c.ping(b"p")
+        assert c.recv() == ("pong", b"p")
+        c.send_text("quit")
+        assert c.recv() == ("close", 4001, "bye")
+    finally:
+        c.close()
+    serve.delete("wsapp")
+
+
+def test_websocket_reject_and_client_disconnect(serve_cluster):
+    """App close before accept surfaces as HTTP 403; an accepted session
+    whose client vanishes delivers websocket.disconnect to the app."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve._ws import WSClient
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    async def app(scope, receive, send):
+        await receive()  # websocket.connect
+        if scope["path"].endswith("/reject"):
+            await send({"type": "websocket.close", "code": 1008})
+            return
+        await send({"type": "websocket.accept"})
+        while True:
+            msg = await receive()
+            if msg["type"] == "websocket.disconnect":
+                # visible side channel: write a marker the test can poll
+                with open(scope["extensions"]["marker_path"], "w") as f:
+                    f.write(str(msg.get("code")))
+                return
+            await send({"type": "websocket.send", "text": "ok"})
+
+    import tempfile
+
+    marker = tempfile.NamedTemporaryFile(delete=False)
+    marker.close()
+    marker_path = marker.name
+
+    async def wrapped(scope, receive, send):
+        ext = dict(scope.get("extensions") or {})
+        ext["marker_path"] = marker_path
+        scope = dict(scope)
+        scope["extensions"] = ext
+        await app(scope, receive, send)
+
+    @serve.deployment
+    @serve.ingress(wrapped)
+    class WsR:
+        pass
+
+    serve.run(WsR.bind(), name="wsrapp", route_prefix="/wsr")
+    proxy = ensure_proxy(_get_or_create_controller(), "wsrapp", "/wsr")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+
+    try:
+        WSClient(host, port, "/wsr/reject")
+        assert False, "upgrade should have been refused"
+    except ConnectionError as e:
+        assert "403" in str(e)
+
+    c = WSClient(host, port, "/wsr/chat")
+    c.send_text("x")
+    assert c.recv() == "ok"
+    c._sock.close()  # vanish without a close frame
+    deadline = time.time() + 30
+    code = ""
+    while time.time() < deadline:
+        with open(marker_path) as f:
+            code = f.read().strip()
+        if code:
+            break
+        time.sleep(0.2)
+    assert code == "1006", f"app never saw the disconnect (marker={code!r})"
+    os.unlink(marker_path)
+    serve.delete("wsrapp")
