@@ -72,8 +72,10 @@ class NativeStoreClient(StorePutMixin):
         self._creating: Dict[ObjectID, bool] = {}  # id -> in_arena
         # oids whose spill marker points at a backend THIS process
         # definitively cannot read (e.g. another process's memory://):
-        # fail-fast locally without touching the shared marker
-        self._external_miss: set = set()
+        # fail-fast locally without touching the shared marker. Keyed by
+        # the marker's mtime so a re-spill to a readable backend (marker
+        # rewritten) invalidates the negative entry.
+        self._external_miss: Dict[ObjectID, float] = {}
         self._lock = threading.Lock()
         self._closed = False
 
@@ -157,9 +159,13 @@ class NativeStoreClient(StorePutMixin):
             # marker itself must survive: it may be another process's only
             # pointer to a copy that IS restorable there, so unlinking it
             # would turn a local miss into cluster-wide data loss.
-            self._external_miss.add(oid)
+            try:
+                mtime = os.stat(self._spill_marker(oid)).st_mtime
+            except OSError:
+                mtime = 0.0
+            self._external_miss[oid] = mtime
             return None
-        self._external_miss.discard(oid)
+        self._external_miss.pop(oid, None)
         # reinstate locally so repeat gets don't re-download a hot object
         # from the backend every time (the external copy stays the durable
         # one; delete() purges both). create/seal directly: put_bytes would
@@ -232,12 +238,22 @@ class NativeStoreClient(StorePutMixin):
     def contains(self, oid: ObjectID) -> bool:
         if self._lib.rt_store_contains(self._h, oid.binary()):
             return True
-        if (
-            self._spill_uri
-            and oid not in self._external_miss
-            and os.path.exists(self._spill_marker(oid))
-        ):
-            return True
+        if self._spill_uri:
+            cached = self._external_miss.get(oid)
+            if cached is None:
+                if os.path.exists(self._spill_marker(oid)):
+                    return True
+            else:
+                # negative entry: honor it only while the marker is
+                # unchanged — a rewrite (re-spill) or removal invalidates
+                try:
+                    mtime = os.stat(self._spill_marker(oid)).st_mtime
+                except OSError:
+                    self._external_miss.pop(oid, None)  # marker gone
+                    mtime = None
+                if mtime is not None and mtime != cached:
+                    self._external_miss.pop(oid, None)
+                    return True
         return self._fallback.contains(oid)
 
     def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
@@ -269,6 +285,7 @@ class NativeStoreClient(StorePutMixin):
         self._fallback.release(oid)
 
     def delete(self, oid: ObjectID) -> None:
+        self._external_miss.pop(oid, None)
         if self._spill_uri:
             uri = self._external_spilled_uri(oid)
             if uri is not None:

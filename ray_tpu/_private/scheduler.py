@@ -460,8 +460,11 @@ class Scheduler:
         self.head_object_addr = None
         self.head_object_server = None
         self._last_gcs_snapshot = 0.0
-        # zero-refcount frees deferred by a grace window (see _maybe_free)
+        # zero-refcount frees deferred by a grace window (see _maybe_free).
+        # Only oids whose ref traffic ever crossed channels need it: those
+        # are tracked here; single-channel (owner-only) oids free on zero.
         self._deferred_frees: collections.deque = collections.deque()
+        self._cross_channel: set = set()
         # event-driven dispatch bookkeeping
         self._dispatch_dirty = True
         self._last_full_dispatch = 0.0
@@ -1275,10 +1278,12 @@ class Scheduler:
             # scheduler-released in-flight pins: never holder-attributed
             # (see WorkerRuntime.submit)
             for oid in cmd[1]:
+                self._cross_channel.add(oid)
                 self._apply_ref_op(1, oid)
         elif kind == "unpin_args":
             # direct-plane callers release their own in-flight pins when the
             # result arrives (the head never sees those completions)
+            self._cross_channel.update(cmd[1])
             self._unpin(cmd[1])
         elif kind == "direct_publish":
             # ownership escalation: a caller-owned direct-call result escaped
@@ -1293,6 +1298,7 @@ class Scheduler:
                     e = self.memory_store.get_entry(oid)
                     if e is not None:
                         self._wake_waiters(oid, e)
+                self._cross_channel.add(oid)
                 if count:
                     self._ref_counts[oid] += count
                     if holder is not None:
@@ -1341,6 +1347,18 @@ class Scheduler:
     def _on_submit(self, spec: TaskSpec):
         rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
         self.tasks[spec.task_id] = rec
+        # ref args will be pinned/unpinned across channels (submitter pin,
+        # completion unpin): their zeros need the deferred-free grace.
+        # Only live oids (submitter's pin precedes submit on its channel,
+        # so count >= 1 here) — a ref to an already-freed object must not
+        # park in the set forever
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if (
+                a.is_ref
+                and a.object_id is not None
+                and a.object_id in self._ref_counts
+            ):
+                self._cross_channel.add(a.object_id)
         self._record_event(spec, "PENDING")
         if spec.task_type == TaskType.ACTOR_CREATION:
             st = self.actors.get(spec.actor_id)
@@ -3016,6 +3034,10 @@ class Scheduler:
         ``holder`` attributes borrows to a worker so a crashed borrower's
         refs are released by ``_on_worker_death`` instead of leaking.
         """
+        if holder is not None or op in (2, 3):
+            # ref traffic beyond the owner's own ordered channel: this oid's
+            # future zeros must ride the deferred-free grace window
+            self._cross_channel.add(oid)
         if op == -1:
             if holder is not None:
                 held = self._holder_refs.get(holder)
@@ -3074,7 +3096,7 @@ class Scheduler:
             )
 
     def _maybe_free(self, oid: ObjectID):
-        """Refcount hit zero: schedule the free after a short grace window.
+        """Refcount hit zero: free now, or after a short grace window.
 
         Ref traffic converges on the head from independent channels (caller
         pipes, the direct-actor escalation path, completion unpins), so a
@@ -3083,7 +3105,17 @@ class Scheduler:
         ownership-escalation transfer is processed. Freeing on the transient
         zero deletes a live object; the grace window lets stragglers arrive
         (parity: the reference tolerates the same lag via owner-side
-        deletion — only the owner decides an object is out of scope)."""
+        deletion — only the owner decides an object is out of scope).
+
+        The window only applies to oids whose ref ops ever arrived from more
+        than the owner's single ordered channel (``_cross_channel``: worker
+        borrows, transit pins, escalations, task args). A put/del that never
+        left its owner cannot have a straggler — its zero is definitive, and
+        deferring it lets high-churn loops (put; del; repeat) overflow the
+        arena into LRU spill while dead objects wait out their grace."""
+        if oid not in self._cross_channel:
+            self._free_object(oid)
+            return
         self._deferred_frees.append((time.monotonic() + 2.0, oid))
 
     def _sweep_deferred_frees(self) -> None:
@@ -3094,6 +3126,7 @@ class Scheduler:
                 self._free_object(oid)
 
     def _free_object(self, oid: ObjectID):
+        self._cross_channel.discard(oid)
         self._xfer_waiting.pop(oid, None)
         if self._shm_xfer_failed:
             self._shm_xfer_failed = {

@@ -518,7 +518,16 @@ class HTTPProxy:
         up_thread = threading.Thread(target=pump_up, daemon=True, name="ws-up")
         up_thread.start()
         try:
-            first = await q.get()
+            # bounded: an app that hangs before accept/close must not leak
+            # the client socket, both pump threads, and a dedicated replica
+            # serving thread per retried connection
+            try:
+                first = await asyncio.wait_for(q.get(), timeout=60.0)
+            except asyncio.TimeoutError:
+                await self._write_simple(
+                    writer, *_error_body(500, "app never completed the handshake"), keep
+                )
+                return True
             if isinstance(first, dict) and first.get("type") == "websocket.accept":
                 extra = [
                     f"{k.decode('latin1')}: {v.decode('latin1')}\r\n"
@@ -558,9 +567,10 @@ class HTTPProxy:
                         await asyncio.sleep(0.02)
 
             async def upstream():
+                frames = ws.MessageReader(reader)
                 try:
                     while True:
-                        op, payload = await ws.read_message(reader)
+                        op, payload = await frames.next()
                         if op == ws.OP_CLOSE:
                             code, _reason = ws.parse_close(payload)
                             try:

@@ -75,7 +75,13 @@ def encode_frame(opcode: int, payload: bytes, fin: bool = True, mask: bool = Fal
 
 
 def encode_close(code: int = 1000, reason: str = "", mask: bool = False) -> bytes:
-    payload = struct.pack("!H", code) + reason.encode("utf-8")[:123]
+    # close payload caps at 125 bytes (2 for the code); the reason must stay
+    # valid UTF-8 after truncation (RFC 6455 §5.5.1), so cut on a codepoint
+    # boundary, never mid-sequence
+    raw = reason.encode("utf-8")
+    if len(raw) > 123:
+        raw = raw[:123].decode("utf-8", errors="ignore").encode("utf-8")
+    payload = struct.pack("!H", code) + raw
     return encode_frame(OP_CLOSE, payload, mask=mask)
 
 
@@ -112,29 +118,49 @@ async def read_frame(reader) -> Tuple[bool, int, bytes]:
     return fin, opcode, payload
 
 
+class MessageReader:
+    """Reassembles fragmented messages across calls. Control frames may be
+    injected INSIDE a fragmented message (RFC 6455 §5.4): they surface
+    immediately while the partial data message stays buffered here, so the
+    continuation frames that follow still have their message in progress."""
+
+    def __init__(self, reader):
+        self._reader = reader
+        self._opcode: Optional[int] = None
+        self._parts: list = []
+        self._total = 0
+
+    async def next(self) -> Tuple[int, bytes]:
+        while True:
+            fin, op, payload = await read_frame(self._reader)
+            if op in (OP_CLOSE, OP_PING, OP_PONG):
+                return op, payload
+            if op != OP_CONT:
+                self._opcode = op
+                self._parts = [payload]
+                self._total = len(payload)
+            else:
+                if self._opcode is None:
+                    raise ValueError(
+                        "continuation frame with no message in progress"
+                    )
+                self._parts.append(payload)
+                self._total += len(payload)
+            if self._total > MAX_FRAME:
+                raise ValueError(
+                    f"websocket message exceeds {MAX_FRAME} bytes"
+                )
+            if fin:
+                op, data = self._opcode, b"".join(self._parts)
+                self._opcode, self._parts, self._total = None, [], 0
+                return op, data
+
+
 async def read_message(reader) -> Tuple[int, bytes]:
-    """Read one complete message (reassembling continuation frames).
-    Control frames interleaved inside a fragmented message are returned
-    immediately (they may not be fragmented themselves, RFC 6455 §5.4)."""
-    opcode = None
-    parts = []
-    total = 0
-    while True:
-        fin, op, payload = await read_frame(reader)
-        if op in (OP_CLOSE, OP_PING, OP_PONG):
-            return op, payload
-        if op != OP_CONT:
-            opcode = op
-            parts = [payload]
-        else:
-            if opcode is None:
-                raise ValueError("continuation frame with no message in progress")
-            parts.append(payload)
-        total += len(payload)
-        if total > MAX_FRAME:
-            raise ValueError(f"websocket message exceeds {MAX_FRAME} bytes")
-        if fin:
-            return opcode, b"".join(parts)
+    """One-shot form of MessageReader for callers without interleaved
+    control-frame concerns (a fragmented message must complete within the
+    call). Prefer MessageReader for session loops."""
+    return await MessageReader(reader).next()
 
 
 # ---------------------------------------------------------------------------
@@ -208,7 +234,20 @@ def run_asgi_websocket(asgi_app, scope, conn, instance=None) -> None:
             # not block forever on the drained queue
             return {"type": "websocket.disconnect", "code": disconnected[1]}
         loop = asyncio.get_running_loop()
-        ev = await loop.run_in_executor(None, upstream.get)
+
+        def _get():
+            # poll, don't park: an abandoned receive() (wait_for timeout,
+            # cancelled race) leaves this executor thread behind — it must
+            # notice session close and exit, or loop shutdown joins it for
+            # minutes and the serving thread + ongoing-request slot wedge
+            while True:
+                try:
+                    return upstream.get(timeout=0.5)
+                except queue.Empty:
+                    if closed.is_set():
+                        return {"type": "websocket.disconnect", "code": 1006}
+
+        ev = await loop.run_in_executor(None, _get)
         if ev.get("type") == "websocket.disconnect":
             disconnected[0] = True
             disconnected[1] = ev.get("code", 1006)
@@ -220,8 +259,17 @@ def run_asgi_websocket(asgi_app, scope, conn, instance=None) -> None:
         with send_lock:
             conn.send(("evt", event))
 
+    async def _session():
+        try:
+            await asgi_app(scope, receive, send)
+        finally:
+            # set BEFORE the loop shuts down its default executor: any
+            # executor thread still polling in receive()'s _get must see
+            # this and exit, or asyncio.run would join it for minutes
+            closed.set()
+
     try:
-        asyncio.run(asgi_app(scope, receive, send))
+        asyncio.run(_session())
         with send_lock:
             conn.send(("end", None))
     except (EOFError, OSError, BrokenPipeError):

@@ -780,3 +780,40 @@ def test_websocket_reject_and_client_disconnect(serve_cluster):
     assert code == "1006", f"app never saw the disconnect (marker={code!r})"
     os.unlink(marker_path)
     serve.delete("wsrapp")
+
+
+def test_websocket_fragmented_message_with_interleaved_ping(serve_cluster):
+    """RFC 6455 §5.4: control frames may be injected inside a fragmented
+    message; the relay must buffer the partial message across them."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve import _ws as ws
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    async def app(scope, receive, send):
+        await receive()
+        await send({"type": "websocket.accept"})
+        while True:
+            m = await receive()
+            if m["type"] == "websocket.disconnect":
+                return
+            await send({"type": "websocket.send", "text": m["text"].upper()})
+
+    @serve.deployment
+    @serve.ingress(app)
+    class WsF:
+        pass
+
+    serve.run(WsF.bind(), name="wsfrag", route_prefix="/wsfrag")
+    proxy = ensure_proxy(_get_or_create_controller(), "wsfrag", "/wsfrag")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+    c = ws.WSClient(host, port, "/wsfrag")
+    try:
+        c._sock.sendall(ws.encode_frame(ws.OP_TEXT, b"hel", fin=False, mask=True))
+        c._sock.sendall(ws.encode_frame(ws.OP_PING, b"p", mask=True))
+        c._sock.sendall(ws.encode_frame(ws.OP_CONT, b"lo", fin=True, mask=True))
+        msgs = [c.recv(), c.recv()]
+        assert ("pong", b"p") in msgs and "HELLO" in msgs, msgs
+    finally:
+        c.close()
+    serve.delete("wsfrag")
