@@ -203,6 +203,7 @@ class NodeDaemon:
         self._lease_blocked.clear()
         self._lease_starting = 0
         self._lease_in_use.clear()
+        self._instance_ledger = None  # rebuilt with the fresh worker fleet
         self._lease_done_buf.clear()
         self._lease_started_buf.clear()
         self._lease_idle_since.clear()
@@ -336,14 +337,21 @@ class NodeDaemon:
             self._spawn_worker(WorkerID(msg[1]))
         elif kind == "to_worker":
             _, wid_bin, inner = msg
-            entry = self.workers.get(WorkerID(wid_bin))
+            wid = WorkerID(wid_bin)
+            entry = self.workers.get(wid)
             if entry is not None:
+                inner = self._maybe_assign_devices(wid, inner)
                 try:
                     entry[1].send(inner)
                 except (OSError, EOFError, BrokenPipeError):
-                    self._on_worker_pipe_death(WorkerID(wid_bin))
+                    self._on_worker_pipe_death(wid)
         elif kind == "kill_worker":
-            entry = self.workers.get(WorkerID(msg[1]))
+            wid = WorkerID(msg[1])
+            # the head has already released this worker's resources — its
+            # device instances free NOW, not when the process finishes
+            # dying (a replacement's exec can relay in before that)
+            self._free_head_devices(wid, worker_gone=True)
+            entry = self.workers.get(wid)
             if entry is not None and entry[0] is not None:
                 try:
                     entry[0].terminate()
@@ -488,6 +496,8 @@ class NodeDaemon:
                     # logs) still rides the head relay below
                     self._lease_worker_msg(wid, msg)
                 else:
+                    if msg[0] == "task_done":
+                        self._free_head_devices(wid, worker_gone=False)
                     self._send(("worker_msg", wid.binary(), msg))
         except (EOFError, OSError):
             self._on_worker_pipe_death(wid)
@@ -496,6 +506,7 @@ class NodeDaemon:
         entry = self.workers.pop(wid, None)
         if entry is None:
             return
+        self._free_head_devices(wid, worker_gone=True)
         proc, pipe = entry
         self._pipe_to_wid.pop(pipe, None)
         try:
@@ -537,8 +548,89 @@ class NodeDaemon:
         return True
 
     def _lease_charge(self, demand: Dict[str, float], sign: int) -> None:
+        from ray_tpu._private.resources import quantize
+
         for k, v in demand.items():
-            self._lease_in_use[k] = self._lease_in_use.get(k, 0.0) + sign * v
+            self._lease_in_use[k] = quantize(
+                self._lease_in_use.get(k, 0.0) + sign * v
+            )
+
+    def _instances(self):
+        """Per-device ledger for this node's indexed resources (TPU/GPU).
+        The daemon is the SINGLE authority for its node's device indices:
+        lease-dispatched tasks allocate in _lease_tick, head-dispatched
+        execs (actors, affinity tasks) allocate at the relay
+        (_maybe_assign_devices) — one ledger, no double-booking (parity:
+        resource_instance_set.h lives in the raylet)."""
+        led = getattr(self, "_instance_ledger", None)
+        if led is None:
+            from ray_tpu._private.resources import InstanceLedger
+
+            led = self._instance_ledger = InstanceLedger(self._total_resources)
+        return led
+
+    def _maybe_assign_devices(self, wid: WorkerID, inner):
+        """Inject a device assignment into a head-relayed exec. Actor
+        creations hold their devices until the worker dies; normal tasks
+        free on task_done. Method calls (ACTOR_TASK) reuse the creation's
+        assignment. A fragmentation failure relays unscoped (the head's
+        flat promise already committed the capacity) with a warning."""
+        from ray_tpu._private.task_spec import TaskType
+
+        if not (isinstance(inner, tuple) and inner and inner[0] == "exec"):
+            return inner
+        if len(inner) != 2:
+            return inner
+        spec = inner[1]
+        if spec.task_type not in (TaskType.NORMAL_TASK, TaskType.ACTOR_CREATION):
+            return inner
+        accel = self._instances().allocate(spec.resources)
+        if accel is None:
+            # the head frees a killed actor's resources before this
+            # daemon's pipe-death notices — a replacement's exec can win
+            # that race. Reclaim devices held by already-dead workers and
+            # retry before giving up.
+            self._prune_dead_head_accel()
+            accel = self._instances().allocate(spec.resources)
+        if not accel:
+            if accel is None:
+                logger.warning(
+                    "device instances fragmented for head-dispatched task %s;"
+                    " running without accelerator scoping",
+                    spec.task_id.hex()[:8],
+                )
+            return inner
+        head_accel = getattr(self, "_head_accel", None)
+        if head_accel is None:
+            head_accel = self._head_accel = {}
+        head_accel[wid] = {
+            "alloc": accel,
+            "persist": spec.task_type == TaskType.ACTOR_CREATION,
+        }
+        return ("exec", spec, accel)
+
+    def _prune_dead_head_accel(self) -> None:
+        head_accel = getattr(self, "_head_accel", None)
+        if not head_accel:
+            return
+        for wid in list(head_accel):
+            entry = self.workers.get(wid)
+            if entry is None or (
+                entry[0] is not None and not entry[0].is_alive()
+            ):
+                rec = head_accel.pop(wid)
+                self._instances().free(rec["alloc"])
+
+    def _free_head_devices(self, wid: WorkerID, worker_gone: bool) -> None:
+        head_accel = getattr(self, "_head_accel", None)
+        if not head_accel:
+            return
+        rec = head_accel.get(wid)
+        if rec is None:
+            return
+        if worker_gone or not rec["persist"]:
+            del head_accel[wid]
+            self._instances().free(rec["alloc"])
 
     def _lease_tick(self) -> None:
         """Dispatch queued leased tasks onto local workers, flush completed
@@ -560,6 +652,13 @@ class NodeDaemon:
                     blocked_classes.add(klass)
                     skipped.append(spec)
                     continue
+                accel = self._instances().allocate(spec.resources)
+                if accel is None:
+                    # flat budget admits it but devices are fragmented:
+                    # treat like an infeasible class until something frees
+                    blocked_classes.add(klass)
+                    skipped.append(spec)
+                    continue
                 wid = None
                 while self._lease_idle:
                     cand = self._lease_idle.popleft()
@@ -567,6 +666,7 @@ class NodeDaemon:
                         wid = cand
                         break
                 if wid is None:
+                    self._instances().free(accel)
                     # no idle worker: spawn only what the queue can actually
                     # use (starting workers already count toward demand —
                     # spawning 4 for 1 queued task quadruples the import
@@ -583,10 +683,17 @@ class NodeDaemon:
                         self._lease_spawn()
                     break  # worker scarcity blocks every class equally
                 self._lease_charge(spec.resources, +1)
-                self._lease_running[wid] = {"spec": spec, "charged": True}
+                self._lease_running[wid] = {
+                    "spec": spec,
+                    "charged": True,
+                    "accel": accel,
+                }
                 try:
                     entry = self.workers[wid]
-                    entry[1].send(("exec", spec))
+                    if accel:
+                        entry[1].send(("exec", spec, accel))
+                    else:
+                        entry[1].send(("exec", spec))
                     self._lease_started_buf.append(spec.task_id.binary())
                 except (OSError, EOFError, BrokenPipeError):
                     self._on_worker_pipe_death(wid)
@@ -652,6 +759,8 @@ class NodeDaemon:
             info = self._lease_running.pop(wid, None)
             if info is not None and info["charged"]:
                 self._lease_charge(info["spec"].resources, -1)
+            if info is not None and info.get("accel"):
+                self._instances().free(info["accel"])
             self._lease_blocked.discard(wid)
             self._lease_done_buf.append((task_id.binary(), results))
             self._lease_mark_idle(wid)
@@ -683,6 +792,8 @@ class NodeDaemon:
         info = self._lease_running.pop(wid, None)
         if info is not None and info["charged"]:
             self._lease_charge(info["spec"].resources, -1)
+        if info is not None and info.get("accel"):
+            self._instances().free(info["accel"])
         tid_bin = info["spec"].task_id.binary() if info is not None else None
         try:
             self._send(("lease_worker_gone", wid.binary(), tid_bin))

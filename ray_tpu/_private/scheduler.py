@@ -200,12 +200,30 @@ class NodeState:
         return all(self.available.get(k, 0.0) >= v - 1e-9 for k, v in demand.items())
 
     def acquire(self, demand: Dict[str, float]) -> None:
+        # fixed-point grid (parity: fixed_point.h): fractional churn cannot
+        # drift a float ledger away from exact zero/total
+        from ray_tpu._private.resources import quantize
+
         for k, v in demand.items():
-            self.available[k] = self.available.get(k, 0.0) - v
+            self.available[k] = quantize(self.available.get(k, 0.0) - v)
 
     def release(self, demand: Dict[str, float]) -> None:
+        from ray_tpu._private.resources import quantize
+
         for k, v in demand.items():
-            self.available[k] = min(self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+            self.available[k] = quantize(
+                min(self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+            )
+
+    def instances(self):
+        """Per-device ledger for indexed resources (TPU/GPU); lazy, parity:
+        ``resource_instance_set.h``."""
+        led = self.__dict__.get("_instance_ledger")
+        if led is None:
+            from ray_tpu._private.resources import InstanceLedger
+
+            led = self.__dict__["_instance_ledger"] = InstanceLedger(self.total)
+        return led
 
     def utilization(self) -> float:
         if not self.total:
@@ -253,6 +271,9 @@ class WorkerState:
     current_task: Optional[TaskID] = None
     acquired: Dict[str, float] = field(default_factory=dict)
     acquired_node: Optional[NodeID] = None
+    # indexed-resource device assignment for the current task (TPU/GPU
+    # instance indices; freed with the resources)
+    accel_alloc: Dict[str, list] = field(default_factory=dict)
     actor_id: Optional[ActorID] = None
     pg_reservation: Optional[Tuple[PlacementGroupID, int]] = None
     # address of the worker's direct actor-call listener (rides the ready
@@ -465,6 +486,12 @@ class Scheduler:
         # are tracked here; single-channel (owner-only) oids free on zero.
         self._deferred_frees: collections.deque = collections.deque()
         self._cross_channel: set = set()
+        # general pubsub channels (parity: GCS pubsub, src/ray/pubsub/):
+        # channel -> {"workers": set[wid], "local": set[SimpleQueue]};
+        # publishes fan out at the head — worker subscribers get a pushed
+        # ("pubsub_msg", channel, blob) on their conn, in-process (driver)
+        # subscribers get the blob on their queue
+        self._pubsub: Dict[str, dict] = {}
         # event-driven dispatch bookkeeping
         self._dispatch_dirty = True
         self._last_full_dispatch = 0.0
@@ -1311,6 +1338,25 @@ class Scheduler:
                 e = self.memory_store.get_entry(oid)
                 if e is not None:
                     self._wake_waiters(oid, e)
+        elif kind == "pubsub_publish":
+            self._pubsub_fanout(cmd[1], cmd[2])
+        elif kind == "pubsub_sub":
+            ch = self._pubsub.setdefault(
+                cmd[1], {"workers": set(), "local": set()}
+            )
+            if holder is not None:
+                ch["workers"].add(holder)
+            else:
+                ch["local"].add(cmd[2])
+        elif kind == "pubsub_unsub":
+            ch = self._pubsub.get(cmd[1])
+            if ch is not None:
+                if holder is not None:
+                    ch["workers"].discard(holder)
+                elif len(cmd) > 2:
+                    ch["local"].discard(cmd[2])
+                if not ch["workers"] and not ch["local"]:
+                    del self._pubsub[cmd[1]]
         elif kind == "ref_batch":
             # ordered batch of ref ops: (1, oid) add, (-1, oid) remove,
             # (2, oid, token) transit pin, (3, oid, token) transit release;
@@ -1723,10 +1769,29 @@ class Scheduler:
         wid = self._acquire_worker(node, spec)
         if wid is None:
             return False
-        node.acquire(spec.resources)
         w = self.workers[wid]
+        accel: Dict[str, list] = {}
+        if node.daemon_conn is None:
+            # daemonless (head/virtual) nodes: the head's per-device ledger
+            # is authoritative. Daemon nodes assign devices at the RELAY
+            # (raylet.py to_worker) so lease-dispatched and head-dispatched
+            # tasks share ONE ledger and can't double-book a chip.
+            got = node.instances().allocate(spec.resources)
+            if got is None:
+                # flat ledger admits it, but devices are fragmented (e.g. a
+                # 0.8 demand across two 0.4-free chips): cannot place now —
+                # hand the worker back and retry after a release
+                w.state = "idle"
+                w.idle_since = time.monotonic()
+                self._idle_by_node[node.node_id].append(wid)
+                return False
+            accel = got
+        node.acquire(spec.resources)
         w.acquired = dict(spec.resources)
         w.acquired_node = node.node_id
+        # indexed resources (TPU/GPU): the worker gets TPU_VISIBLE_CHIPS /
+        # CUDA_VISIBLE_DEVICES scoped to the task
+        w.accel_alloc = accel
         self._send_exec(wid, rec)
         return True
 
@@ -1784,7 +1849,10 @@ class Scheduler:
             w.actor_id = rec.spec.actor_id
         self._record_event(rec.spec, "RUNNING")
         try:
-            w.conn.send(("exec", rec.spec))
+            if w.accel_alloc:
+                w.conn.send(("exec", rec.spec, w.accel_alloc))
+            else:
+                w.conn.send(("exec", rec.spec))
         except (OSError, EOFError):
             self._on_worker_death(wid)
 
@@ -2410,12 +2478,40 @@ class Scheduler:
             node = self.nodes.get(w.acquired_node)
             if node is not None:
                 node.release(w.acquired)
+                if w.accel_alloc:
+                    node.instances().free(w.accel_alloc)
         w.acquired = {}
         w.acquired_node = None
+        w.accel_alloc = {}
 
     def _commit_result(self, oid: ObjectID, entry: Tuple):
         self.memory_store.put(oid, entry)
         self._wake_waiters(oid, entry)
+
+    def _pubsub_fanout(self, channel: str, blob: bytes) -> None:
+        """Push one published message to every subscriber of a channel.
+        Dead worker subscribers are pruned lazily here (and their conns'
+        failures route through the normal worker-death path)."""
+        ch = self._pubsub.get(channel)
+        if ch is None:
+            return
+        for q in ch["local"]:
+            q.put(blob)
+        dead = []
+        for wid in ch["workers"]:
+            w = self.workers.get(wid)
+            if w is None or w.state == "dead":
+                dead.append(wid)
+                continue
+            try:
+                w.conn.send(("pubsub_msg", channel, blob))
+            except (OSError, EOFError):
+                dead.append(wid)
+                self._on_worker_death(wid)
+        for wid in dead:
+            ch["workers"].discard(wid)
+        if not ch["workers"] and not ch["local"]:
+            self._pubsub.pop(channel, None)
 
     def _wake_waiters(self, oid: ObjectID, entry: Tuple):
         # wake dependent tasks
@@ -2766,6 +2862,11 @@ class Scheduler:
     # ---- rpc served to workers ------------------------------------------
 
     def _serve_rpc(self, op: str, args):
+        if op == "pubsub_sync":
+            # loop-ordered no-op: a subscriber's barrier that its
+            # pubsub_sub (same channel: conn recv order / loop queue) has
+            # been registered before subscribe() returns
+            return True
         if op == "kv_put":
             return self.gcs.kv_put(*args)
         if op == "kv_get":

@@ -272,6 +272,26 @@ class DriverRuntime:
         if self._direct is not None:
             self._direct.release_stream(task_id)
 
+    # -- pubsub (parity: GCS pubsub subscriber surface) --------------------
+
+    def pubsub_publish(self, channel: str, blob: bytes) -> None:
+        self.scheduler.post(("pubsub_publish", channel, blob))
+
+    def pubsub_subscribe(self, channel: str):
+        import queue as _queue
+
+        q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self.scheduler.post(("pubsub_sub", channel, q))
+        # loop-ordered barrier (see WorkerRuntime.pubsub_subscribe)
+        try:
+            self.scheduler_rpc("pubsub_sync", ())
+        except Exception:
+            pass
+        return q
+
+    def pubsub_unsubscribe(self, channel: str, q) -> None:
+        self.scheduler.post(("pubsub_unsub", channel, q))
+
     def transit_pin(self, pairs):
         if self._direct is not None:
             self._direct.ensure_published([oid for oid, _ in pairs])

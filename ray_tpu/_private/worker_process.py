@@ -158,6 +158,9 @@ class WorkerRuntime:
         self._responses_lock = threading.Lock()
         self.exec_queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._stopped = threading.Event()
+        # pubsub: channel -> local subscriber queues fed by pushed msgs
+        self._pubsub_local: Dict[str, List] = {}
+        self._pubsub_lock = threading.Lock()
         # pickled-function blob -> deserialized callable/method-name (parity:
         # the reference's per-worker function table; same blob = same object)
         self._fn_cache: Dict[bytes, Any] = {}
@@ -236,7 +239,29 @@ class WorkerRuntime:
                     if q is not None:
                         q.put(msg)
                 elif kind == "exec":
+                    accel = msg[2] if len(msg) > 2 else None
+                    prev = getattr(self, "_accel_alloc", None)
+                    if accel or prev:
+                        # scope the process's accelerator visibility to the
+                        # task (env applies before the exec dequeues — pipe
+                        # order guarantees it precedes the task thread's
+                        # first device use). ALWAYS drop the previous
+                        # task's keys first: a TPU task followed by a
+                        # GPU-only task must not keep TPU_VISIBLE_CHIPS
+                        from ray_tpu._private.resources import visible_env_for
+
+                        if prev:
+                            for k in visible_env_for(prev):
+                                os.environ.pop(k, None)
+                        if accel:
+                            os.environ.update(visible_env_for(accel))
+                        self._accel_alloc = accel
                     self.exec_queue.put(msg[1])
+                elif kind == "pubsub_msg":
+                    with self._pubsub_lock:
+                        queues = list(self._pubsub_local.get(msg[1], ()))
+                    for q in queues:
+                        q.put(msg[2])
                 elif kind == "dump_stacks":
                     # reporter-agent stack dump (runs here on the reader
                     # thread so a busy/blocked task thread still reports)
@@ -597,6 +622,46 @@ class WorkerRuntime:
     def release_stream(self, task_id):
         if self._direct is not None:
             self._direct.release_stream(task_id)
+
+    # -- pubsub (parity: GCS pubsub subscriber surface) --------------------
+
+    def pubsub_publish(self, channel: str, blob: bytes) -> None:
+        self._send(("cmd", ("pubsub_publish", channel, blob)))
+
+    def pubsub_subscribe(self, channel: str):
+        import queue as _queue
+
+        q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        with self._pubsub_lock:
+            lst = self._pubsub_local.setdefault(channel, [])
+            first = not lst
+            lst.append(q)
+        if first:
+            self._send(("cmd", ("pubsub_sub", channel)))
+            # barrier: cmd and rpc share this conn and the head handles them
+            # in receipt order — the roundtrip guarantees the subscription
+            # is registered before subscribe() returns, so a publish issued
+            # next (from any process) cannot outrun it
+            try:
+                self.rpc("pubsub_sync")
+            except Exception:
+                pass
+        return q
+
+    def pubsub_unsubscribe(self, channel: str, q) -> None:
+        with self._pubsub_lock:
+            lst = self._pubsub_local.get(channel)
+            if lst is None:
+                return
+            try:
+                lst.remove(q)
+            except ValueError:
+                return
+            last = not lst
+            if last:
+                del self._pubsub_local[channel]
+        if last:
+            self._send(("cmd", ("pubsub_unsub", channel)))
 
     def transit_pin(self, pairs):
         # serializing a locally-owned ref hands it to another process:

@@ -37,8 +37,22 @@ _PREFETCH = 4
 def _apply_ops(block: Batch, ops) -> Batch:
     import cloudpickle
 
-    for kind, fn_blob in ops:
-        fn = cloudpickle.loads(fn_blob)
+    for kind, payload in ops:
+        # declarative column ops carry plain data (no closure): they stay
+        # inspectable for the logical optimizer (ray_tpu/data/optimizer.py)
+        if kind == "select":
+            missing = [c for c in payload if c not in block]
+            if missing:
+                raise KeyError(f"select_columns: missing {missing}")
+            block = {k: block[k] for k in payload}
+            continue
+        if kind == "drop":
+            block = {k: v for k, v in block.items() if k not in payload}
+            continue
+        if kind == "rename":
+            block = {payload.get(k, k): v for k, v in block.items()}
+            continue
+        fn = cloudpickle.loads(payload)
         if kind == "map_batches":
             block = normalize_block(fn(block))
         elif kind == "map":
@@ -112,9 +126,11 @@ class Dataset:
     def _with_op(self, kind: str, fn: Callable) -> "Dataset":
         import cloudpickle
 
+        return self._with_raw_op((kind, cloudpickle.dumps(fn)))
+
+    def _with_raw_op(self, op) -> "Dataset":
         from ray_tpu.data.streaming_executor import TaskMapStage
 
-        op = (kind, cloudpickle.dumps(fn))
         stages = list(self._stages)
         if stages and isinstance(stages[-1], TaskMapStage):
             # fuse into the trailing task-map: the chain runs as ONE task
@@ -245,31 +261,15 @@ class Dataset:
         return self._with_op("map_batches", _add)
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
-        cols = list(cols)
-
-        def _drop(batch):
-            return {k: v for k, v in batch.items() if k not in cols}
-
-        return self._with_op("map_batches", _drop)
+        # declarative (no closure): the logical optimizer coalesces chains
+        # of these and pushes projections into column-pruning reads
+        return self._with_raw_op(("drop", list(cols)))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        cols = list(cols)
-
-        def _select(batch):
-            missing = [c for c in cols if c not in batch]
-            if missing:
-                raise KeyError(f"select_columns: missing {missing}")
-            return {k: batch[k] for k in cols}
-
-        return self._with_op("map_batches", _select)
+        return self._with_raw_op(("select", list(cols)))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
-        mapping = dict(mapping)
-
-        def _rename(batch):
-            return {mapping.get(k, k): v for k, v in batch.items()}
-
-        return self._with_op("map_batches", _rename)
+        return self._with_raw_op(("rename", dict(mapping)))
 
     def unique(self, column: str) -> List:
         """Distinct values of one column: per-block remote uniques, only the
@@ -589,6 +589,17 @@ class Dataset:
         return pd.DataFrame({k: list(v) if getattr(v, "ndim", 1) > 1 else v
                              for k, v in block.items()})
 
+    def to_arrow(self):
+        """Single pyarrow.Table of the whole dataset (parity: to_arrow_refs
+        collapsed to one table — the common interop shape). Numeric numpy
+        columns wrap zero-copy; object columns convert."""
+        return _to_arrow_table(self.to_block())
+
+    def to_arrow_refs(self) -> List:
+        """Per-block Arrow conversion as refs (parity: to_arrow_refs)."""
+        src_refs, ops = self._refs_and_ops()
+        return [_block_to_arrow.remote(r, ops) for r in src_refs]
+
     def to_numpy_refs(self) -> List:
         return list(self._iter_exec_block_refs())
 
@@ -681,6 +692,25 @@ def _sample_block(block: Batch, fraction: float, base: int, index: int) -> Batch
     rng = np.random.default_rng([base, index])
     keep = rng.random(block_num_rows(block)) < fraction
     return {k: np.asarray(v)[keep] for k, v in block.items()}
+
+
+def _to_arrow_table(block: Batch):
+    """dict-of-columns block -> pyarrow.Table (zero-copy for contiguous
+    numerics; object columns convert element-wise)."""
+    import pyarrow as pa
+
+    return pa.table(
+        {
+            k: pa.array(list(v)) if getattr(v, "dtype", None) is not None
+            and v.dtype == object else pa.array(np.asarray(v))
+            for k, v in block.items()
+        }
+    )
+
+
+@ray_tpu.remote
+def _block_to_arrow(block, ops):
+    return _to_arrow_table(_apply_ops(block, ops))
 
 
 @ray_tpu.remote

@@ -130,11 +130,11 @@ def _local_copy(path: str):
 
 
 @ray_tpu.remote
-def _read_parquet_file(path: str):
+def _read_parquet_file(path: str, columns=None):
     import pyarrow.parquet as pq
 
     with _local_copy(path) as local:
-        table = pq.read_table(local)
+        table = pq.read_table(local, columns=columns)
     return {c: table.column(c).to_numpy(zero_copy_only=False) for c in table.column_names}
 
 
@@ -199,14 +199,45 @@ def read_binary_files(paths) -> Dataset:
     return _lazy_read(_read_binary_file, _expand_paths(paths, ""))
 
 
-def from_arrow(table) -> Dataset:
-    block = {c: table.column(c).to_numpy(zero_copy_only=False)
-             for c in table.column_names}
-    return Dataset([ray_tpu.put(block)])
+def from_arrow(table, *, num_blocks: int = 1) -> Dataset:
+    """Arrow table(s) → Dataset. Slicing is zero-copy on the Arrow side;
+    numeric columns convert to numpy without a copy where the layout
+    allows (parity: ``from_arrow``/ArrowBlockAccessor)."""
+    tables = table if isinstance(table, (list, tuple)) else [table]
+    refs = []
+    for t in tables:
+        n = t.num_rows
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        for start in builtins.range(0, max(n, 1), per):
+            sl = t.slice(start, min(per, n - start))
+            refs.append(
+                ray_tpu.put(
+                    {
+                        c: sl.column(c).to_numpy(zero_copy_only=False)
+                        for c in sl.column_names
+                    }
+                )
+            )
+    return Dataset(refs)
 
 
-def read_parquet(paths) -> Dataset:
-    return _lazy_read(_read_parquet_file, _expand_paths(paths, ".parquet"))
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """Parquet read with column pruning: ``columns`` (or a subsequent
+    ``select_columns``, via the logical optimizer's projection pushdown)
+    restricts what is decoded from the files."""
+    from ray_tpu.data.streaming_executor import ReadTask
+
+    return Dataset(
+        [
+            ReadTask(
+                _read_parquet_file,
+                (p,),
+                columns=list(columns) if columns else None,
+                supports_columns=True,
+            )
+            for p in _expand_paths(paths, ".parquet")
+        ]
+    )
 
 
 def read_csv(paths) -> Dataset:

@@ -42,12 +42,21 @@ from ray_tpu.data.block import (
 class ReadTask:
     """A lazy source block: ``fn.remote(*args)`` produces the block. Kept
     unsubmitted until the executor's source window has room, so reading a
-    100k-file dataset does not flood the cluster with 100k tasks."""
+    100k-file dataset does not flood the cluster with 100k tasks.
+
+    ``supports_columns`` marks readers that can prune columns at the file
+    (parquet): the logical optimizer pushes a leading select into
+    ``columns`` so pruned data never leaves the source (parity: projection
+    pushdown, ``_internal/logical/rules/``)."""
 
     fn: Any  # a ray_tpu remote function
     args: Tuple
+    columns: Optional[List[str]] = None
+    supports_columns: bool = False
 
     def submit(self):
+        if self.columns is not None:
+            return self.fn.remote(*self.args, columns=self.columns)
         return self.fn.remote(*self.args)
 
 
@@ -277,7 +286,12 @@ class RebatchStage:
 
 
 def iter_stage_refs(sources: List, stages: List, owned_actors: List) -> Iterator:
-    """Compose the stage generators into one lazily-driven pipeline."""
+    """Compose the stage generators into one lazily-driven pipeline, after
+    the logical optimizer has rewritten the plan (projection algebra +
+    pushdown into column-pruning reads)."""
+    from ray_tpu.data.optimizer import optimize_plan
+
+    sources, stages = optimize_plan(sources, stages)
     stream: Iterator = SourceStage(sources).stream()
     for stage in stages:
         if isinstance(stage, ActorMapStage):

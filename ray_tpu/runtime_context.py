@@ -16,6 +16,7 @@ class RuntimeContext:
     worker_id: Optional[str]
     actor_id: Optional[str]
     task_id: Optional[str]
+    accelerator_ids: Optional[dict] = None
 
     def get_job_id(self):
         return self.job_id
@@ -31,6 +32,15 @@ class RuntimeContext:
 
     def get_worker_id(self):
         return self.worker_id
+
+    def get_accelerator_ids(self) -> dict:
+        """Device instances assigned to the current task (parity:
+        ``RuntimeContext.get_accelerator_ids``): ``{"TPU": ["0", "1"]}``.
+        Empty lists when the task requested no indexed resources."""
+        out = {"TPU": [], "GPU": []}
+        for name, alloc in (self.accelerator_ids or {}).items():
+            out[name] = [str(i) for i, _ in alloc]
+        return out
 
 
 def get_runtime_context() -> RuntimeContext:
@@ -51,4 +61,5 @@ def get_runtime_context() -> RuntimeContext:
         worker_id=rt.worker_id.hex(),
         actor_id=actor.hex() if actor else None,
         task_id=tid.hex() if tid else None,
+        accelerator_ids=getattr(rt, "_accel_alloc", None),
     )

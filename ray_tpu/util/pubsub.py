@@ -1,0 +1,89 @@
+"""General publish/subscribe channels over the cluster RPC substrate.
+
+Parity: the reference's GCS pubsub (``src/ray/pubsub/publisher.h:38``,
+``subscriber.h``) — named channels any process can publish to, with
+push-based delivery to every subscriber. The head fans a published message
+out once per subscriber process; within a process, every local subscription
+gets its own queue. Messages are delivered to CURRENT subscribers only (no
+replay) — the reference's semantics.
+
+    from ray_tpu.util.pubsub import publish, subscribe
+
+    sub = subscribe("alerts")           # driver, task, or actor — anywhere
+    publish("alerts", {"sev": "high"})  # any process
+    msg = sub.get(timeout=5)            # -> {"sev": "high"}
+    for msg in sub:                     # or iterate (blocking)
+        ...
+    sub.close()
+
+Internals (``_private/scheduler.py`` ``_pubsub_fanout``): worker subscribers
+receive ``("pubsub_msg", channel, blob)`` pushes on their head connection
+(the same pipe that carries pull replies), so delivery needs no polling;
+in-head (driver) subscribers are fed directly on the scheduler loop.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from typing import Any, Iterator, Optional
+
+import cloudpickle
+
+
+class Subscription:
+    """One subscriber of one channel. Not thread-safe across concurrent
+    ``get`` calls (each message goes to exactly one getter)."""
+
+    def __init__(self, channel: str, q, rt):
+        self.channel = channel
+        self._q = q
+        self._rt = rt
+        self._closed = False
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next message (deserialized). Raises ``queue.Empty`` on timeout."""
+        if timeout is None:
+            blob = self._q.get()
+        else:
+            blob = self._q.get(timeout=timeout)
+        return cloudpickle.loads(blob)
+
+    def get_nowait(self) -> Any:
+        return cloudpickle.loads(self._q.get_nowait())
+
+    def __iter__(self) -> Iterator[Any]:
+        while not self._closed:
+            yield self.get()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._rt.pubsub_unsubscribe(self.channel, self._q)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def publish(channel: str, message: Any) -> None:
+    """Publish to every current subscriber of ``channel``."""
+    from ray_tpu._private.worker import get_runtime
+
+    get_runtime().pubsub_publish(str(channel), cloudpickle.dumps(message))
+
+
+def subscribe(channel: str) -> Subscription:
+    """Subscribe to ``channel``; messages published AFTER this call are
+    delivered to the returned ``Subscription``."""
+    from ray_tpu._private.worker import get_runtime
+
+    rt = get_runtime()
+    return Subscription(str(channel), rt.pubsub_subscribe(str(channel)), rt)
+
+
+_queue_Empty = _queue.Empty  # re-export convenience for callers

@@ -143,3 +143,53 @@ def test_spread_strategy(ray_start_cluster):
         return 1
 
     assert sum(ray_tpu.get([f.remote() for _ in range(4)], timeout=120)) == 4
+
+
+def test_device_instances_across_dispatch_planes():
+    """One per-device ledger per node (daemon-authoritative): head-relayed
+    actors and daemon-leased tasks must never share a chip, kills recycle
+    indices, and TPU_VISIBLE_CHIPS reaches the worker (parity:
+    resource_instance_set.h + accelerator env isolation)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"TPU": 2})
+    cluster.wait_for_nodes()
+    try:
+        @ray_tpu.remote(num_cpus=0, resources={"TPU": 1})
+        class Chip:
+            def which(self):
+                import os
+
+                return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        a, b = Chip.remote(), Chip.remote()
+        got = {
+            ray_tpu.get(a.which.remote(), timeout=120),
+            ray_tpu.get(b.which.remote(), timeout=120),
+        }
+        assert got == {"0", "1"}, got
+
+        c = Chip.remote()  # pending: both chips held
+        ray_tpu.kill(a)
+        assert ray_tpu.get(c.which.remote(), timeout=120) in ("0", "1")
+
+        ray_tpu.kill(b)
+        ray_tpu.kill(c)
+        time.sleep(1.0)
+
+        @ray_tpu.remote(num_cpus=0, resources={"TPU": 1})
+        def probe():
+            import os
+            import time as _t
+
+            _t.sleep(0.8)
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        xs = ray_tpu.get([probe.remote(), probe.remote()], timeout=120)
+        assert set(xs) == {"0", "1"}, xs
+    finally:
+        cluster.shutdown()

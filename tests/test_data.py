@@ -566,3 +566,137 @@ def test_actor_pool_grows_under_backlog(ray_start_regular):
 
     stages = [s for s in ds._stages if isinstance(s, ActorMapStage)]
     assert stages and stages[0].pool_size() > 1, "pool never grew"
+
+
+# ---- logical-plan optimizer (parity: _internal/logical/rules/) ----
+
+
+def test_optimizer_projection_algebra():
+    from ray_tpu.data.optimizer import optimize_ops
+
+    # select/select intersects (when sound), drop/drop unions
+    assert optimize_ops([("select", ["a", "b"]), ("select", ["b"])]) == [
+        ("select", ["b"])
+    ]
+    assert optimize_ops([("drop", ["a"]), ("drop", ["b"])]) == [
+        ("drop", ["a", "b"])
+    ]
+    assert optimize_ops([("select", ["a", "b"]), ("drop", ["b"])]) == [
+        ("select", ["a"])
+    ]
+    # select of a column the earlier select pruned must NOT merge (the
+    # runtime KeyError is user-visible behavior)
+    ops = [("select", ["a"]), ("select", ["b"])]
+    assert optimize_ops(ops) == ops
+    # rename compose
+    assert optimize_ops(
+        [("rename", {"a": "b"}), ("rename", {"b": "c", "x": "y"})]
+    ) == [("rename", {"a": "c", "x": "y"})]
+    # select commutes left past rename (pushdown direction)
+    out = optimize_ops([("rename", {"a": "b"}), ("select", ["b", "c"])])
+    assert out == [("select", ["a", "c"]), ("rename", {"a": "b"})]
+
+
+def test_optimizer_pushdown_into_parquet_read(ray_start_regular, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.optimizer import optimize_plan
+    from ray_tpu.data.streaming_executor import TaskMapStage
+
+    p = tmp_path / "t.parquet"
+    pq.write_table(
+        pa.table({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0], "c": ["x", "y", "z"]}),
+        p,
+    )
+    ds = rd.read_parquet(str(p)).select_columns(["a", "b"])
+    # the plan rewrite moves the select into the read task
+    src, stages = optimize_plan(ds._block_refs, ds._stages)
+    assert src[0].columns == ["a", "b"]
+    assert not any(
+        op for s in stages if isinstance(s, TaskMapStage) for op in s.ops
+    )
+    # end-to-end result is identical to the unoptimized semantics
+    rows = ds.take_all()
+    assert rows == [{"a": 1, "b": 4.0}, {"a": 2, "b": 5.0}, {"a": 3, "b": 6.0}]
+    # rename then select: commutes into the read too
+    ds2 = (
+        rd.read_parquet(str(p))
+        .rename_columns({"a": "id"})
+        .select_columns(["id"])
+    )
+    src2, _ = optimize_plan(ds2._block_refs, ds2._stages)
+    assert src2[0].columns == ["a"]
+    assert ds2.take_all() == [{"id": 1}, {"id": 2}, {"id": 3}]
+
+
+def test_read_parquet_columns_arg(ray_start_regular, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = tmp_path / "t.parquet"
+    pq.write_table(pa.table({"a": [1, 2], "b": [3, 4]}), p)
+    assert rd.read_parquet(str(p), columns=["b"]).take_all() == [
+        {"b": 3},
+        {"b": 4},
+    ]
+
+
+def test_arrow_roundtrip(ray_start_regular):
+    import pyarrow as pa
+
+    t = pa.table({"x": list(range(10)), "s": [f"r{i}" for i in range(10)]})
+    ds = rd.from_arrow(t, num_blocks=3)
+    assert ds.count() == 10
+    back = ds.to_arrow()
+    assert back.column("x").to_pylist() == list(range(10))
+    assert back.column("s").to_pylist() == [f"r{i}" for i in range(10)]
+    # per-block refs form
+    tables = ray_tpu.get(ds.to_arrow_refs(), timeout=120)
+    assert sum(tb.num_rows for tb in tables) == 10
+
+
+def test_declarative_column_ops_execute(ray_start_regular):
+    ds = rd.from_items([{"a": i, "b": i * 2, "c": i * 3} for i in range(6)])
+    out = (
+        ds.drop_columns(["c"])
+        .rename_columns({"b": "bb"})
+        .select_columns(["bb"])
+        .take_all()
+    )
+    assert out == [{"bb": i * 2} for i in range(6)]
+    with pytest.raises((KeyError, ray_tpu.exceptions.TaskError, Exception)):
+        ds.select_columns(["nope"]).take_all()
+
+
+def test_optimizer_preserves_error_semantics(ray_start_regular, tmp_path):
+    """The rewrite must never mask a user-visible KeyError or widen a read
+    (review r5 findings: renamed-away selects, pre-restricted reads)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.optimizer import optimize_ops, optimize_plan
+
+    # select of a renamed-AWAY column must not merge (it must still raise)
+    ops = [("rename", {"a": "b"}), ("select", ["a"])]
+    assert optimize_ops(ops) == ops
+    ds = rd.from_items([{"a": 1}]).rename_columns({"a": "b"}).select_columns(["a"])
+    with pytest.raises(Exception):
+        ds.take_all()
+    # drop of a renamed-away column is a no-op, not a drop of the source
+    ds2 = rd.from_items([{"a": 1}]).rename_columns({"a": "b"}).drop_columns(["a"])
+    assert ds2.take_all() == [{"b": 1}]
+
+    # pushdown must not widen a read_parquet(columns=...) restriction
+    p = tmp_path / "t.parquet"
+    pq.write_table(pa.table({"a": [1], "b": [2]}), p)
+    ds3 = rd.read_parquet(str(p), columns=["a"]).select_columns(["b"])
+    src, stages = optimize_plan(ds3._block_refs, ds3._stages)
+    assert src[0].columns == ["a"]  # untouched
+    with pytest.raises(Exception):
+        ds3.take_all()
+    # narrowing select DOES push into a restricted read
+    ds4 = rd.read_parquet(str(p), columns=["a", "b"]).select_columns(["a"])
+    src4, _ = optimize_plan(ds4._block_refs, ds4._stages)
+    assert src4[0].columns == ["a"]
+    assert ds4.take_all() == [{"a": 1}]

@@ -296,3 +296,85 @@ def test_runtime_env_pip_wheelhouse(ray_start_regular, tmp_path, monkeypatch):
     leaked = ray_tpu.get([check_clean.remote() for _ in range(4)], timeout=60)
     # none of the workers may carry the failed task's env var
     assert str(wheelhouse) not in [v for v in leaked if v is not None]
+
+
+# ---- fixed-point resources + per-instance accounting (SURVEY row 6) ----
+
+
+def test_fixed_point_no_drift():
+    from ray_tpu._private.resources import quantize
+
+    v = 1.0
+    for _ in range(10000):
+        v = quantize(v - 0.0001)
+    assert v == 0.0  # a float loop would land at ~1e-13, not exact zero
+
+
+def test_resource_instance_set_rules():
+    from ray_tpu._private.resources import ResourceInstanceSet
+
+    s = ResourceInstanceSet(4)
+    # whole demands take whole devices
+    a = s.allocate(2.0)
+    assert sorted(i for i, _ in a) == [0, 1]
+    # fractional demands pack onto one device (best-fit on partial first)
+    b = s.allocate(0.5)
+    c = s.allocate(0.25)
+    assert b[0][0] == c[0][0] == 2  # packs the same device
+    d = s.allocate(0.5)
+    assert d[0][0] == 3
+    # nothing left for a whole device
+    assert s.allocate(1.0) is None
+    # >1 must be whole
+    assert s.allocate(1.5) is None
+    s.free(a)
+    assert s.allocate(1.0) is not None
+    # free restores fractional capacity exactly
+    s.free(b)
+    s.free(c)
+    assert s.allocate(1.0) is not None  # device 2 whole again
+
+
+def test_instance_ledger_all_or_nothing():
+    from ray_tpu._private.resources import InstanceLedger
+
+    led = InstanceLedger({"TPU": 2.0, "GPU": 1.0, "CPU": 8.0})
+    ok = led.allocate({"TPU": 2.0, "GPU": 1.0, "CPU": 4.0})
+    assert set(ok) == {"TPU", "GPU"}  # CPU is not indexed
+    # GPU exhausted: a combined demand must roll back its TPU part too
+    led.free(ok)
+    led.allocate({"GPU": 1.0})
+    failed = led.allocate({"TPU": 1.0, "GPU": 1.0})
+    assert failed is None
+    assert led.allocate({"TPU": 2.0}) is not None  # TPU was rolled back
+
+
+def test_task_sees_assigned_accelerator_ids():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, resources={"TPU": 2}, ignore_reinit_error=True)
+
+    @ray_tpu.remote(resources={"TPU": 1})
+    def which():
+        import os
+
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_accelerator_ids()["TPU"], os.environ.get("TPU_VISIBLE_CHIPS")
+
+    try:
+        ids, env = ray_tpu.get(which.remote(), timeout=120)
+        assert len(ids) == 1 and env == ids[0]
+        # two concurrent 1-chip tasks must get DIFFERENT devices
+        import time as _time
+
+        @ray_tpu.remote(resources={"TPU": 1})
+        def hold():
+            import os
+
+            _time.sleep(1.0)
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        a, b = ray_tpu.get([hold.remote(), hold.remote()], timeout=120)
+        assert {a, b} == {"0", "1"}
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,96 @@
+"""General pubsub channels (parity: GCS pubsub, src/ray/pubsub/)."""
+
+import queue
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.pubsub import publish, subscribe
+
+
+@pytest.fixture
+def ray_start():
+    rt = ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_driver_pub_driver_sub(ray_start):
+    with subscribe("alpha") as sub:
+        publish("alpha", {"n": 1})
+        publish("alpha", [1, 2, 3])
+        assert sub.get(timeout=10) == {"n": 1}
+        assert sub.get(timeout=10) == [1, 2, 3]
+
+
+def test_worker_pub_driver_sub_and_fanout(ray_start):
+    @ray_tpu.remote
+    def announce(i):
+        publish("beta", {"from_task": i})
+        return i
+
+    sub1 = subscribe("beta")
+    sub2 = subscribe("beta")
+    ray_tpu.get([announce.remote(i) for i in range(3)], timeout=60)
+    got1 = sorted(sub1.get(timeout=10)["from_task"] for _ in range(3))
+    got2 = sorted(sub2.get(timeout=10)["from_task"] for _ in range(3))
+    assert got1 == got2 == [0, 1, 2]
+    sub1.close()
+    sub2.close()
+    # closed: a later publish is not delivered to sub1
+    publish("beta", {"late": True})
+    with pytest.raises(queue.Empty):
+        sub1.get(timeout=0.5)
+
+
+def test_actor_subscriber_receives_driver_publishes(ray_start):
+    @ray_tpu.remote
+    class Listener:
+        def __init__(self):
+            self.sub = subscribe("gamma")
+
+        def ready(self):
+            return True
+
+        def next(self):
+            return self.sub.get(timeout=30)
+
+    lis = Listener.remote()
+    ray_tpu.get(lis.ready.remote(), timeout=60)
+    publish("gamma", "hello-actor")
+    assert ray_tpu.get(lis.next.remote(), timeout=60) == "hello-actor"
+    ray_tpu.kill(lis)
+
+
+def test_worker_to_worker_channel(ray_start):
+    @ray_tpu.remote
+    class Consumer:
+        def __init__(self):
+            self.sub = subscribe("delta")
+
+        def ready(self):
+            return True
+
+        def take(self, n):
+            return sorted(self.sub.get(timeout=30) for _ in range(n))
+
+    @ray_tpu.remote
+    def producer(i):
+        publish("delta", i * 10)
+        return i
+
+    c = Consumer.remote()
+    ray_tpu.get(c.ready.remote(), timeout=60)
+    fut = c.take.remote(3)
+    ray_tpu.get([producer.remote(i) for i in range(3)], timeout=60)
+    assert ray_tpu.get(fut, timeout=60) == [0, 10, 20]
+    ray_tpu.kill(c)
+
+
+def test_no_replay_for_late_subscriber(ray_start):
+    publish("epsilon", "before")  # nobody listening: dropped
+    with subscribe("epsilon") as sub:
+        publish("epsilon", "after")
+        assert sub.get(timeout=10) == "after"
+        with pytest.raises(queue.Empty):
+            sub.get(timeout=0.3)
