@@ -1,0 +1,105 @@
+"""Ownership decentralization metric (VERDICT r4 #3).
+
+Same actor-call workload, two ownership models, measured at the head:
+
+* ``central``   — ``direct_actor_calls=False``: every call relays through
+  the head, every result commits into the head's store, every result ref
+  lives in the head's table (round-3 architecture);
+* ``caller``    — ``direct_actor_calls=True`` (default): calls go
+  worker→worker, results commit to a CALLER-LOCAL store with caller-side
+  refcounts (parity: owner-side memory store + reference_count.h), and the
+  head sees ownership traffic only when a ref escapes its owner.
+
+Emits one JSON line per mode with the head's ref-op and commit counters
+(``event_stats`` rpc, ``__ownership__``) normalized per call, plus the
+reduction factor. The driver commits stdout as OWNERSHIP_r05.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private.worker import get_runtime  # noqa: E402
+
+N_ACTORS = 4
+N_CALLERS = 4
+CALLS = 1500
+
+
+def run_mode(direct: bool) -> dict:
+    ray_tpu.init(num_cpus=4, _system_config={"direct_actor_calls": direct})
+    try:
+        rt = get_runtime()
+
+        @ray_tpu.remote(num_cpus=0)
+        class Svc:
+            def ping(self, i):
+                return i
+
+        @ray_tpu.remote(num_cpus=0)
+        def caller(actor, n):
+            got = 0
+            for i in range(n):
+                got += ray_tpu.get(actor.ping.remote(i), timeout=120)
+            return got
+
+        actors = [Svc.remote() for _ in range(N_ACTORS)]
+        for a in actors:
+            ray_tpu.get(a.ping.remote(0), timeout=60)  # warm
+        s0 = rt.rpc("event_stats")["__ownership__"]
+        t0 = time.perf_counter()
+        out = ray_tpu.get(
+            [
+                caller.remote(actors[i % N_ACTORS], CALLS)
+                for i in range(N_CALLERS)
+            ],
+            timeout=600,
+        )
+        dt = time.perf_counter() - t0
+        s1 = rt.rpc("event_stats")["__ownership__"]
+        assert out == [sum(range(CALLS))] * N_CALLERS
+        total_calls = N_CALLERS * CALLS
+        return {
+            "mode": "caller" if direct else "central",
+            "calls": total_calls,
+            "calls_per_sec": round(total_calls / dt, 1),
+            "head_ref_ops": s1["ref_ops"] - s0["ref_ops"],
+            "head_commits": s1["commits"] - s0["commits"],
+            "ref_ops_per_call": round((s1["ref_ops"] - s0["ref_ops"]) / total_calls, 3),
+            "commits_per_call": round((s1["commits"] - s0["commits"]) / total_calls, 3),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+def main():
+    central = run_mode(direct=False)
+    caller = run_mode(direct=True)
+    for row in (central, caller):
+        print(json.dumps({"metric": f"ownership_{row['mode']}", **row}), flush=True)
+    red_refs = central["head_ref_ops"] / max(1, caller["head_ref_ops"])
+    red_commits = central["head_commits"] / max(1, caller["head_commits"])
+    print(
+        json.dumps(
+            {
+                "metric": "ownership_decentralization",
+                "head_ref_op_reduction": round(red_refs, 1),
+                "head_commit_reduction": round(red_commits, 1),
+                "note": (
+                    "same n:n actor workload; caller-side ownership removes "
+                    "head ref/commit traffic except lifecycle + escapes"
+                ),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

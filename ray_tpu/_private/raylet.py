@@ -30,6 +30,7 @@ from multiprocessing.connection import Client
 from typing import Dict
 
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.resources import quantize
 
 logger = logging.getLogger(__name__)
 
@@ -548,8 +549,6 @@ class NodeDaemon:
         return True
 
     def _lease_charge(self, demand: Dict[str, float], sign: int) -> None:
-        from ray_tpu._private.resources import quantize
-
         for k, v in demand.items():
             self._lease_in_use[k] = quantize(
                 self._lease_in_use.get(k, 0.0) + sign * v

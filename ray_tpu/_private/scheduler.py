@@ -45,6 +45,7 @@ from ray_tpu._private.ids import (
 )
 from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec, TaskType
+from ray_tpu._private.resources import quantize
 
 logger = logging.getLogger(__name__)
 
@@ -202,14 +203,10 @@ class NodeState:
     def acquire(self, demand: Dict[str, float]) -> None:
         # fixed-point grid (parity: fixed_point.h): fractional churn cannot
         # drift a float ledger away from exact zero/total
-        from ray_tpu._private.resources import quantize
-
         for k, v in demand.items():
             self.available[k] = quantize(self.available.get(k, 0.0) - v)
 
     def release(self, demand: Dict[str, float]) -> None:
-        from ray_tpu._private.resources import quantize
-
         for k, v in demand.items():
             self.available[k] = quantize(
                 min(self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
@@ -448,6 +445,11 @@ class Scheduler:
             lambda: [0, 0.0]
         )
         self._event_stats_last_print = time.monotonic()
+        # ownership-traffic instrumentation: every ref mutation and result
+        # commit the head processes (the decentralization metric — caller
+        # -owned results never appear here)
+        self._refop_count = 0
+        self._commit_count = 0
         # ---- multi-host plane (daemon-backed nodes) ----
         # daemon socket -> node id (the socket is in the wait set)
         self._daemon_conns: Dict[Any, NodeID] = {}
@@ -1809,11 +1811,28 @@ class Scheduler:
                 wid = self._acquire_worker(node, spec)
                 if wid is None:
                     return False
+                w = self.workers[wid]
+                accel: Dict[str, list] = {}
+                if node.daemon_conn is None:
+                    # PG reservations debit the flat ledger only; device
+                    # INDICES resolve at dispatch from the node ledger so
+                    # PG and non-PG tasks can't share a chip (daemon nodes
+                    # resolve at the relay instead)
+                    got = node.instances().allocate(spec.resources)
+                    if got is None:
+                        w.state = "idle"
+                        w.idle_since = time.monotonic()
+                        self._idle_by_node[node.node_id].append(wid)
+                        return False
+                    accel = got
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0.0) - v
-                w = self.workers[wid]
                 w.acquired = dict(spec.resources)
-                w.acquired_node = None
+                # flat release goes to the bundle (pg_reservation branch),
+                # but device instances free back into the NODE ledger they
+                # came from — keep the node id for that
+                w.acquired_node = node.node_id
+                w.accel_alloc = accel
                 w.pg_reservation = (pg.pg_id, i)
                 self._send_exec(wid, rec)
                 return True
@@ -2473,6 +2492,10 @@ class Scheduler:
                 avail = pg.bundle_available[i]
                 for k, v in w.acquired.items():
                     avail[k] = min(avail.get(k, 0.0) + v, pg.bundles[i].get(k, 0.0))
+            if w.accel_alloc and w.acquired_node is not None:
+                node = self.nodes.get(w.acquired_node)
+                if node is not None:
+                    node.instances().free(w.accel_alloc)
             w.pg_reservation = None
         elif w.acquired and w.acquired_node is not None:
             node = self.nodes.get(w.acquired_node)
@@ -2485,6 +2508,7 @@ class Scheduler:
         w.accel_alloc = {}
 
     def _commit_result(self, oid: ObjectID, entry: Tuple):
+        self._commit_count += 1
         self.memory_store.put(oid, entry)
         self._wake_waiters(oid, entry)
 
@@ -3106,6 +3130,10 @@ class Scheduler:
                 "cpu_s": time.clock_gettime(time.CLOCK_THREAD_CPUTIME_ID),
                 "wall_s": time.monotonic() - self._loop_started_at,
             }
+            out["__ownership__"] = {
+                "ref_ops": self._refop_count,
+                "commits": self._commit_count,
+            }
             return out
         raise ValueError(f"unknown rpc {op}")
 
@@ -3135,6 +3163,7 @@ class Scheduler:
         ``holder`` attributes borrows to a worker so a crashed borrower's
         refs are released by ``_on_worker_death`` instead of leaking.
         """
+        self._refop_count += 1
         if holder is not None or op in (2, 3):
             # ref traffic beyond the owner's own ordered channel: this oid's
             # future zeros must ride the deferred-free grace window

@@ -225,18 +225,11 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
     """Parquet read with column pruning: ``columns`` (or a subsequent
     ``select_columns``, via the logical optimizer's projection pushdown)
     restricts what is decoded from the files."""
-    from ray_tpu.data.streaming_executor import ReadTask
-
-    return Dataset(
-        [
-            ReadTask(
-                _read_parquet_file,
-                (p,),
-                columns=list(columns) if columns else None,
-                supports_columns=True,
-            )
-            for p in _expand_paths(paths, ".parquet")
-        ]
+    return _lazy_read(
+        _read_parquet_file,
+        _expand_paths(paths, ".parquet"),
+        columns=list(columns) if columns else None,
+        supports_columns=True,
     )
 
 
@@ -248,10 +241,22 @@ def read_json(paths) -> Dataset:
     return _lazy_read(_read_json_file, _expand_paths(paths, ".json"))
 
 
-def _lazy_read(remote_fn, paths: List[str]) -> Dataset:
+def _lazy_read(
+    remote_fn,
+    paths: List[str],
+    columns: Optional[List[str]] = None,
+    supports_columns: bool = False,
+) -> Dataset:
     """Source blocks as lazy ReadTasks: the streaming executor submits them
     with a bounded window instead of flooding the cluster with one task per
     file up front (parity: the reference's read-op backpressure)."""
     from ray_tpu.data.streaming_executor import ReadTask
 
-    return Dataset([ReadTask(remote_fn, (p,)) for p in paths])
+    return Dataset(
+        [
+            ReadTask(
+                remote_fn, (p,), columns=columns, supports_columns=supports_columns
+            )
+            for p in paths
+        ]
+    )
