@@ -1,0 +1,43 @@
+# Unified build/test/bench entry point (parity role: the reference's
+# top-level Bazel workspace + CI scripts — SURVEY §2.1 row "Build").
+#
+#   make native     build the C++ object-store runtime (.so)
+#   make cpp        build the C++ client API (+ demo binary)
+#   make sanitize   build + run the TSAN/ASAN store-chaos harnesses
+#   make test       full pytest suite (virtual 8-device CPU mesh)
+#   make test-fast  the quick core slice (smoke for iteration)
+#   make bench      the flagship MFU benchmark (one JSON line)
+#   make ci         everything CI runs: native + cpp + sanitize + test
+
+PY ?= python
+
+.PHONY: all native cpp sanitize test test-fast bench ci clean
+
+all: native cpp
+
+native:
+	$(MAKE) -C ray_tpu/native
+
+cpp:
+	$(MAKE) -C ray_tpu/cpp
+
+sanitize:
+	$(MAKE) -C ray_tpu/native tsan asan
+	./ray_tpu/native/store_chaos_tsan /dev/shm/ray_tpu_chaos_tsan 8 200
+	./ray_tpu/native/store_chaos_asan /dev/shm/ray_tpu_chaos_asan 8 200
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+test-fast: native
+	$(PY) -m pytest tests/test_core_basic.py tests/test_actors.py \
+		tests/test_direct_actor.py tests/test_data.py -q
+
+bench:
+	$(PY) bench.py
+
+ci: native cpp sanitize test
+
+clean:
+	$(MAKE) -C ray_tpu/native clean
+	$(MAKE) -C ray_tpu/cpp clean

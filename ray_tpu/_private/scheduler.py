@@ -269,8 +269,11 @@ class WorkerState:
     acquired: Dict[str, float] = field(default_factory=dict)
     acquired_node: Optional[NodeID] = None
     # indexed-resource device assignment for the current task (TPU/GPU
-    # instance indices; freed with the resources)
+    # instance indices; freed with the resources). accel_node is the node
+    # whose ledger they came from — tracked separately because PG workers
+    # keep acquired_node=None (their flat release goes to the bundle)
     accel_alloc: Dict[str, list] = field(default_factory=dict)
+    accel_node: Optional[NodeID] = None
     actor_id: Optional[ActorID] = None
     pg_reservation: Optional[Tuple[PlacementGroupID, int]] = None
     # address of the worker's direct actor-call listener (rides the ready
@@ -804,7 +807,14 @@ class Scheduler:
             if w.state == "busy" and w.actor_id is None:
                 w.state = "blocked"
                 if w.acquired and w.acquired_node is not None:
+                    # flat resources oversubscribe while blocked (reference
+                    # behavior), but device INSTANCES stay assigned — the
+                    # parked task resumes on its chips; freeing them here
+                    # would double-book the chip under a concurrent task
+                    accel, anode = w.accel_alloc, w.accel_node
+                    w.accel_alloc, w.accel_node = {}, None
                     self._release_resources(w)
+                    w.accel_alloc, w.accel_node = accel, anode
         elif kind == "block_end":
             if w.state == "blocked":
                 w.state = "busy"
@@ -1654,6 +1664,12 @@ class Scheduler:
         consecutive_fails = 0
         task_id = None
         self._pick_cache = {}
+        # per-resource-class attempt cap within one scan (the raylet's
+        # blocked-classes rule, relaxed to 4 so _acquire_worker's
+        # demand-driven spawn widening still ramps): a homogeneous
+        # 100-deep queue behind one freed worker costs ~4 placement
+        # attempts instead of fail_cap of them
+        class_fails: Dict[Tuple, int] = {}
         try:
             while self._pending:
                 task_id = self._pending.popleft()
@@ -1661,13 +1677,35 @@ class Scheduler:
                 if rec is None or rec.state not in ("PENDING",):
                     task_id = None
                     continue
+                strat = rec.spec.scheduling_strategy
+                klass = None
+                if strat.kind in ("DEFAULT", "SPREAD"):
+                    # task_type is part of the class: actor creations are
+                    # not leasable, so their failures must not block NORMAL
+                    # tasks of the same shape from the lease-overflow path
+                    klass = (
+                        strat.kind,
+                        rec.spec.task_type,
+                        tuple(sorted(rec.spec.resources.items())),
+                    )
+                    if class_fails.get(klass, 0) >= 4:
+                        deferred.append(task_id)
+                        consecutive_fails += 1
+                        task_id = None
+                        if consecutive_fails >= fail_cap:
+                            break
+                        continue
                 placed = self._try_dispatch(rec)
                 if not placed:
+                    if klass is not None:
+                        class_fails[klass] = class_fails.get(klass, 0) + 1
                     deferred.append(task_id)
                     consecutive_fails += 1
                     if consecutive_fails >= fail_cap:
                         break
                 else:
+                    if klass is not None:
+                        class_fails[klass] = 0
                     consecutive_fails = 0
                 task_id = None
         finally:
@@ -1794,6 +1832,7 @@ class Scheduler:
         # indexed resources (TPU/GPU): the worker gets TPU_VISIBLE_CHIPS /
         # CUDA_VISIBLE_DEVICES scoped to the task
         w.accel_alloc = accel
+        w.accel_node = node.node_id if accel else None
         self._send_exec(wid, rec)
         return True
 
@@ -1820,19 +1859,19 @@ class Scheduler:
                     # resolve at the relay instead)
                     got = node.instances().allocate(spec.resources)
                     if got is None:
+                        # fragmented on THIS bundle's node: hand the worker
+                        # back and try the remaining candidate bundles
                         w.state = "idle"
                         w.idle_since = time.monotonic()
                         self._idle_by_node[node.node_id].append(wid)
-                        return False
+                        continue
                     accel = got
                 for k, v in spec.resources.items():
                     avail[k] = avail.get(k, 0.0) - v
                 w.acquired = dict(spec.resources)
-                # flat release goes to the bundle (pg_reservation branch),
-                # but device instances free back into the NODE ledger they
-                # came from — keep the node id for that
-                w.acquired_node = node.node_id
+                w.acquired_node = None
                 w.accel_alloc = accel
+                w.accel_node = node.node_id if accel else None
                 w.pg_reservation = (pg.pg_id, i)
                 self._send_exec(wid, rec)
                 return True
@@ -2492,20 +2531,19 @@ class Scheduler:
                 avail = pg.bundle_available[i]
                 for k, v in w.acquired.items():
                     avail[k] = min(avail.get(k, 0.0) + v, pg.bundles[i].get(k, 0.0))
-            if w.accel_alloc and w.acquired_node is not None:
-                node = self.nodes.get(w.acquired_node)
-                if node is not None:
-                    node.instances().free(w.accel_alloc)
             w.pg_reservation = None
         elif w.acquired and w.acquired_node is not None:
             node = self.nodes.get(w.acquired_node)
             if node is not None:
                 node.release(w.acquired)
-                if w.accel_alloc:
-                    node.instances().free(w.accel_alloc)
+        if w.accel_alloc and w.accel_node is not None:
+            node = self.nodes.get(w.accel_node)
+            if node is not None:
+                node.instances().free(w.accel_alloc)
         w.acquired = {}
         w.acquired_node = None
         w.accel_alloc = {}
+        w.accel_node = None
 
     def _commit_result(self, oid: ObjectID, entry: Tuple):
         self._commit_count += 1

@@ -241,9 +241,7 @@ class WorkerRuntime:
                 elif kind == "exec":
                     accel = msg[2] if len(msg) > 2 else None
                     prev = getattr(self, "_accel_alloc", None)
-                    from ray_tpu._private.task_spec import TaskType as _TT
-
-                    if accel is None and msg[1].task_type == _TT.ACTOR_TASK:
+                    if accel is None and msg[1].task_type == TaskType.ACTOR_TASK:
                         # method calls carry no assignment of their own —
                         # the actor keeps its creation-time devices; do
                         # NOT wipe them (head-relayed calls arrive as

@@ -582,6 +582,24 @@ class Dataset:
         if buffered and not drop_last:
             yield concat_blocks([slice_block(b, o, block_num_rows(b)) for b, o in blocks])
 
+    def iter_jax_batches(self, **kw) -> Iterator[Dict]:
+        """Parity: the framework batch iterators live on Dataset too (the
+        reference's ``Dataset.iter_torch_batches`` family) — delegate to a
+        DataIterator over this plan."""
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self).iter_jax_batches(**kw)
+
+    def iter_tf_batches(self, **kw) -> Iterator[Dict]:
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self).iter_tf_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Dict]:
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self).iter_torch_batches(**kw)
+
     def to_pandas(self):
         import pandas as pd
 

@@ -19,7 +19,7 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune import bayesopt
 from ray_tpu.tune.bayesopt import BayesOptSearch
 from ray_tpu.tune.result_grid import ResultGrid
-from ray_tpu.tune.schedulers import PopulationBasedTraining, ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.schedulers import PopulationBasedTraining, ASHAScheduler, FIFOScheduler, HyperBandScheduler, MedianStoppingRule
 from ray_tpu.tune.stopper import (
     CombinedStopper,
     FunctionStopper,
@@ -50,6 +50,7 @@ __all__ = [
     "randn",
     "FIFOScheduler",
     "ASHAScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

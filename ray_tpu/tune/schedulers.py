@@ -108,6 +108,57 @@ class MedianStoppingRule:
         return CONTINUE if mine >= median else STOP
 
 
+class HyperBandScheduler:
+    """Bracketed successive halving (parity: ``tune/schedulers/hyperband.py``).
+
+    Classic HyperBand runs ``s_max+1`` brackets that trade exploration
+    breadth against per-trial budget: bracket ``s`` starts trials with
+    grace period ``max_t / eta**s`` and halves by ``eta`` at each rung.
+    Trials are assigned to brackets round-robin on first report. Rung
+    decisions are made asynchronously per trial (no pausing — the async
+    variant the reference recommends for elastic executors), so each
+    bracket behaves like ASHA at its own grace period while the bracket
+    spread preserves HyperBand's budget diversity."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 81,
+        reduction_factor: int = 3,
+    ):
+        assert mode in ("min", "max")
+        assert reduction_factor > 1, "reduction_factor must be > 1"
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        s_max = 0
+        t = max_t
+        while t > 1:
+            t //= reduction_factor
+            s_max += 1
+        self._brackets = [
+            ASHAScheduler(
+                metric=metric,
+                mode=mode,
+                max_t=max_t,
+                grace_period=max(1, max_t // (reduction_factor ** s)),
+                reduction_factor=reduction_factor,
+            )
+            for s in range(s_max, -1, -1)
+        ]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_result(self, trial_id: str, iteration: int, metrics: Dict) -> str:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._assignment[trial_id] = self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[b].on_result(trial_id, iteration, metrics)
+
+
 EXPLOIT = "EXPLOIT"
 
 

@@ -298,3 +298,30 @@ def test_bayesopt_beats_random_on_quadratic(ray_start_regular, tmp_path):
     best = grid.get_best_result(metric="neg_loss", mode="max")
     assert best.metrics["neg_loss"] > -0.01  # within 0.1 of the optimum
     assert abs(best.metrics["config"]["x"] - 0.73) < 0.1
+
+
+def test_hyperband_brackets_stop_bad_trials():
+    """HyperBand: bracketed halving stops weak trials at rungs (before
+    exhausting max_t) while the best survive, with bracket diversity in
+    grace periods."""
+    from ray_tpu.tune.schedulers import STOP, HyperBandScheduler
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=27, reduction_factor=3)
+    graces = sorted({b.grace for b in sched._brackets})
+    assert len(graces) > 1, "expected multiple bracket budgets"
+
+    # 12 trials; good trials report first so rungs are populated when the
+    # weak ones arrive (async halving judges against filled rungs)
+    order = sorted(range(12), reverse=True)
+    stopped_at = {}
+    for it in range(1, 28):
+        for i in order:
+            tid = f"t{i}"
+            if tid in stopped_at:
+                continue
+            if sched.on_result(tid, it, {"score": float(i)}) == STOP:
+                stopped_at[tid] = it
+    assert stopped_at.get("t11", 27) >= 27, "best trial must reach max_t"
+    early = {t for t, it in stopped_at.items() if it < 27}
+    assert len(early) >= 3, f"halving never stopped weak trials early: {stopped_at}"
+    assert all(int(t[1:]) < 11 for t in early)
