@@ -94,5 +94,109 @@ class Algorithm:
         self.iteration = blob["iteration"]
         self.set_state(blob["state"])
 
+    # -- inference / evaluation (parity: Algorithm.compute_single_action
+    # and the evaluation rollout surface, rllib/algorithms/algorithm.py) --
+
+    def _policy_params(self):
+        """The MLP-policy param tree actions come from. Policy-gradient
+        algos expose ``self.params``; SAC's actor is ``self.actor``."""
+        params = getattr(self, "params", None)
+        if params is None:
+            params = getattr(self, "actor", None)
+        if params is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not expose policy params for "
+                "single-action inference"
+            )
+        return params
+
+    def compute_single_action(self, obs, explore: bool = False) -> int:
+        """Action for one MODULE-space observation — i.e. after any
+        configured env-to-module connector pipeline has transformed it
+        (``evaluate`` does this; raw-obs callers with connectors must run
+        the pipeline themselves, since the net is built for its output
+        width). ``explore=False`` is greedy (argmax over the policy/Q
+        logits); ``explore=True`` samples, seeded from ``config.seed``."""
+        import numpy as np
+
+        fwd = getattr(self, "_single_action_logits", None)
+        if fwd is None:
+            import jax
+
+            from ray_tpu.rl.models import apply_mlp_policy
+
+            fwd = self._single_action_logits = jax.jit(
+                lambda p, o: apply_mlp_policy(p, o)[0]
+            )
+        logits = np.asarray(
+            fwd(self._policy_params(), np.asarray(obs, np.float32)[None])
+        )[0]
+        if explore:
+            rng = getattr(self, "_explore_rng", None)
+            if rng is None:
+                rng = self._explore_rng = np.random.default_rng(
+                    getattr(self.config, "seed", 0)
+                )
+            z = rng.gumbel(size=logits.shape)
+            return int(np.argmax(logits + z))
+        return int(np.argmax(logits))
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 10_000,
+                 max_steps_per_episode: int = 1000) -> Dict[str, Any]:
+        """Greedy evaluation rollouts on fresh envs, with the configured
+        env-to-module connector pipeline applied exactly as the training
+        runners apply it (parity: evaluation_interval rollouts)."""
+        import copy
+
+        import numpy as np
+
+        from ray_tpu.rl.env import make_env
+        from ray_tpu.rl.env_runner import _build_pipeline
+
+        # use the TRAINED connector state (a NormalizeObservations filter's
+        # running mean/std lives in the training runners), snapshotted so
+        # evaluation does not mutate it; fall back to a fresh pipeline only
+        # when no local runner exists
+        runners = getattr(self, "runners", None)
+        trained = getattr(runners, "local", None) if runners is not None else None
+        if trained is not None and getattr(trained, "connectors", None) is not None:
+            pipe = copy.deepcopy(trained.connectors)
+        else:
+            pipe = _build_pipeline(
+                getattr(self.config, "env_to_module_connector", None)
+            )
+        returns = []
+        lengths = []
+        for ep in range(num_episodes):
+            env = make_env(self.config.env, seed=seed + ep)
+            try:
+                # callable creators ignore make_env's seed: reseed on reset
+                obs = env.reset(seed=seed + ep)[0]
+            except TypeError:
+                obs = env.reset()[0]
+            total, steps = 0.0, 0
+            for _ in range(max_steps_per_episode):
+                raw = np.asarray(obs, np.float32)[None]
+                mod_obs = pipe(raw)[0] if pipe else raw[0]
+                action = self.compute_single_action(mod_obs)
+                if pipe:
+                    action = int(pipe.transform_action(np.asarray([action]))[0])
+                obs, reward, term, trunc, _ = env.step(action)
+                total += float(reward)
+                steps += 1
+                if term or trunc:
+                    break
+            returns.append(total)
+            lengths.append(steps)
+        return {
+            "evaluation": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes_this_iter": num_episodes,
+            }
+        }
+
     def stop(self) -> None:
         pass
