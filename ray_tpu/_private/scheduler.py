@@ -2924,6 +2924,33 @@ class Scheduler:
     # ---- rpc served to workers ------------------------------------------
 
     def _serve_rpc(self, op: str, args):
+        if op == "object_shm_ref":
+            # zero-copy local data plane for native clients (parity role:
+            # the reference's plasma client mmap access): a same-machine
+            # caller gets the shm dir of a node holding the object and
+            # reads the arena directly (cpp/ray_tpu_client.cc GetLocalShm)
+            mid, oid_bin = args
+            oid = ObjectID(oid_bin)
+            for nid in list(self._object_locations.get(oid) or ()):
+                node = self.nodes.get(nid)
+                if (
+                    node is not None
+                    and node.alive
+                    and node.host_id == mid
+                    and node.shm_dir
+                ):
+                    return node.shm_dir
+            # head-store objects: the head's own node entry
+            head = self.nodes.get(self._node.head_node_id)
+            if (
+                head is not None
+                and head.host_id == mid
+                and head.shm_dir
+                and self._node.store_client is not None
+                and self._node.store_client.contains(oid)
+            ):
+                return head.shm_dir
+            return None
         if op == "pubsub_sync":
             # loop-ordered no-op: a subscriber's barrier that its
             # pubsub_sub (same channel: conn recv order / loop queue) has

@@ -56,6 +56,32 @@ int main(int argc, char** argv) {
   }
   printf("OK put_get\n");
 
+  // zero-copy local data plane: a 1 MiB payload lands in the head's shm
+  // arena; GetLocalShm maps it and reads without a socket round trip
+  {
+    std::string big(1 << 20, '\0');
+    for (size_t i = 0; i < big.size(); i++) big[i] = char(i * 131 % 251);
+    std::string big_oid;
+    if (!client.Put(ray_tpu::PyValue::Bytes(big), &big_oid, &err)) {
+      fprintf(stderr, "big put failed: %s\n", err.c_str());
+      return 1;
+    }
+    ray_tpu::PyValue local;
+    if (client.GetLocal(big_oid, &local, &err)) {
+      if (local.kind != ray_tpu::PyValue::Kind::kBytes || local.s != big) {
+        fprintf(stderr, "shm_get mismatch (kind=%d size=%zu)\n",
+                int(local.kind), local.s.size());
+        return 1;
+      }
+      printf("OK shm_get %zu bytes\n", local.s.size());
+    } else if (err.empty()) {
+      printf("SKIP shm_get (no same-machine copy)\n");
+    } else {
+      fprintf(stderr, "shm_get failed: %s\n", err.c_str());
+      return 1;
+    }
+  }
+
   // named-actor call (the harness registers "cpp_demo" with method add)
   std::string result_oid;
   std::vector<ray_tpu::PyValue> args{ray_tpu::PyValue::Int(40),

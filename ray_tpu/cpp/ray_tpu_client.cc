@@ -25,9 +25,13 @@
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <random>
 #include <stdexcept>
 #include <thread>
+
+#include "../native/rt_store.h"
 
 namespace ray_tpu {
 
@@ -891,6 +895,42 @@ bool Client::Put(const PyValue& value, std::string* object_id,
   return true;
 }
 
+// Decode the store's flat object frame: <IQ> header (nbufs, pickle length),
+// pickle bytes, then 64-byte-aligned out-of-band buffers (rejected here —
+// the mini unpickler has no buffer protocol). Shared by Get and GetLocal.
+static bool DecodeFrame(const std::string& blob, PyValue* out,
+                        std::string* error) {
+  if (blob.size() < 12) {
+    *error = "malformed object frame";
+    return false;
+  }
+  uint32_t nbufs = 0;
+  for (int i = 0; i < 4; i++) nbufs |= uint32_t(uint8_t(blob[i])) << (8 * i);
+  uint64_t plen = 0;
+  for (int i = 0; i < 8; i++)
+    plen |= uint64_t(uint8_t(blob[4 + i])) << (8 * i);
+  if (nbufs != 0) {
+    *error = "object has out-of-band buffers (numpy); unsupported in the "
+             "C++ frontend";
+    return false;
+  }
+  if (blob.size() < 12 + plen) {
+    *error = "malformed object frame";
+    return false;
+  }
+  // named lvalue: Unpickler keeps a reference to its input, so a temporary
+  // here would dangle for the whole Load()
+  std::string pickled = blob.substr(12, plen);
+  try {
+    Unpickler u(pickled);
+    *out = u.Load();
+  } catch (const std::exception& e) {
+    *error = std::string("object unpickle failed: ") + e.what();
+    return false;
+  }
+  return true;
+}
+
 bool Client::Get(const std::string& object_id, double timeout_s, PyValue* out,
                  std::string* error) {
   auto deadline = std::chrono::steady_clock::now() +
@@ -915,29 +955,7 @@ bool Client::Get(const std::string& object_id, double timeout_s, PyValue* out,
         }
         return false;
       }
-      if (blob.size() < 12) {
-        *error = "malformed object blob";
-        return false;
-      }
-      uint32_t nbufs = 0;
-      for (int i = 0; i < 4; i++) nbufs |= uint32_t(uint8_t(blob[i])) << (8 * i);
-      uint64_t plen = 0;
-      for (int i = 0; i < 8; i++)
-        plen |= uint64_t(uint8_t(blob[4 + i])) << (8 * i);
-      if (nbufs != 0) {
-        *error = "object has out-of-band buffers (numpy); unsupported in the "
-                 "C++ frontend";
-        return false;
-      }
-      std::string pickled = blob.substr(12, plen);
-      try {
-        Unpickler u(pickled);
-        *out = u.Load();
-      } catch (const std::exception& e) {
-        *error = std::string("object unpickle failed: ") + e.what();
-        return false;
-      }
-      return true;
+      return DecodeFrame(blob, out, error);
     }
     if (std::chrono::steady_clock::now() > deadline) {
       *error = "get timed out";
@@ -945,6 +963,100 @@ bool Client::Get(const std::string& object_id, double timeout_s, PyValue* out,
     }
     std::this_thread::sleep_for(std::chrono::milliseconds(20));
   }
+}
+
+// ---- zero-copy local data plane ------------------------------------------
+
+static std::string LocalMachineId() {
+  // must byte-match ray_tpu._private.object_transfer.machine_id():
+  // "{boot_id}:{hostname}"
+  std::string boot;
+  FILE* f = fopen("/proc/sys/kernel/random/boot_id", "r");
+  if (f) {
+    char buf[128];
+    if (fgets(buf, sizeof(buf), f)) {
+      boot = buf;
+      while (!boot.empty() && (boot.back() == '\n' || boot.back() == '\r'))
+        boot.pop_back();
+    }
+    fclose(f);
+  }
+  char host[256] = {0};
+  gethostname(host, sizeof(host) - 1);
+  return boot + ":" + host;
+}
+
+bool Client::GetLocalShm(const std::string& object_id, std::string* blob,
+                         std::string* error) {
+  error->clear();
+  PyValue reply;
+  std::vector<PyValue> args{PyValue::Str(LocalMachineId()),
+                            PyValue::Bytes(object_id)};
+  if (!Rpc("object_shm_ref", args, &reply, error)) return false;
+  if (reply.kind != PyValue::Kind::kStr || reply.s.empty()) {
+    return false;  // no same-machine copy: caller falls back to Get
+  }
+  const std::string arena_path = reply.s + "/arena";
+  void* handle = nullptr;
+  {
+    static std::mutex arenas_mu;
+    static std::map<std::string, void*> arenas;  // attach once per arena
+    std::lock_guard<std::mutex> g(arenas_mu);
+    auto it = arenas.find(arena_path);
+    if (it != arenas.end()) {
+      handle = it->second;
+    } else {
+      handle = rt_store_open(arena_path.c_str(), 0, 0, /*create=*/0);
+      if (handle) arenas[arena_path] = handle;
+    }
+  }
+  if (handle) {
+    uint64_t size = 0;
+    uint64_t off = rt_store_get(
+        handle, reinterpret_cast<const uint8_t*>(object_id.data()), &size);
+    if (off) {
+      const char* base = static_cast<const char*>(rt_store_base(handle));
+      blob->assign(base + off, size);  // pinned for exactly this copy
+      rt_store_release(handle,
+                       reinterpret_cast<const uint8_t*>(object_id.data()));
+      return true;
+    }
+  }
+  // not in the arena: objects too large for it (or arena-full puts) live in
+  // the file-per-object fallback as <shm_dir>/<hex>.obj — 8-byte LE size,
+  // payload at offset 16 (mirrors read_peer_pinned, object_transfer.py)
+  static const char* kHex = "0123456789abcdef";
+  std::string hex;
+  hex.reserve(object_id.size() * 2);
+  for (unsigned char c : object_id) {
+    hex += kHex[c >> 4];
+    hex += kHex[c & 15];
+  }
+  const std::string obj_path = reply.s + "/" + hex + ".obj";
+  FILE* f = fopen(obj_path.c_str(), "rb");
+  if (!f) return false;  // evicted/spilled since the location answer
+  uint8_t hdr[16];
+  if (fread(hdr, 1, 16, f) != 16) {
+    fclose(f);
+    return false;
+  }
+  uint64_t fsize = 0;
+  for (int i = 0; i < 8; i++) fsize |= uint64_t(hdr[i]) << (8 * i);
+  blob->resize(fsize);
+  size_t got = fsize ? fread(&(*blob)[0], 1, fsize, f) : 0;
+  fclose(f);
+  if (got != fsize) {
+    blob->clear();
+    return false;
+  }
+  return true;
+}
+
+bool Client::GetLocal(const std::string& object_id, PyValue* out,
+                      std::string* error) {
+  std::string blob;
+  if (!GetLocalShm(object_id, &blob, error)) return false;
+  return DecodeFrame(blob, out, error);
 }
 
 bool Client::CallActor(const std::string& name, const std::string& method,

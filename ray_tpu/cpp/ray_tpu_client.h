@@ -68,6 +68,22 @@ class Client {
   bool Get(const std::string& object_id, double timeout_s, PyValue* out,
            std::string* error);
 
+  // Zero-copy local data plane (parity role: plasma client mmap access):
+  // when this process runs on the SAME MACHINE as a node holding the
+  // object, read the serialized blob straight out of that node's shm
+  // arena — this client links the node's own C++ store (rt_store.h), so
+  // the read is one memcpy from mapped memory, no socket, no head relay.
+  // Returns false with an empty *error when no same-machine sealed copy
+  // exists (callers fall back to Get).
+  bool GetLocalShm(const std::string& object_id, std::string* blob,
+                   std::string* error);
+
+  // GetLocalShm + flat-frame decode (the store's <IQ> header + pickle +
+  // 64-byte-aligned raw buffers). Values without out-of-band buffers
+  // decode fully; buffer-carrying values (numpy) are rejected like Get.
+  bool GetLocal(const std::string& object_id, PyValue* out,
+                std::string* error);
+
   // Invoke `method` on the actor registered under `name`; returns the result
   // object id (fetch it with Get).
   bool CallActor(const std::string& name, const std::string& method,

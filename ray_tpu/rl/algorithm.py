@@ -154,17 +154,16 @@ class Algorithm:
         from ray_tpu.rl.env_runner import _build_pipeline
 
         # use the TRAINED connector state (a NormalizeObservations filter's
-        # running mean/std lives in the training runners), snapshotted so
-        # evaluation does not mutate it; fall back to a fresh pipeline only
-        # when no local runner exists
+        # running mean/std lives in the training runners — local OR remote),
+        # loaded into a private pipeline so evaluation does not mutate it
+        pipe = _build_pipeline(
+            getattr(self.config, "env_to_module_connector", None)
+        )
         runners = getattr(self, "runners", None)
-        trained = getattr(runners, "local", None) if runners is not None else None
-        if trained is not None and getattr(trained, "connectors", None) is not None:
-            pipe = copy.deepcopy(trained.connectors)
-        else:
-            pipe = _build_pipeline(
-                getattr(self.config, "env_to_module_connector", None)
-            )
+        if pipe is not None and runners is not None:
+            state = getattr(runners, "connector_state", lambda: None)()
+            if state is not None:
+                pipe.set_state(copy.deepcopy(state))
         returns = []
         lengths = []
         for ep in range(num_episodes):

@@ -66,6 +66,11 @@ class EnvRunner:
         self._ep_return = np.zeros(num_envs)
         self._completed: List[float] = []
 
+    def get_connector_state(self):
+        """Trained connector-pipeline state (normalize stats etc.) for
+        evaluation-time reuse; None when no pipeline is configured."""
+        return self.connectors.get_state() if self.connectors else None
+
     def sample(self, params) -> Dict[str, np.ndarray]:
         jax = self._jax
         T, N = self.rollout_len, self.vec.n
@@ -157,6 +162,23 @@ class EnvRunnerGroup:
 
     def num_healthy(self) -> int:
         return 1 if self.local is not None else len(self.remote)
+
+    def connector_state(self):
+        """The trained env-to-module connector state, wherever the runners
+        live: the local runner's pipeline state, or the first healthy
+        remote runner's (remote runners see the same stream statistics)."""
+        if self.local is not None:
+            return (
+                self.local.connectors.get_state()
+                if self.local.connectors
+                else None
+            )
+        for r in list(self.remote):
+            try:
+                return ray_tpu.get(r.get_connector_state.remote(), timeout=60)
+            except Exception:
+                continue
+        return None
 
     def restore(self, min_runners: Optional[int] = None) -> int:
         """Replace dead runners up to the original target; returns how many

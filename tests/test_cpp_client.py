@@ -56,6 +56,9 @@ def test_cpp_client_end_to_end(cpp_demo_binary):
         assert "OK connect" in out
         assert "OK cluster_resources" in out
         assert "OK put_get" in out
+        # zero-copy shm data plane: the demo runs on the head's machine, so
+        # the 1MiB payload MUST come back via the arena read, not a SKIP
+        assert "OK shm_get 1048576 bytes" in out, out
         assert "OK call_actor 42" in out
         assert "OK memo_roundtrip" in out
         assert "OK done" in out
