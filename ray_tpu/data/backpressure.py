@@ -14,6 +14,7 @@ it is called per stage as ``factory(stats)`` → policy.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -27,6 +28,8 @@ class StageStats:
         self.name = name
         self.submitted = 0
         self.consumed = 0
+        self.started_at = time.monotonic()
+        self.last_consumed_at = self.started_at
         self.pending: deque = deque()
         self._size_cache: Dict = {}
         # running mean of materialized block sizes: the memory policy uses
@@ -105,7 +108,23 @@ class StageStats:
             "consumed": self.consumed,
             "inflight": self.inflight,
             "ready_bytes": self.ready_bytes(),
+            "wall_s": round(self.last_consumed_at - self.started_at, 4),
         }
+
+    def render(self) -> str:
+        """One human line for Dataset.stats() (parity: the reference's
+        per-operator stats summary)."""
+        wall = self.last_consumed_at - self.started_at
+        avg = (
+            f", avg_block={int(self.avg_block_bytes):,}B"
+            if self.avg_block_bytes
+            else ""
+        )
+        rate = f", {self.consumed / wall:.1f} blocks/s" if wall > 1e-6 else ""
+        return (
+            f"{self.name}: {self.consumed} blocks in {wall:.2f}s"
+            f"{rate}{avg}"
+        )
 
 
 class BackpressurePolicy:

@@ -503,8 +503,10 @@ class Dataset:
         ):
             yield from self._block_refs
             return
+        self._exec_stats = []
         yield from iter_stage_refs(
-            self._block_refs, self._stages, self._owned_actors
+            self._block_refs, self._stages, self._owned_actors,
+            collector=self._exec_stats,
         )
 
     def _iter_exec_blocks(self) -> Iterator[Batch]:
@@ -696,10 +698,19 @@ class Dataset:
         return len(self._block_refs)
 
     def stats(self) -> str:
-        return (
+        """Plan summary + per-stage metrics of THIS dataset's most recent
+        execution (parity: ``Dataset.stats()``'s per-operator breakdown —
+        block counts, wall time, throughput, mean block size)."""
+        lines = [
             f"Dataset(blocks={len(self._block_refs)}, "
             f"stages={len(self._stages)})"
-        )
+        ]
+        own = getattr(self, "_exec_stats", None)
+        if own:
+            lines.append("Last execution:")
+            for st in own[-8:]:
+                lines.append("  " + st.render())
+        return "\n".join(lines)
 
     def __repr__(self):
         return self.stats()

@@ -24,6 +24,7 @@ as ONE task per block), ``ActorMapStage`` = ActorPoolMapOperator,
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, List, Optional, Tuple
@@ -66,7 +67,8 @@ def _window() -> int:
     return max(1, DataContext.get_current().max_inflight_blocks)
 
 
-def _windowed(submitted: Iterator, window: int, name: str = "stage") -> Iterator:
+def _windowed(submitted: Iterator, window: int, name: str = "stage",
+              collector: Optional[List] = None) -> Iterator:
     """The backpressure core shared by every stage: pull (and thereby
     submit) ahead of the consumer while the POLICY CHAIN allows, release in
     FIFO order (block order is always preserved). The fixed window is one
@@ -78,6 +80,8 @@ def _windowed(submitted: Iterator, window: int, name: str = "stage") -> Iterator
     stats = bp.StageStats(name)
     policies = bp.build_policies(stats, window)
     bp.track_stats(stats)
+    if collector is not None:
+        collector.append(stats)
     pending = stats.pending
     exhausted = False
     while True:
@@ -103,6 +107,7 @@ def _windowed(submitted: Iterator, window: int, name: str = "stage") -> Iterator
         ref = pending.popleft()
         stats._size_cache.pop(ref.id(), None)
         stats.consumed += 1
+        stats.last_consumed_at = time.monotonic()
         yield ref
 
 
@@ -113,7 +118,7 @@ class SourceStage:
     def __init__(self, items: List):
         self.items = items
 
-    def stream(self) -> Iterator:
+    def stream(self, collector: Optional[List] = None) -> Iterator:
         return _windowed(
             (
                 item.submit() if isinstance(item, ReadTask) else item
@@ -121,6 +126,7 @@ class SourceStage:
             ),
             _window(),
             name="source",
+            collector=collector,
         )
 
 
@@ -138,13 +144,14 @@ class TaskMapStage:
     def fused(self, more_ops: List) -> "TaskMapStage":
         return TaskMapStage(self.ops + list(more_ops))
 
-    def stream(self, upstream: Iterator) -> Iterator:
+    def stream(self, upstream: Iterator, collector: Optional[List] = None) -> Iterator:
         from ray_tpu.data.dataset import _exec_block
 
         return _windowed(
             (_exec_block.remote(ref, self.ops) for ref in upstream),
             _window(),
             name=f"map[{len(self.ops)} ops]",
+            collector=collector,
         )
 
 
@@ -192,7 +199,8 @@ class ActorMapStage:
                 ready, rest = _rt.wait(lst, num_returns=len(lst), timeout=0)
                 lst[:] = rest
 
-    def stream(self, upstream: Iterator, owned_actors: List) -> Iterator:
+    def stream(self, upstream: Iterator, owned_actors: List,
+               collector: Optional[List] = None) -> Iterator:
         workers = self._pool()
         # pin on the executing dataset so handle-count reaping cannot kill
         # the pool before its output blocks are consumed
@@ -220,7 +228,8 @@ class ActorMapStage:
                 yield out
 
         return _windowed(
-            submitted(), _window() * self.max_size, name="actor_map"
+            submitted(), _window() * self.max_size, name="actor_map",
+            collector=collector,
         )
 
 
@@ -285,17 +294,22 @@ class RebatchStage:
             yield ray_tpu.put(concat_blocks(pieces))
 
 
-def iter_stage_refs(sources: List, stages: List, owned_actors: List) -> Iterator:
+def iter_stage_refs(sources: List, stages: List, owned_actors: List,
+                    collector: Optional[List] = None) -> Iterator:
     """Compose the stage generators into one lazily-driven pipeline, after
     the logical optimizer has rewritten the plan (projection algebra +
-    pushdown into column-pruning reads)."""
+    pushdown into column-pruning reads). ``collector`` (a list) receives
+    each stage's StageStats so the owning Dataset can report ITS OWN
+    execution metrics, not some other pipeline's."""
     from ray_tpu.data.optimizer import optimize_plan
 
     sources, stages = optimize_plan(sources, stages)
-    stream: Iterator = SourceStage(sources).stream()
+    stream: Iterator = SourceStage(sources).stream(collector)
     for stage in stages:
         if isinstance(stage, ActorMapStage):
-            stream = stage.stream(stream, owned_actors)
-        else:
+            stream = stage.stream(stream, owned_actors, collector)
+        elif isinstance(stage, RebatchStage):
             stream = stage.stream(stream)
+        else:
+            stream = stage.stream(stream, collector)
     return stream

@@ -159,6 +159,12 @@ class Algorithm:
         pipe = _build_pipeline(
             getattr(self.config, "env_to_module_connector", None)
         )
+        if pipe is not None:
+            # ALWAYS a private copy: when the config holds connector
+            # INSTANCES (not a factory), _build_pipeline wraps the same
+            # objects the training runners use — evaluation must not
+            # advance their statistics or resize their buffers
+            pipe = copy.deepcopy(pipe)
         runners = getattr(self, "runners", None)
         if pipe is not None and runners is not None:
             state = getattr(runners, "connector_state", lambda: None)()

@@ -168,11 +168,7 @@ class EnvRunnerGroup:
         live: the local runner's pipeline state, or the first healthy
         remote runner's (remote runners see the same stream statistics)."""
         if self.local is not None:
-            return (
-                self.local.connectors.get_state()
-                if self.local.connectors
-                else None
-            )
+            return self.local.get_connector_state()
         for r in list(self.remote):
             try:
                 return ray_tpu.get(r.get_connector_state.remote(), timeout=60)

@@ -28,10 +28,11 @@ from ray_tpu.tune.stopper import (
     TrialPlateauStopper,
 )
 from ray_tpu.tune.tune_config import TuneConfig
-from ray_tpu.tune.tuner import Tuner
+from ray_tpu.tune.tuner import Tuner, with_parameters
 
 __all__ = [
     "Tuner",
+    "with_parameters",
     "TuneConfig",
     "ResultGrid",
     "BayesOptSearch",

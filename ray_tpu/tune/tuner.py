@@ -371,3 +371,22 @@ class Tuner:
                 )
             )
         return ResultGrid(results)
+
+
+def with_parameters(trainable, **kwargs):
+    """Bind large constant objects to a trainable once (parity:
+    ``tune.with_parameters``): each object is stored in the cluster object
+    store a single time and every trial fetches it by reference, instead of
+    re-pickling the payload into each trial's function blob."""
+    import functools
+
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    @functools.wraps(trainable)
+    def inner(config):
+        resolved = {k: ray_tpu.get(r, timeout=600) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    return inner

@@ -6,7 +6,7 @@ output (``workflow_storage.py``) so a crashed/restarted run resumes from
 completed steps instead of recomputing them.
 """
 
-from ray_tpu.workflow.api import get_output, get_status, resume, run, run_async
+from ray_tpu.workflow.api import get_output, get_status, list_all, resume, run, run_async
 from ray_tpu.workflow.events import (
     EventListener,
     KVEventListener,
@@ -20,6 +20,7 @@ __all__ = [
     "run_async",
     "resume",
     "get_status",
+    "list_all",
     "get_output",
     "wait_for_event",
     "post_event",

@@ -171,3 +171,26 @@ def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
     d = _storage_dir(workflow_id, storage)
     with open(os.path.join(d, "output.pkl"), "rb") as fh:
         return pickle.load(fh)
+
+
+def list_all(
+    status_filter=None, *, storage: Optional[str] = None
+) -> "list[tuple[str, str]]":
+    """All stored workflows as (workflow_id, status) pairs (parity:
+    ``workflow.list_all``). ``status_filter``: a status string or
+    set/list of them to keep."""
+    root = storage or _DEFAULT_STORAGE
+    if isinstance(status_filter, str):
+        status_filter = {status_filter}
+    out = []
+    try:
+        entries = sorted(os.listdir(root))
+    except FileNotFoundError:
+        return out
+    for wid in entries:
+        if not os.path.isdir(os.path.join(root, wid)):
+            continue
+        st = get_status(wid, storage=storage)
+        if status_filter is None or st in status_filter:
+            out.append((wid, st))
+    return out

@@ -700,3 +700,14 @@ def test_optimizer_preserves_error_semantics(ray_start_regular, tmp_path):
     src4, _ = optimize_plan(ds4._block_refs, ds4._stages)
     assert src4[0].columns == ["a"]
     assert ds4.take_all() == [{"a": 1}]
+
+
+def test_stats_reports_stage_executions(ray_start_regular):
+    ds = rd.range(2000, num_blocks=8).map_batches(lambda b: b)
+    assert ds.count() == 2000
+    s = ds.stats()
+    assert "Last execution:" in s
+    assert "map[1 ops]" in s and "blocks in" in s, s
+    # an UNEXECUTED dataset must not show another pipeline's stages
+    fresh = rd.range(10)
+    assert "Last execution:" not in fresh.stats()

@@ -325,3 +325,27 @@ def test_hyperband_brackets_stop_bad_trials():
     early = {t for t, it in stopped_at.items() if it < 27}
     assert len(early) >= 3, f"halving never stopped weak trials early: {stopped_at}"
     assert all(int(t[1:]) < 11 for t in early)
+
+
+def test_with_parameters_shares_payload(ray_start_regular, tmp_path):
+    """tune.with_parameters: one object-store copy feeds every trial."""
+    import numpy as np
+
+    from ray_tpu import tune
+
+    payload = np.arange(20000.0)  # too big to want per-trial pickling
+
+    def train_fn(config, data=None):
+        from ray_tpu import train as _train
+
+        _train.report({"loss": float(config["x"] + data.sum() * 0)})
+
+    from ray_tpu.train import RunConfig as _RC
+
+    tuner = tune.Tuner(
+        tune.with_parameters(train_fn, data=payload),
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0])},
+        run_config=_RC(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert sorted(r.metrics["loss"] for r in grid) == [1.0, 2.0, 3.0]

@@ -290,3 +290,19 @@ def test_collective_send_recv_queues_per_key(ray_start_regular):
     )
     assert (first, second) == (1.0, 2.0)
     assert ack == 9.0
+
+
+def test_workflow_list_all(tmp_path, ray_start_regular):
+    import ray_tpu as _rt
+    from ray_tpu import workflow
+
+    @_rt.remote
+    def one():
+        return 1
+
+    storage = str(tmp_path / "wf")
+    workflow.run(one.bind(), workflow_id="wf_a", storage=storage)
+    workflow.run(one.bind(), workflow_id="wf_b", storage=storage)
+    rows = workflow.list_all(storage=storage)
+    assert rows == [("wf_a", "SUCCESSFUL"), ("wf_b", "SUCCESSFUL")]
+    assert workflow.list_all("FAILED", storage=storage) == []
