@@ -94,3 +94,41 @@ def test_no_replay_for_late_subscriber(ray_start):
         assert sub.get(timeout=10) == "after"
         with pytest.raises(queue.Empty):
             sub.get(timeout=0.3)
+
+
+def test_dead_subscriber_pruned_and_others_unaffected(ray_start):
+    import time
+
+    @ray_tpu.remote
+    class Listener:
+        def __init__(self):
+            self.sub = subscribe("zeta")
+
+        def ready(self):
+            return True
+
+        def next(self):
+            return self.sub.get(timeout=30)
+
+    a, b = Listener.remote(), Listener.remote()
+    ray_tpu.get([a.ready.remote(), b.ready.remote()], timeout=60)
+    ray_tpu.kill(a)
+    # deterministic: wait until the cluster actually sees a as dead
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(a.ready.remote(), timeout=5)
+        except Exception:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("killed actor never died")
+    # publish with one dead subscriber: must not error, and the survivor
+    # still receives (the head prunes the dead channel during fanout)
+    publish("zeta", "still-works")
+    assert ray_tpu.get(b.next.remote(), timeout=60) == "still-works"
+    from ray_tpu._private.worker import get_runtime
+
+    ch = get_runtime().scheduler._pubsub.get("zeta")
+    assert ch is not None and len(ch["workers"]) == 1, ch
+    ray_tpu.kill(b)

@@ -817,3 +817,48 @@ def test_websocket_fragmented_message_with_interleaved_ping(serve_cluster):
     finally:
         c.close()
     serve.delete("wsfrag")
+
+
+def test_websocket_replica_death_closes_session(serve_cluster):
+    """Killing the replica mid-session must surface as an abnormal close
+    (1011 close frame, or a dropped connection) to the client, not a hang."""
+    from ray_tpu.serve._proxy import ensure_proxy
+    from ray_tpu.serve._ws import WSClient
+    from ray_tpu.serve.api import _get_or_create_controller, get_app_handle
+
+    async def app(scope, receive, send):
+        await receive()
+        await send({"type": "websocket.accept"})
+        while True:
+            m = await receive()
+            if m["type"] == "websocket.disconnect":
+                return
+            await send({"type": "websocket.send", "text": "pong"})
+
+    @serve.deployment
+    @serve.ingress(app)
+    class WsK:
+        pass
+
+    serve.run(WsK.bind(), name="wskill", route_prefix="/wskill")
+    proxy = ensure_proxy(_get_or_create_controller(), "wskill", "/wskill")
+    host, port = ray_tpu.get(proxy.address.remote(), timeout=60)
+    c = WSClient(host, port, "/wskill")
+    try:
+        c.send_text("hi")
+        assert c.recv() == "pong"
+        # kill every replica out from under the session
+        handle = get_app_handle("wskill")
+        replicas = list(handle._replicas)
+        assert replicas, "no replicas to kill"
+        for r in replicas:
+            ray_tpu.kill(r)
+        try:
+            got = c.recv()
+        except ConnectionError:
+            got = ("close", 1006, "connection dropped")  # also abnormal
+        assert isinstance(got, tuple) and got[0] == "close", got
+        assert got[1] in (1006, 1011), got
+    finally:
+        c.close()
+        serve.delete("wskill")
