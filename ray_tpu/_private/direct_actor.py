@@ -148,13 +148,22 @@ class DirectActorClient:
         # pump wakeup pipe
         self._wake_r, self._wake_w = os.pipe()
         self._threads_started = False
+        self._threads_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
     def _ensure_threads(self):
+        # lock-free fast path: the flag is monotonic, so a stale read only
+        # falls through to the locked check
         if self._threads_started:
             return
-        self._threads_started = True
+        # guarded by its own lock, never self._lock: Thread.start() waits
+        # for the child's bootstrap, whose GC finalizers may need
+        # self._lock (see submit)
+        with self._threads_lock:
+            if self._threads_started:
+                return
+            self._threads_started = True
         threading.Thread(
             target=self._pump_loop, name="direct-actor-pump", daemon=True
         ).start()
@@ -332,13 +341,19 @@ class DirectActorClient:
         if self._closed:
             return False
         aid_bin = spec.actor_id.binary()
+        # thread startup must happen OUTSIDE self._lock: Thread.start()
+        # blocks until the new thread signals started, and if a GC cycle
+        # fires inside that thread's bootstrap, an ObjectRef.__del__ ->
+        # remove_refs there needs self._lock — holding it here while
+        # waiting on the thread is a deadlock (observed under pytest's
+        # full-suite GC pressure)
+        self._ensure_threads()
         with self._lock:
             ch = self._actors.get(aid_bin)
             if ch is None:
                 ch = _Channel(spec.actor_id)
                 self._actors[aid_bin] = ch
                 self._need_resolve.add(aid_bin)
-                self._ensure_threads()
                 self._resolve_cv.notify_all()
             if ch.mode == "relay":
                 return False
