@@ -168,9 +168,9 @@ class NativeStoreClient(StorePutMixin):
         self._external_miss.pop(oid, None)
         # reinstate locally so repeat gets don't re-download a hot object
         # from the backend every time (the external copy stays the durable
-        # one; delete() purges both). create/seal directly: put_bytes would
-        # early-return on contains() — the spill marker makes that true —
-        # and recurse back here
+        # one; delete() purges both). create/seal directly rather than
+        # put_bytes: its duplicate-race handler consults contains(), which
+        # the spill marker satisfies, and would recurse back here
         try:
             dest = self.create(oid, len(data))
             dest[:] = data
@@ -207,6 +207,11 @@ class NativeStoreClient(StorePutMixin):
                         self._fallback.seal(vid)
                     except ValueError:
                         pass  # concurrent spiller won the race
+                    except FileNotFoundError:
+                        # a concurrent delete() unlinked our in-flight
+                        # .building: the object is dying anyway — evicting
+                        # without a spill copy is exactly right
+                        pass
                     except StoreFullError:
                         return False  # disk full too: stop evicting
             finally:
@@ -299,8 +304,12 @@ class NativeStoreClient(StorePutMixin):
                     os.unlink(self._spill_marker(oid))
                 except OSError:
                     pass
-        if self._lib.rt_store_delete(self._h, oid.binary()) != 0:
-            self._fallback.delete(oid)
+        # purge EVERY tier unconditionally: a retried put of a spilled
+        # object can leave both an arena copy and a fallback file (create()
+        # arbitrates against the arena only), so a success here must not
+        # skip the fallback or the .obj file would leak
+        self._lib.rt_store_delete(self._h, oid.binary())
+        self._fallback.delete(oid)
 
     def usage_bytes(self) -> int:
         return int(self._lib.rt_store_used_bytes(self._h)) + self._fallback.usage_bytes()

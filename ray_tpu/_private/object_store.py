@@ -40,9 +40,10 @@ class StorePutMixin:
 
     def put_bytes(self, oid: ObjectID, data: bytes) -> None:
         # idempotent: a retried task re-stores the same deterministic return
-        # id; object values are immutable so the first sealed copy wins
-        if self.contains(oid):
-            return
+        # id; object values are immutable so the first sealed copy wins.
+        # create() is the atomic arbiter (raises ValueError on an existing
+        # sealed object), so no contains() pre-check — fresh oids are the
+        # overwhelming case and the pre-probe cost filesystem stats per put
         try:
             buf = self.create(oid, len(data))
         except ValueError:
@@ -58,13 +59,11 @@ class StorePutMixin:
         create()d buffer, ``plasma_store_provider.h:88``)."""
         pickled, buffers = serde.serialize(value)
         size = serde.serialized_size(pickled, buffers)
-        if self.contains(oid):
-            return
         try:
             buf = self.create(oid, size)
         except ValueError:
             if self.contains(oid):
-                return
+                return  # duplicate store (task retry): first copy wins
             raise
         serde.write_to(pickled, buffers, buf)
         self.seal(oid)
