@@ -25,15 +25,50 @@ _LOCK = threading.Lock()
 _BACKENDS: Dict[str, "StorageBackend"] = {}
 _FACTORIES: Dict[str, Callable[[], "StorageBackend"]] = {}
 
+# streaming read unit for read_into (one readinto syscall per chunk)
+_READ_CHUNK = 8 * 1024 * 1024
+
 
 class StorageBackend:
-    """Byte-level storage behind one URI scheme."""
+    """Byte-level storage behind one URI scheme.
+
+    ``write_stream`` / ``read_into`` are the large-object streaming surface
+    (spill writes sealed store buffers chunk-by-chunk; restore reads
+    straight into a store allocation). The base-class implementations fall
+    back to the whole-blob methods so third-party backends that only
+    implement ``write_bytes``/``read_bytes`` keep working.
+    """
 
     def write_bytes(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
     def read_bytes(self, path: str) -> Optional[bytes]:
         raise NotImplementedError
+
+    def write_stream(self, path: str, chunks) -> None:
+        """Write an iterable of bytes-like chunks as one object."""
+        # join accepts memoryviews directly: one flattening copy, not two
+        self.write_bytes(path, b"".join(chunks))
+
+    def read_into(self, path: str, make_dest) -> Optional[int]:
+        """Read an object into a caller-provided buffer.
+
+        ``make_dest(size) -> Optional[memoryview]`` allocates the
+        destination; a None return means the caller declined (e.g. lost a
+        create race) — the backend then skips the copy but still returns
+        the size. Returns the object size, or None when the object does not
+        exist. Callers must treat a None return after ``make_dest`` ran as
+        "destination possibly part-filled" and discard it.
+        """
+        data = self.read_bytes(path)
+        if data is None:
+            return None
+        dest = make_dest(len(data))
+        if dest is not None:
+            from ray_tpu._private import fastcopy
+
+            fastcopy.copy_into(dest, data)
+        return len(data)
 
     def exists(self, path: str) -> bool:
         raise NotImplementedError
@@ -61,6 +96,37 @@ class FileBackend(StorageBackend):
                 return fh.read()
         except OSError:
             return None
+
+    def write_stream(self, path: str, chunks) -> None:
+        # chunked writes straight from the caller's views (no join copy),
+        # same tmp+rename atomicity as write_bytes
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            for c in chunks:
+                fh.write(c)
+        os.replace(tmp, path)
+
+    def read_into(self, path: str, make_dest) -> Optional[int]:
+        try:
+            fh = open(path, "rb")
+        except OSError:
+            return None
+        with fh:
+            try:
+                size = os.fstat(fh.fileno()).st_size
+                dest = make_dest(size)
+                if dest is None:
+                    return size
+                off = 0
+                while off < size:
+                    n = fh.readinto(dest[off : min(off + _READ_CHUNK, size)])
+                    if not n:
+                        return None  # truncated under us: discard the fill
+                    off += n
+                return size
+            except OSError:
+                return None
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
@@ -173,6 +239,20 @@ def write_bytes(uri: str, data: bytes) -> None:
 def read_bytes(uri: str) -> Optional[bytes]:
     backend, path = resolve(uri)
     return backend.read_bytes(path)
+
+
+def write_stream(uri: str, chunks) -> None:
+    """Write an iterable of bytes-like chunks as one object (spill path:
+    streams sealed store buffers without staging a full copy)."""
+    backend, path = resolve(uri)
+    backend.write_stream(path, chunks)
+
+
+def read_into(uri: str, make_dest) -> Optional[int]:
+    """Read an object straight into ``make_dest(size)``'s buffer (restore
+    path); see :meth:`StorageBackend.read_into` for the contract."""
+    backend, path = resolve(uri)
+    return backend.read_into(path, make_dest)
 
 
 def exists(uri: str) -> bool:

@@ -12,32 +12,30 @@ import ctypes
 import os
 import threading
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, Optional, Set, Tuple
 
+from ray_tpu._private import fastcopy
+from ray_tpu._private.fastcopy import stage_timer
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError, StorePutMixin
 
 
 class _Pin:
-    """Buffer object over an arena payload holding one store pin.
+    """Holder of one store pin over an arena payload; released on GC.
 
-    Deserialized numpy views keep the exporting memoryview — and therefore
-    this object — alive; GC of the last view releases the pin, letting the
+    Deserialized numpy views keep the exporting buffer — and therefore this
+    object — alive; GC of the last view releases the pin, letting the
     store's deferred delete reclaim the block. This mirrors plasma's
     client-held object references (``plasma_store_provider.h:88``): memory is
     never reused under a live zero-copy view.
     """
 
-    __slots__ = ("_lib", "_h", "_id", "_arr")
+    __slots__ = ("_lib", "_h", "_id")
 
-    def __init__(self, lib, handle, id_bytes: bytes, base: int, off: int, size: int):
+    def __init__(self, lib, handle, id_bytes: bytes):
         self._lib = lib
         self._h = handle
         self._id = id_bytes
-        self._arr = (ctypes.c_char * size).from_address(base + off)
-
-    def __buffer__(self, flags):
-        return memoryview(self._arr).cast("B")
 
     def __del__(self):
         try:
@@ -46,7 +44,43 @@ class _Pin:
             pass
 
 
+# ctypes array subclasses keyed by payload size: a plain ``ctypes.c_char *
+# n`` instance can't carry the pin, and the ``__buffer__`` protocol (PEP
+# 688) only exists on Python 3.12+ — a subclass instance accepts the
+# attribute AND exports the buffer on every supported Python.
+_PIN_ARR_CLASSES: Dict[int, type] = {}
+_PIN_ARR_LOCK = threading.Lock()
+
+
+def pinned_view(lib, handle, id_bytes: bytes, base: int, off: int, size: int) -> memoryview:
+    """Read-only zero-copy view over an arena payload whose lifetime carries
+    the store pin taken by ``rt_store_get``: view (or anything deserialized
+    from it) GC'd → pin released → deferred delete may reclaim the block.
+
+    Read-only is the get-side aliasing contract: the arena mapping itself is
+    writable in every client, so without it a consumer mutating a
+    deserialized numpy array would corrupt the sealed shared copy."""
+    with _PIN_ARR_LOCK:
+        cls = _PIN_ARR_CLASSES.get(size)
+        if cls is None:
+            if len(_PIN_ARR_CLASSES) > 4096:  # unbounded size diversity guard
+                _PIN_ARR_CLASSES.clear()
+            cls = type("_PinnedArr", (ctypes.c_char * size,), {})
+            _PIN_ARR_CLASSES[size] = cls
+    try:
+        arr = cls.from_address(base + off)
+        arr._pin = _Pin(lib, handle, id_bytes)
+    except Exception:
+        lib.rt_store_release(handle, id_bytes)  # the get's pin must not leak
+        raise
+    return memoryview(arr).cast("B").toreadonly()
+
+
 class NativeStoreClient(StorePutMixin):
+    # negative external-miss cache entries re-probe after this long even if
+    # the marker file looks identical (see contains())
+    _EXTERNAL_MISS_TTL_S = 5.0
+
     def __init__(
         self,
         lib,
@@ -73,11 +107,18 @@ class NativeStoreClient(StorePutMixin):
         # oids whose spill marker points at a backend THIS process
         # definitively cannot read (e.g. another process's memory://):
         # fail-fast locally without touching the shared marker. Keyed by
-        # the marker's mtime so a re-spill to a readable backend (marker
-        # rewritten) invalidates the negative entry.
-        self._external_miss: Dict[ObjectID, float] = {}
+        # the marker's (mtime_ns, inode, size) — the atomic tmp+rename that
+        # writes a marker always produces a fresh inode, so a re-spill is
+        # detected even when the rewritten marker has identical content and
+        # a same-granularity timestamp — plus a short TTL so a stale entry
+        # can never wedge waiters into spurious object-lost failures.
+        self._external_miss: Dict[ObjectID, Tuple[tuple, float]] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # arena prefault is lazy: kicked off by the first LARGE create so
+        # the many short-lived small-object sessions (tests, control planes)
+        # never pay background fault work they don't need
+        self._prefault_started = False
 
     # -- helpers -----------------------------------------------------------
 
@@ -85,9 +126,76 @@ class NativeStoreClient(StorePutMixin):
         buf = (ctypes.c_char * size).from_address(self._base + offset)
         return memoryview(buf).cast("B")
 
+    def _prefault_async(self) -> None:
+        """Allocation-time buffer prep: fault the arena's free space in from
+        a background thread (one bounded slab per lock hold) so large-object
+        copies hit resident pages instead of serializing first-touch faults
+        inside the copy loop (measured here: an unprepped 128 MiB first put
+        runs ~40× slower than a prepped one). The cursor lives in the shared
+        arena header, so the work happens once per arena no matter how many
+        clients open it. Budgeted against the shm filesystem's free space;
+        kill switch via env."""
+        with self._lock:
+            if self._prefault_started:
+                return  # lost the race: exactly one prefault thread per client
+            self._prefault_started = True
+        if os.environ.get("RAY_TPU_DISABLE_PREFAULT"):
+            return
+        if not hasattr(self._lib, "rt_store_prefault"):
+            return  # stale .so without the export
+        try:
+            st = os.statvfs(self._shm_dir)
+            free = st.f_bavail * st.f_frsize
+        except OSError:
+            return
+        margin = max(64 * 1024 * 1024, (st.f_blocks * st.f_frsize) // 20)
+        # default: the whole arena (it is declared capacity — a large-object
+        # workload WILL touch it, and faulting lazily inside the copy loop
+        # is the slowest possible place to do it), still bounded by half the
+        # shm filesystem's free space so co-tenant stores keep headroom
+        budget = min(self._capacity, max(0, (free - margin) // 2))
+        try:
+            cap_mb = int(os.environ.get("RAY_TPU_ARENA_PREFAULT_MB", ""))
+            budget = min(budget, cap_mb * 1024 * 1024)
+        except ValueError:
+            pass
+        if budget <= 0:
+            return
+
+        def run():
+            # 2 MiB slabs: on hosts where fresh tmpfs pages fault slowly the
+            # arena lock is held ~tens of ms per slab — small slabs keep
+            # concurrent create/seal latency bounded
+            step = 2 * 1024 * 1024
+            done = 0
+            while done < budget and not self._closed:
+                try:
+                    n = self._lib.rt_store_prefault(self._h, min(step, budget - done))
+                except Exception:
+                    return
+                if not n:
+                    return  # cursor reached the end (or nothing free)
+                done += n
+                # brief sleep so concurrent create/seal can win the arena
+                # lock — a tight loop re-grabs it before they wake (first
+                # puts measured 100x slower under that starvation)
+                time.sleep(0.0002)
+
+        threading.Thread(target=run, daemon=True, name="arena-prefault").start()
+
+    def _marker_key(self, oid: ObjectID) -> Optional[tuple]:
+        """Identity of the current spill marker file (None = no marker)."""
+        try:
+            st = os.stat(self._spill_marker(oid))
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_ino, st.st_size)
+
     # -- ObjectStoreClient interface --------------------------------------
 
     def create(self, oid: ObjectID, size: int) -> memoryview:
+        if size >= fastcopy.LARGE_OBJECT_MIN and not self._prefault_started:
+            self._prefault_async()
         err = ctypes.c_int(0)
         off = self._lib.rt_store_create(self._h, oid.binary(), size, ctypes.byref(err))
         if not off and err.value == 2:
@@ -123,7 +231,11 @@ class NativeStoreClient(StorePutMixin):
 
         uri = storage.join(self._spill_uri, f"{oid.hex()}.obj")
         try:
-            storage.write_bytes(uri, bytes(src))
+            # stream the sealed buffer in chunks straight from the arena
+            # view — the old ``bytes(src)`` staged a full second copy of the
+            # object in heap memory before a single byte hit the backend
+            with stage_timer("store.spill.write", src.nbytes):
+                storage.write_stream(uri, fastcopy.iter_chunks(src))
             # per-process tmp name: same-node clients can race on the same
             # LRU victim, and losing that race must not fail the caller's
             # put (the old local-spill path had the same tolerance)
@@ -142,38 +254,87 @@ class NativeStoreClient(StorePutMixin):
         except OSError:
             return None
 
+    def _note_external_miss(self, oid: ObjectID) -> None:
+        # definitive miss (backends raise on transport errors, None means
+        # not-found): remember it in a PROCESS-LOCAL negative cache so this
+        # process's contains() flips False and its waiters fail fast instead
+        # of polling to the object-lost timeout. Happens when the backend is
+        # process-local (memory://) but the marker sits in the shared shm
+        # dir — the marker itself must survive: it may be another process's
+        # only pointer to a copy that IS restorable there, so unlinking it
+        # would turn a local miss into cluster-wide data loss.
+        key = self._marker_key(oid) or (0, 0, 0)
+        self._external_miss[oid] = (key, time.monotonic())
+
     def _restore_external(self, oid: ObjectID) -> Optional[memoryview]:
         uri = self._external_spilled_uri(oid)
         if uri is None:
             return None
         from ray_tpu._private import external_storage as storage
 
-        data = storage.read_bytes(uri)
-        if data is None:
-            # definitive miss (read_bytes raises on transport errors, None
-            # means not-found): remember it in a PROCESS-LOCAL negative
-            # cache so this process's contains() flips False and its
-            # waiters fail fast instead of polling to the object-lost
-            # timeout. Happens when the backend is process-local
-            # (memory://) but the marker sits in the shared shm dir — the
-            # marker itself must survive: it may be another process's only
-            # pointer to a copy that IS restorable there, so unlinking it
-            # would turn a local miss into cluster-wide data loss.
-            try:
-                mtime = os.stat(self._spill_marker(oid)).st_mtime
-            except OSError:
-                mtime = 0.0
-            self._external_miss[oid] = mtime
-            return None
-        self._external_miss.pop(oid, None)
         # reinstate locally so repeat gets don't re-download a hot object
         # from the backend every time (the external copy stays the durable
-        # one; delete() purges both). create/seal directly rather than
-        # put_bytes: its duplicate-race handler consults contains(), which
-        # the spill marker satisfies, and would recurse back here
+        # one; delete() purges both). Preferred path: the backend streams
+        # chunks straight into the store's create() buffer — no staging
+        # bytes object. When create() loses a race or the store is full, the
+        # same single download lands in a heap buffer instead (never a
+        # second fetch). create/seal directly rather than put_bytes: its
+        # duplicate-race handler consults contains(), which the spill
+        # marker satisfies, and would recurse back here.
+        created = False
+        heap_buf: Optional[bytearray] = None
+
+        def make_dest(size: int) -> Optional[memoryview]:
+            nonlocal created, heap_buf
+            try:
+                view = self.create(oid, size)
+                created = True
+                return view
+            except Exception:
+                heap_buf = bytearray(size)
+                return memoryview(heap_buf)
+
+        def _abort_created():
+            nonlocal created
+            if created:
+                try:
+                    self.abort(oid)  # possibly part-filled: never seal it
+                except Exception:
+                    pass
+                created = False
+
+        try:
+            with stage_timer("store.restore.read"):
+                n = storage.read_into(uri, make_dest)
+        except Exception:
+            # transport error, NOT a definitive miss: the durable copy may
+            # be intact — propagate (the old read_bytes path did the same)
+            # rather than poisoning the negative cache with a false loss
+            _abort_created()
+            raise
+        if n is None:
+            _abort_created()
+            heap_buf = None  # possibly part-filled: discard
+        if created:
+            try:
+                self.seal(oid)
+                mv = self.get(oid, timeout=0)
+                if mv is not None:
+                    self._external_miss.pop(oid, None)
+                    return mv
+            except Exception:
+                _abort_created()
+        # fallback: the single download's heap copy (create race lost or
+        # store full), or — only when the streaming read said not-found /
+        # truncated — one plain bytes re-read to decide miss vs. data
+        data = heap_buf if heap_buf is not None else storage.read_bytes(uri)
+        if data is None:
+            self._note_external_miss(oid)
+            return None
+        self._external_miss.pop(oid, None)
         try:
             dest = self.create(oid, len(data))
-            dest[:] = data
+            fastcopy.copy_into(dest, data)
             self.seal(oid)
             mv = self.get(oid, timeout=0)
             if mv is not None:
@@ -203,7 +364,8 @@ class NativeStoreClient(StorePutMixin):
                 elif not self._fallback.contains(vid):
                     try:
                         dest = self._fallback.create(vid, size.value)
-                        dest[:] = src
+                        with stage_timer("store.spill.copy", size.value):
+                            fastcopy.copy_into(dest, src)
                         self._fallback.seal(vid)
                     except ValueError:
                         pass  # concurrent spiller won the race
@@ -249,14 +411,17 @@ class NativeStoreClient(StorePutMixin):
                 if os.path.exists(self._spill_marker(oid)):
                     return True
             else:
-                # negative entry: honor it only while the marker is
-                # unchanged — a rewrite (re-spill) or removal invalidates
-                try:
-                    mtime = os.stat(self._spill_marker(oid)).st_mtime
-                except OSError:
+                # negative entry: honor it only while the marker identity
+                # (mtime_ns, inode, size) is unchanged AND the entry is
+                # fresh — a re-spill rewrites the marker via tmp+rename
+                # (new inode), and the TTL re-probes even a byte-identical
+                # marker so waiters can never wedge on a stale negative
+                key, stamp = cached
+                fresh = (time.monotonic() - stamp) < self._EXTERNAL_MISS_TTL_S
+                current = self._marker_key(oid)
+                if current is None:
                     self._external_miss.pop(oid, None)  # marker gone
-                    mtime = None
-                if mtime is not None and mtime != cached:
+                elif current != key or not fresh:
                     self._external_miss.pop(oid, None)
                     return True
         return self._fallback.contains(oid)
@@ -268,11 +433,12 @@ class NativeStoreClient(StorePutMixin):
             size = ctypes.c_uint64(0)
             off = self._lib.rt_store_get(self._h, oid.binary(), ctypes.byref(size))
             if off:
-                # rt_store_get took a pin; the _Pin object carries it and the
+                # rt_store_get took a pin; the pinned view carries it and the
                 # returned view (plus anything deserialized from it) keeps the
                 # pin alive — deletes defer until the last view is GC'd
-                pin = _Pin(self._lib, self._h, oid.binary(), self._base, off, size.value)
-                return memoryview(pin)
+                return pinned_view(
+                    self._lib, self._h, oid.binary(), self._base, off, size.value
+                )
             mv = self._fallback.get(oid, timeout=0)
             if mv is not None:
                 return mv

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ray_tpu._private import fastcopy
+from ray_tpu._private.fastcopy import stage_timer
 from ray_tpu._private.ids import ObjectID
 
 _HEADER = 16  # [u64 data_size][u64 flags]
@@ -36,7 +38,12 @@ class StoreFullError(Exception):
 
 
 class StorePutMixin:
-    """Shared idempotent put; both store clients implement create/seal/contains."""
+    """Shared idempotent put; both store clients implement create/seal/contains.
+
+    Every stage of the large-object pipeline (serialize → alloc → copy →
+    seal) is timed into the ``fastcopy`` stage registry, surfaced by the
+    scheduler's ``event_stats`` RPC — the put-bandwidth budget is
+    attributable per stage instead of one opaque number."""
 
     def put_bytes(self, oid: ObjectID, data: bytes) -> None:
         # idempotent: a retried task re-stores the same deterministic return
@@ -45,28 +52,35 @@ class StorePutMixin:
         # sealed object), so no contains() pre-check — fresh oids are the
         # overwhelming case and the pre-probe cost filesystem stats per put
         try:
-            buf = self.create(oid, len(data))
+            with stage_timer("store.put.alloc"):
+                buf = self.create(oid, len(data))
         except ValueError:
             if self.contains(oid):
                 return  # lost the race to a concurrent identical store
             raise  # a live creator owns it, or an unreclaimable orphan: loud
-        buf[:] = data
-        self.seal(oid)
+        with stage_timer("store.put.copy", len(data)):
+            fastcopy.copy_into(buf, data)
+        with stage_timer("store.put.seal"):
+            self.seal(oid)
 
     def put_serialized(self, oid: ObjectID, serde, value) -> None:
         """Serialize straight into the store buffer (one copy fewer than
         serialize-to-bytes + put_bytes; parity: plasma clients write into the
         create()d buffer, ``plasma_store_provider.h:88``)."""
-        pickled, buffers = serde.serialize(value)
-        size = serde.serialized_size(pickled, buffers)
+        with stage_timer("store.put.serialize"):
+            pickled, buffers = serde.serialize(value)
+            size = serde.serialized_size(pickled, buffers)
         try:
-            buf = self.create(oid, size)
+            with stage_timer("store.put.alloc"):
+                buf = self.create(oid, size)
         except ValueError:
             if self.contains(oid):
                 return  # duplicate store (task retry): first copy wins
             raise
-        serde.write_to(pickled, buffers, buf)
-        self.seal(oid)
+        with stage_timer("store.put.copy", size):
+            serde.write_to(pickled, buffers, buf)
+        with stage_timer("store.put.seal"):
+            self.seal(oid)
 
 
 class ObjectStoreClient(StorePutMixin):
@@ -180,7 +194,15 @@ class ObjectStoreClient(StorePutMixin):
                     pass
                 return self.create(oid, size)
             raise ValueError(f"object {oid.hex()} already being created")
-        m = mmap.mmap(fd, total)
+        # allocation-time buffer prep: pages were reserved by fallocate, but
+        # PTEs still fault on first touch — for large objects, populate them
+        # in one syscall (and request huge pages where supported) so faults
+        # don't serialize inside the copy loop
+        if total >= fastcopy.LARGE_OBJECT_MIN and hasattr(mmap, "MAP_POPULATE"):
+            m = mmap.mmap(fd, total, flags=mmap.MAP_SHARED | mmap.MAP_POPULATE)
+        else:
+            m = mmap.mmap(fd, total)
+        fastcopy.prepare_map(m, total)
         os.close(fd)
         mv = memoryview(m)
         mv[:8] = size.to_bytes(8, "little")
@@ -230,13 +252,20 @@ class ObjectStoreClient(StorePutMixin):
         return self._find_sealed(oid) is not None
 
     def get(self, oid: ObjectID, timeout: Optional[float] = 0) -> Optional[memoryview]:
-        """Zero-copy read view of a sealed object; None on timeout."""
+        """Zero-copy READ-ONLY view of a sealed object; None on timeout.
+
+        Keep-alive contract: the returned view (and anything deserialized
+        from it — numpy/arrow buffers reference their exporting view) pins
+        the underlying mapping via this client's ``_maps`` table until
+        ``release``/``delete``; sealed bytes are immutable, so every view is
+        read-only — a consumer mutating a deserialized array gets a loud
+        error instead of silently corrupting the shared copy."""
         with self._lock:
             entry = self._maps.get(oid)
             if entry is not None and not entry[2]:
                 m, mv, _ = entry
                 size = int.from_bytes(mv[:8], "little")
-                return mv[_HEADER : _HEADER + size]
+                return mv[_HEADER : _HEADER + size].toreadonly()
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 0.0001
         while True:

@@ -442,14 +442,13 @@ def read_peer_pinned(src_shm_dir: str, oid: ObjectID) -> Optional[memoryview]:
     lib, h, base = handle
     import ctypes
 
-    from ray_tpu._private.native_store import _Pin
+    from ray_tpu._private.native_store import pinned_view
 
     size = ctypes.c_uint64(0)
     off = lib.rt_store_get(h, oid.binary(), ctypes.byref(size))
     if not off:
         return None
-    pin = _Pin(lib, h, oid.binary(), base, off, size.value)
-    return memoryview(pin)
+    return pinned_view(lib, h, oid.binary(), base, off, size.value)
 
 
 def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
@@ -465,12 +464,15 @@ def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
         return True
 
     def copy_in(view: memoryview) -> bool:
+        from ray_tpu._private import fastcopy
+
         try:
             dest = store.create(oid, view.nbytes)
         except ValueError:
             return store.contains(oid)  # concurrent fetch owns/finished it
         try:
-            dest[:] = view
+            with fastcopy.stage_timer("store.fetch.shm_copy", view.nbytes):
+                fastcopy.copy_into(dest, view)
         except BaseException:
             store.abort(oid)
             raise

@@ -205,6 +205,10 @@ class NodeDaemon:
         self._lease_starting = 0
         self._lease_in_use.clear()
         self._instance_ledger = None  # rebuilt with the fresh worker fleet
+        # allocations recorded against the OLD ledger die with it: freeing a
+        # stale pre-reset record into the fresh ledger (via _free_head_devices
+        # or _prune_dead_head_accel) would double-book a chip
+        self._head_accel = {}
         self._lease_done_buf.clear()
         self._lease_started_buf.clear()
         self._lease_idle_since.clear()

@@ -491,6 +491,10 @@ class Scheduler:
         # are tracked here; single-channel (owner-only) oids free on zero.
         self._deferred_frees: collections.deque = collections.deque()
         self._cross_channel: set = set()
+        # oid -> the FIRST channel (worker id, or None for the driver) its
+        # ref ops arrived on; a second channel's traffic promotes the oid
+        # to _cross_channel. Entries die with the object (_free_object).
+        self._ref_channel: Dict[ObjectID, Any] = {}
         # general pubsub channels (parity: GCS pubsub, src/ray/pubsub/):
         # channel -> {"workers": set[wid], "local": set[SimpleQueue]};
         # publishes fan out at the head — worker subscribers get a pushed
@@ -2560,7 +2564,8 @@ class Scheduler:
         for q in ch["local"]:
             q.put(blob)
         dead = []
-        for wid in ch["workers"]:
+        # snapshot: _on_worker_death prunes the dead wid from this very set
+        for wid in list(ch["workers"]):
             w = self.workers.get(wid)
             if w is None or w.state == "dead":
                 dead.append(wid)
@@ -2646,6 +2651,17 @@ class Scheduler:
         except OSError:
             pass
         self._release_resources(w)
+        # prune the dead worker from EVERY pubsub channel now (and drop
+        # channels it emptied) instead of lazily on the next publish — an
+        # idle channel would otherwise hold dead worker ids (and its own
+        # dict entry) forever
+        for channel in [
+            ch for ch, rec in self._pubsub.items() if wid in rec["workers"]
+        ]:
+            rec = self._pubsub[channel]
+            rec["workers"].discard(wid)
+            if not rec["workers"] and not rec["local"]:
+                self._pubsub.pop(channel, None)
         # release the dead borrower's registered refs (parity: the owner
         # noticing borrower death in the reference's borrower protocol) —
         # without this every borrow held by a crashed worker leaks forever
@@ -3191,6 +3207,20 @@ class Scheduler:
                 k: {"count": int(c), "total_s": t, "mean_us": (t / c * 1e6 if c else 0.0)}
                 for k, (c, t) in self._event_stats.items()
             }
+            # large-object data-path stages (serialize/alloc/copy/seal,
+            # spill/restore) from THIS process's store clients — the
+            # put-bandwidth budget becomes attributable per stage. Entries
+            # carry total bytes so GiB/s per stage falls out directly.
+            from ray_tpu._private import fastcopy as _fastcopy
+
+            for k, (c, t, b) in _fastcopy.stage_stats().items():
+                out[k] = {
+                    "count": int(c),
+                    "total_s": t,
+                    "mean_us": (t / c * 1e6 if c else 0.0),
+                    "bytes": int(b),
+                    "gib_per_s": (b / t / 2**30 if t > 0 and b else 0.0),
+                }
             out["__loop__"] = {
                 "cpu_s": time.clock_gettime(time.CLOCK_THREAD_CPUTIME_ID),
                 "wall_s": time.monotonic() - self._loop_started_at,
@@ -3229,10 +3259,23 @@ class Scheduler:
         refs are released by ``_on_worker_death`` instead of leaking.
         """
         self._refop_count += 1
-        if holder is not None or op in (2, 3):
-            # ref traffic beyond the owner's own ordered channel: this oid's
-            # future zeros must ride the deferred-free grace window
+        if op in (2, 3):
+            # a transit token is by definition a second channel in flight
             self._cross_channel.add(oid)
+        elif oid not in self._cross_channel:
+            # Ops on ONE ordered channel (the owner's — a worker conn, or
+            # the driver's in-process queue) cannot race themselves: every
+            # add precedes its remove, so a zero is definitive and frees
+            # immediately. Only traffic from a SECOND channel (another
+            # worker borrowing, converging escalations) makes a transient
+            # zero possible and must ride the grace window. Keying on the
+            # FIRST channel seen — instead of "any worker at all" — is what
+            # lets a worker's own put/del churn free as fast as the
+            # driver's: the 2 s grace was capping every multi-client put
+            # loop at arena_capacity/grace_window bytes/s of throughput.
+            first = self._ref_channel.setdefault(oid, holder)
+            if first != holder:
+                self._cross_channel.add(oid)
         if op == -1:
             if holder is not None:
                 held = self._holder_refs.get(holder)
@@ -3322,6 +3365,7 @@ class Scheduler:
 
     def _free_object(self, oid: ObjectID):
         self._cross_channel.discard(oid)
+        self._ref_channel.pop(oid, None)
         self._xfer_waiting.pop(oid, None)
         if self._shm_xfer_failed:
             self._shm_xfer_failed = {

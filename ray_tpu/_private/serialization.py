@@ -100,7 +100,13 @@ class SerializationContext:
         return n
 
     def write_to(self, pickled: bytes, buffers: List[pickle.PickleBuffer], dest: memoryview) -> int:
-        """Write the flat blob into ``dest``; returns bytes written."""
+        """Write the flat blob into ``dest``; returns bytes written.
+
+        Out-of-band buffer payloads go through the parallel GIL-releasing
+        copy pool (``fastcopy.copy_into``) — for a multi-MiB numpy array
+        this is the entire put data volume."""
+        from ray_tpu._private import fastcopy
+
         raw = [memoryview(b).cast("B") for b in buffers]
         off = _HDR.size + 8 * len(raw)
         _HDR.pack_into(dest, 0, len(raw), len(pickled))
@@ -109,7 +115,7 @@ class SerializationContext:
         dest[off : off + len(pickled)] = pickled
         off = _align(off + len(pickled))
         for b in raw:
-            dest[off : off + b.nbytes] = b
+            fastcopy.copy_into(dest[off : off + b.nbytes], b)
             off = _align(off + b.nbytes)
         return off
 

@@ -946,8 +946,10 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
         _sys.stderr.write(f"BOOT enter {time.monotonic():.4f}\n")
     import ray_tpu._private.worker as worker_mod
+    from ray_tpu._private import fastcopy
     from ray_tpu._private.native_store import create_store_client
 
+    fastcopy.set_worker_mode()  # share copy cores with sibling workers
     config = pickle.loads(config_blob)
     worker_id = WorkerID(worker_id_bin)
     from ray_tpu._private import external_storage as _xstorage

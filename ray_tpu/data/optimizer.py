@@ -35,13 +35,23 @@ def _merge_pair(a: Tuple, b: Tuple) -> Optional[List[Tuple]]:
     ka, pa = a
     kb, pb = b
     if ka == "select" and kb == "select":
-        if set(pb) <= set(pa):
+        # merge only when both name the same column set: select(pa)
+        # validates EVERY pa column against the block, so collapsing a
+        # pb ⊂ pa pair to select(pb) would swallow the KeyError a missing
+        # pa-only column must raise at execution
+        if set(pb) == set(pa):
             return [("select", list(pb))]
-        return None  # pb references pruned columns: keep the runtime error
+        return None  # differing sets: keep the chain (and its errors)
     if ka == "drop" and kb == "drop":
         return [("drop", list(pa) + [c for c in pb if c not in pa])]
     if ka == "select" and kb == "drop":
-        return [("select", [c for c in pa if c not in pb])]
+        # drop ignores missing columns, so the pair's error behavior is
+        # exactly select(pa)'s; merging to select(pa − pb) would skip the
+        # missing-column check for a dropped pa column. Only a no-op drop
+        # (disjoint from the selection) is eliminable.
+        if not (set(pa) & set(pb)):
+            return [("select", list(pa))]
+        return None
     if ka == "drop" and kb == "select":
         if not (set(pb) & set(pa)):
             return [("select", list(pb))]

@@ -86,5 +86,8 @@ def load_native():
     lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
     lib.rt_store_lru_victim.restype = ctypes.c_int
     lib.rt_store_lru_victim.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)]
+    if hasattr(lib, "rt_store_prefault"):
+        lib.rt_store_prefault.restype = ctypes.c_uint64
+        lib.rt_store_prefault.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     _LIB = lib
     return _LIB

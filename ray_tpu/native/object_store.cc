@@ -27,7 +27,11 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5241595F54505553ULL;  // "RAY_TPUS"
+// Layout version tag: v2 added Header::prefault_cursor, which moved the
+// shared pthread mutex — a v1 build locking a v2 arena (or vice versa)
+// would "lock" the wrong bytes and race the allocator, so mixed builds
+// must refuse to share an arena instead of silently corrupting it.
+constexpr uint64_t kMagic = 0x5241595F54505632ULL;  // "RAY_TPV2"
 constexpr uint32_t kIdSize = 28;
 
 enum EntryState : uint32_t {
@@ -66,6 +70,7 @@ struct Header {
   uint64_t used_bytes;     // payload bytes in sealed/creating objects
   uint64_t num_objects;
   uint64_t access_clock;   // monotonically increasing LRU clock
+  uint64_t prefault_cursor;  // data-region high-water mark of prefaulted pages
   pthread_mutex_t mutex;
 };
 
@@ -245,6 +250,12 @@ void* rt_store_open(const char* path, uint64_t capacity, uint64_t table_size,
       mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
+  // allocation-time buffer prep: huge pages shrink TLB pressure on the
+  // multi-MiB copies this mapping exists for; WILLNEED primes already-
+  // allocated pages. Both are advice — unsupported kernels just say no.
+#ifdef MADV_WILLNEED
+  madvise(mem, capacity, MADV_WILLNEED);
+#endif
   Store* s = new Store();
   s->base = static_cast<uint8_t*>(mem);
   s->hdr = reinterpret_cast<Header*>(s->base);
@@ -256,6 +267,7 @@ void* rt_store_open(const char* path, uint64_t capacity, uint64_t table_size,
     s->hdr->table_size = table_size;
     s->hdr->used_bytes = 0;
     s->hdr->num_objects = 0;
+    s->hdr->prefault_cursor = s->hdr->data_off;
     // one big free block spanning the data region
     uint64_t first = s->hdr->data_off;
     Block* b = reinterpret_cast<Block*>(s->base + first);
@@ -271,9 +283,12 @@ void* rt_store_open(const char* path, uint64_t capacity, uint64_t table_size,
     pthread_mutexattr_destroy(&attr);
     __atomic_store_n(&s->hdr->magic, kMagic, __ATOMIC_RELEASE);
   } else {
-    // wait for the creator to finish initializing
+    // wait for the creator to finish initializing; a foreign NONZERO magic
+    // is a different layout version (or not our file) — fail fast instead
+    // of spinning out the whole init window
     for (int i = 0; i < 100000; i++) {
-      if (__atomic_load_n(&s->hdr->magic, __ATOMIC_ACQUIRE) == kMagic) break;
+      uint64_t m = __atomic_load_n(&s->hdr->magic, __ATOMIC_ACQUIRE);
+      if (m == kMagic || m != 0) break;
       usleep(100);
     }
     if (s->hdr->magic != kMagic) {
@@ -427,6 +442,40 @@ void* rt_store_base(void* handle) {
 
 uint64_t rt_store_capacity(void* handle) {
   return static_cast<Store*>(handle)->hdr->capacity;
+}
+
+// Prefault up to max_bytes of not-yet-touched FREE arena space so later
+// large-object copies write into resident pages instead of serializing
+// first-touch faults inside the copy loop. Only free-block payloads are
+// written (zeroed) — always safe under the lock — and a shared high-water
+// cursor in the header makes the walk incremental and once-per-arena:
+// pages below the cursor were either prefaulted here or touched by a real
+// object write, and tmpfs pages stay resident for the file's lifetime once
+// allocated. Returns bytes touched; 0 = nothing left to do. Callers hold
+// the budget loop (one slab per call keeps lock holds bounded).
+uint64_t rt_store_prefault(void* handle, uint64_t max_bytes) {
+  Store* s = static_cast<Store*>(handle);
+  LockGuard g(&s->hdr->mutex);
+  uint64_t cursor = s->hdr->prefault_cursor;
+  if (cursor < s->hdr->data_off) cursor = s->hdr->data_off;  // older arena
+  uint64_t touched = 0;
+  uint64_t off = s->hdr->free_head;
+  while (off && touched < max_bytes) {
+    Block* b = block_at(s, off);
+    uint64_t lo = off + sizeof(Block);
+    uint64_t hi = lo + b->size;
+    if (hi > cursor) {
+      uint64_t from = lo > cursor ? lo : cursor;
+      uint64_t n = hi - from;
+      if (n > max_bytes - touched) n = max_bytes - touched;
+      memset(s->base + from, 0, n);
+      touched += n;
+      if (from + n > cursor) cursor = from + n;
+    }
+    off = b->next_off;
+  }
+  s->hdr->prefault_cursor = cursor;
+  return touched;
 }
 
 // LRU eviction candidate (parity: plasma EvictionPolicy choosing sealed,

@@ -22,4 +22,5 @@ uint64_t rt_store_num_objects(void* handle);
 void* rt_store_base(void* handle);
 uint64_t rt_store_capacity(void* handle);
 int rt_store_lru_victim(void* handle, uint8_t* out_id);
+uint64_t rt_store_prefault(void* handle, uint64_t max_bytes);
 }

@@ -22,6 +22,7 @@ import json
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Dict, Optional, Tuple
 from urllib.parse import unquote, urlsplit
 
@@ -472,7 +473,11 @@ class HTTPProxy:
                 try:
                     fut.result(timeout=1.0)
                     return True
-                except TimeoutError:
+                except _FuturesTimeout:
+                    # NOT builtin TimeoutError: on Python 3.8-3.10 the
+                    # futures timeout is a distinct class, and letting it
+                    # fall into the generic handler killed the pump on a
+                    # 1s backpressure stall
                     if not fut.cancel():
                         return True
                 except Exception:

@@ -574,19 +574,28 @@ def test_actor_pool_grows_under_backlog(ray_start_regular):
 def test_optimizer_projection_algebra():
     from ray_tpu.data.optimizer import optimize_ops
 
-    # select/select intersects (when sound), drop/drop unions
-    assert optimize_ops([("select", ["a", "b"]), ("select", ["b"])]) == [
-        ("select", ["b"])
+    # select/select dedups same-set pairs, drop/drop unions
+    assert optimize_ops([("select", ["a", "b"]), ("select", ["b", "a"])]) == [
+        ("select", ["b", "a"])
     ]
     assert optimize_ops([("drop", ["a"]), ("drop", ["b"])]) == [
         ("drop", ["a", "b"])
     ]
-    assert optimize_ops([("select", ["a", "b"]), ("drop", ["b"])]) == [
-        ("select", ["a"])
+    # a drop disjoint from the selection is a no-op and is eliminated
+    assert optimize_ops([("select", ["a", "b"]), ("drop", ["c"])]) == [
+        ("select", ["a", "b"])
     ]
     # select of a column the earlier select pruned must NOT merge (the
     # runtime KeyError is user-visible behavior)
     ops = [("select", ["a"]), ("select", ["b"])]
+    assert optimize_ops(ops) == ops
+    # narrowing select/select must NOT merge either: select(["a","b"])
+    # validates "b" against the block even though a later select prunes it
+    ops = [("select", ["a", "b"]), ("select", ["a"])]
+    assert optimize_ops(ops) == ops
+    # nor a drop of a selected column: the select's missing-column check
+    # for the dropped column must still run
+    ops = [("select", ["a", "b"]), ("drop", ["b"])]
     assert optimize_ops(ops) == ops
     # rename compose
     assert optimize_ops(
@@ -595,6 +604,24 @@ def test_optimizer_projection_algebra():
     # select commutes left past rename (pushdown direction)
     out = optimize_ops([("rename", {"a": "b"}), ("select", ["b", "c"])])
     assert out == [("select", ["a", "c"]), ("rename", {"a": "b"})]
+
+
+def test_optimizer_preserves_missing_column_errors():
+    """Regression: select-select / select-drop merges used to swallow the
+    missing-column KeyError of a column only the EARLIER select referenced
+    (it validates every named column against the block at execution)."""
+    from ray_tpu.data.dataset import _apply_ops
+    from ray_tpu.data.optimizer import optimize_ops
+
+    block = {"a": [1, 2, 3]}  # no column "b"
+    for ops in (
+        [("select", ["a", "b"]), ("select", ["a"])],
+        [("select", ["a", "b"]), ("drop", ["b"])],
+    ):
+        with pytest.raises(KeyError):
+            _apply_ops(dict(block), ops)
+        with pytest.raises(KeyError):
+            _apply_ops(dict(block), optimize_ops(ops))
 
 
 def test_optimizer_pushdown_into_parquet_read(ray_start_regular, tmp_path):

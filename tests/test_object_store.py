@@ -214,6 +214,165 @@ def test_abort_unsealed_object(store):
     assert not store.abort(oid)
 
 
+# ---- large-object data path (zero-copy parallel put/get pipeline) ------
+
+
+def test_large_object_threshold_roundtrip(store):
+    """Pattern round-trips at every copy-strategy boundary: below/at the
+    slice-assignment cutoff, below/at/above the parallel fan-out threshold
+    (the +odd size leaves an uneven tail chunk for the copy pool)."""
+    from ray_tpu._private import fastcopy
+
+    sizes = [
+        fastcopy._SLICE_MAX - 1,
+        fastcopy._SLICE_MAX,
+        fastcopy.LARGE_OBJECT_MIN - 1,
+        fastcopy.LARGE_OBJECT_MIN,
+        fastcopy.LARGE_OBJECT_MIN + 65_537,
+    ]
+    for size in sizes:
+        oid = ObjectID.from_random()
+        data = (np.arange(size, dtype=np.uint64) % 251).astype(np.uint8)
+        store.put_bytes(oid, data.tobytes())
+        mv = store.get(oid, timeout=5)
+        assert mv is not None and mv.nbytes == size, size
+        np.testing.assert_array_equal(np.frombuffer(mv, dtype=np.uint8), data)
+        del mv  # drop the pin so delete reclaims the block immediately
+        store.delete(oid)
+
+
+def test_concurrent_multiclient_puts(tmp_path):
+    """Several clients over the same store, putting concurrently: identical
+    puts of ONE oid never corrupt (losing a create race may raise ValueError
+    while the winner is mid-copy — that is the documented loud path — but
+    the sealed object must equal the payload), and puts of DIFFERENT oids
+    all land intact."""
+    import threading
+
+    from ray_tpu._private.native_store import create_store_client
+
+    shm, fb = str(tmp_path / "shm"), str(tmp_path / "fb")
+    clients = [create_store_client(shm, fb, 64 * 1024 * 1024) for _ in range(4)]
+    size = 5 * 1024 * 1024  # above the parallel fan-out threshold
+    payload = bytes(np.full(size, 0xA7, dtype=np.uint8))
+    same = ObjectID.from_random()
+    unexpected = []
+
+    def put_same(c):
+        try:
+            c.put_bytes(same, payload)
+        except ValueError:
+            pass  # a live creator owned it: loud, but not corruption
+        except Exception as e:  # noqa: BLE001
+            unexpected.append(e)
+
+    threads = [threading.Thread(target=put_same, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not unexpected, unexpected
+    for c in clients:
+        mv = c.get(same, timeout=5)
+        assert mv is not None and bytes(mv) == payload
+        del mv
+
+    oids = [ObjectID.from_random() for _ in range(len(clients))]
+    payloads = [bytes([17 * (i + 1) % 256]) * size for i in range(len(clients))]
+
+    def put_own(c, o, p):
+        try:
+            c.put_bytes(o, p)
+        except Exception as e:  # noqa: BLE001
+            unexpected.append(e)
+
+    threads = [
+        threading.Thread(target=put_own, args=(c, o, p))
+        for c, o, p in zip(clients, oids, payloads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not unexpected, unexpected
+    for o, p in zip(oids, payloads):
+        mv = clients[0].get(o, timeout=5)
+        assert mv is not None and bytes(mv) == p
+        del mv
+    for c in clients:
+        c.close()
+
+
+def test_spill_restore_chunk_streamed(tmp_path):
+    """LRU spill streams the sealed arena buffer chunk-by-chunk to external
+    storage (no ``bytes()`` staging copy) and restore streams straight back
+    into a fresh arena allocation; a multi-chunk object survives the round
+    trip bit-exact."""
+    from ray_tpu._private import fastcopy
+    from ray_tpu._private.native_store import NativeStoreClient
+    from ray_tpu.native import load_native
+
+    lib = load_native()
+    if lib is None:
+        pytest.skip("native store not built")
+    shm_dir = f"/dev/shm/rt_test_{uuid.uuid4().hex[:8]}"
+    os.makedirs(shm_dir, exist_ok=True)
+    fb = ObjectStoreClient(
+        os.path.join(shm_dir, "files"), str(tmp_path / "fb"), 1 << 20
+    )
+    spill_dir = tmp_path / "ext_spill"
+    client = NativeStoreClient(
+        lib,
+        os.path.join(shm_dir, "arena"),
+        fb,
+        32 * 1024 * 1024,
+        spill_uri=f"file://{spill_dir}",
+    )
+    client._test_cleanup_dir = shm_dir
+    try:
+        big = ObjectID.from_random()
+        size = 2 * fastcopy.CHUNK_BYTES + 65_537  # 3 chunks, uneven tail
+        data = (np.arange(size, dtype=np.uint64) % 249).astype(np.uint8)
+        client.put_bytes(big, data.tobytes())
+        # fill the arena until the LRU evicts (spills) the big object
+        for i in range(8):
+            client.put_bytes(ObjectID.from_random(), b"f" * (8 * 1024 * 1024))
+            if not lib.rt_store_contains(client._h, big.binary()):
+                break
+        assert not lib.rt_store_contains(client._h, big.binary())
+        assert os.path.exists(spill_dir / f"{big.hex()}.obj")
+        assert client.contains(big)  # reachable via the external copy
+        mv = client.get(big, timeout=10)  # restore: streamed back in
+        assert mv is not None and mv.nbytes == size
+        np.testing.assert_array_equal(np.frombuffer(mv, dtype=np.uint8), data)
+        del mv
+    finally:
+        client.close()
+        shutil.rmtree(shm_dir, ignore_errors=True)
+
+
+def test_zero_copy_get_readonly_aliasing(store):
+    """get() views are READ-ONLY: neither the raw view nor an array
+    deserialized from it can mutate the sealed shared copy, and failed
+    mutation attempts leave the object byte-identical."""
+    oid = ObjectID.from_random()
+    size = 5 * 1024 * 1024  # large path: view aliases the shared map
+    src = (np.arange(size, dtype=np.uint64) % 253).astype(np.uint8)
+    store.put_bytes(oid, src.tobytes())
+    mv = store.get(oid, timeout=5)
+    assert mv.readonly
+    with pytest.raises(TypeError):
+        mv[0] = 1
+    arr = np.frombuffer(mv, dtype=np.uint8)
+    assert not arr.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        arr[0] = 99
+    del arr, mv
+    again = store.get(oid, timeout=5)
+    np.testing.assert_array_equal(np.frombuffer(again, dtype=np.uint8), src)
+    del again
+
+
 def test_spilled_object_reput_then_delete_leaves_no_files():
     """A retried put of a spilled object re-stores into the arena (create is
     the arbiter); delete must purge EVERY tier — arena, shm file, fallback
