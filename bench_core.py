@@ -247,6 +247,39 @@ def main():
     agg = sum(c * gib / e for c, e in results)
     rows.append(report("multi_client_get_gigabytes", agg, unit="GiB/s"))
 
+    # --- telemetry overhead (tracked budget: event pipeline <= 5%) ---
+    # back-to-back fresh clusters so worker-pool age doesn't bias either
+    # side: small-task throughput with the telemetry plane on vs off
+    tele = {}
+    for flag in (True, False):
+        ray_tpu.shutdown()
+        ray_tpu.init(
+            num_cpus=args.num_cpus,
+            ignore_reinit_error=True,
+            _system_config={"telemetry_enabled": flag},
+        )
+        ray_tpu.get([_noop.remote() for _ in range(20)], timeout=60)
+        _, v = timeit(
+            "tasks_async_telemetry", tasks_async, multiplier=100, duration=duration
+        )
+        tele[flag] = v
+        label = "on" if flag else "off"
+        rows.append(report(f"single_client_tasks_async_telemetry_{label}", v))
+    overhead_pct = (
+        (1 - tele[True] / tele[False]) * 100 if tele.get(False) else 0.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "telemetry_overhead_pct",
+                "value": round(overhead_pct, 2),
+                "unit": "%",
+                "budget_pct": 5.0,
+            }
+        ),
+        flush=True,
+    )
+
     # per-stage attribution of the driver's put pipeline (serialize /
     # alloc / copy / seal — the same registry event_stats exports)
     from ray_tpu._private import fastcopy

@@ -169,42 +169,35 @@ def available_resources() -> dict:
     return total
 
 
-def timeline() -> List[dict]:
-    """Chrome-trace task events. Parity: ``ray.timeline()``
-    (``python/ray/_private/state.py:944``)."""
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace task events. Parity: ``ray.timeline(filename=...)``
+    (``python/ray/_private/state.py:944``).
+
+    Forces a cluster-wide telemetry flush first (read-your-writes despite
+    the batched pipeline), then renders the merged event log as a
+    chrome://tracing array: per-task lifecycle phase spans
+    (SUBMITTED/QUEUED/DISPATCHED/RUNNING/FINISHED‑or‑FAILED), profile
+    spans with trace-context parent links (one tree across processes),
+    and stable per-task tids. With ``filename`` the JSON array is also
+    written to disk, ready to load into chrome://tracing or Perfetto.
+    """
     rt = get_runtime()
     if not hasattr(rt, "scheduler"):
         raise RuntimeError("timeline() is driver-only")
-    events = rt.scheduler.task_events()
-    out = []
-    for e in events:
-        if e["type"] == "PROFILE":
-            # user span -> chrome "complete" event with a real duration
-            out.append(
-                {
-                    "cat": "PROFILE",
-                    "name": e["name"],
-                    "pid": e.get("pid", 1),
-                    "tid": (hash(e["task_id"]) % 1000),
-                    "ph": "X",
-                    "ts": e["time"] * 1e6,
-                    "dur": (e.get("duration_ms") or 0.0) * 1e3,
-                    "args": {"task_id": e["task_id"], **e.get("extra", {})},
-                }
-            )
-            continue
-        out.append(
-            {
-                "cat": e["type"],
-                "name": e["name"],
-                "pid": 1,
-                "tid": (hash(e["task_id"]) % 1000),
-                "ph": "i",
-                "ts": e["time"] * 1e6,
-                "args": {"state": e["state"], "task_id": e["task_id"]},
-            }
-        )
-    return out
+    from ray_tpu._private import telemetry as _telemetry
+
+    _telemetry.flush()
+    rt.scheduler.request_telemetry_flush()
+    # read via the loop-serialized rpc: the loop appends telemetry batches
+    # concurrently, and list(deque) from this thread could see a mutation
+    events = rt.scheduler_rpc("task_events", ())
+    trace = _telemetry.build_chrome_trace(events)
+    if filename:
+        import json as _json
+
+        with open(filename, "w") as fh:
+            _json.dump(trace, fh)
+    return trace
 
 
 def __getattr__(name):

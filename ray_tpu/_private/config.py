@@ -101,10 +101,20 @@ class Config:
     # address workers bind their direct-call listeners on; daemons override
     # this with their --host so cross-host callers can reach their workers
     node_host: str = "127.0.0.1"
-    # --- events / metrics ---
+    # --- events / metrics (telemetry plane, _private/telemetry.py) ---
     event_stats_print_interval_ms: int = 0  # 0 = disabled
-    metrics_report_interval_ms: int = 5000
+    # per-process telemetry batch flush period (parity: the reference's
+    # task_events_report_interval_ms=1000, task_event_buffer.h); every
+    # process ships task events + profile spans + metric snapshots to the
+    # scheduler at most this often
+    metrics_report_interval_ms: int = 1000
+    # ring-buffer capacity shared by the scheduler's merged event log and
+    # each process's TelemetryBuffer; overflow is counted, never silent
     task_event_buffer_max: int = 100_000
+    # master switch for the event pipeline (worker lifecycle events,
+    # profile spans, batched metrics, scheduler task-event log); off trades
+    # observability for the last few percent of small-task throughput
+    telemetry_enabled: bool = True
     # --- misc ---
     session_dir_root: str = "/tmp/ray_tpu_sessions"
     log_to_driver: bool = True

@@ -34,6 +34,7 @@ def profile(event_name: str, extra_data: dict | None = None):
 
 
 def _emit(span: dict) -> None:
+    from ray_tpu._private import telemetry
     from ray_tpu._private import worker as worker_mod
 
     rt = None
@@ -47,14 +48,13 @@ def _emit(span: dict) -> None:
     if callable(tid):  # DriverRuntime exposes it as a method
         tid = tid()
     span["task_id"] = tid.hex() if tid is not None else None
-    try:
-        scheduler = getattr(rt, "scheduler", None)
-        if scheduler is not None:  # local driver: post straight to the loop
-            scheduler.post(("profile_event", span))
-        else:  # worker / remote driver: ride the command pipe
-            rt._send(("cmd", ("profile_event", span)))
-    except Exception:  # dead pipe during shutdown
-        pass
+    # attach the active trace context so user spans join the cross-process
+    # tree without each call site threading it through extra_data
+    from ray_tpu.util import tracing
+
+    for k, v in tracing.context_args().items():
+        span["extra"].setdefault(k, v)
+    telemetry.record_span(span)
 
 
 def format_thread_stacks() -> str:

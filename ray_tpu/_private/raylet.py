@@ -697,7 +697,12 @@ class NodeDaemon:
                         entry[1].send(("exec", spec, accel))
                     else:
                         entry[1].send(("exec", spec))
-                    self._lease_started_buf.append(spec.task_id.binary())
+                    # carry the local dispatch timestamp: the head's RUNNING
+                    # event then reflects when the task actually started on
+                    # this node, not when the batched report arrived
+                    self._lease_started_buf.append(
+                        (spec.task_id.binary(), time.time())
+                    )
                 except (OSError, EOFError, BrokenPipeError):
                     self._on_worker_pipe_death(wid)
             while skipped:

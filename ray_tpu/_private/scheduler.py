@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import json
 import logging
 import os
 import pickle
@@ -439,6 +440,16 @@ class Scheduler:
         # the TTL is constant, so expiry only ever pops from the left
         self._transit_pins: collections.deque = collections.deque()
         self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
+        # ---- telemetry plane (merged TelemetryBuffer batches) ----
+        # metric aggregation across processes: name -> {kind, description,
+        # per_proc: {pid: data}}; the merged view is written to the GCS KV
+        # so prometheus_text sees one coherent series per metric
+        self._metric_procs: Dict[str, dict] = {}
+        self._telemetry_batches = 0
+        self._telemetry_events = 0
+        self._telemetry_dropped = 0
+        # req_id -> [event, remaining-ack count] for cluster-wide flushes
+        self._telemetry_flush_waiters: Dict[str, list] = {}
         # name-claimed actors whose creation spec has not arrived yet:
         # actor_id -> deadline for the spec to land
         self._placeholder_deadlines: Dict[ActorID, float] = {}
@@ -728,7 +739,14 @@ class Scheduler:
             nid = self._daemon_conns.get(conn)
             if nid is not None:
                 self._lease_last_activity[nid] = time.monotonic()
-            for tid_bin in msg[1]:
+            for item in msg[1]:
+                # entries carry the daemon's dispatch timestamp so the
+                # timeline reflects when the task actually started, not
+                # when the batched report landed here; bare-bytes entries
+                # (older daemons) fall back to receipt time
+                tid_bin, started_ts = (
+                    item if isinstance(item, tuple) else (item, None)
+                )
                 tid = TaskID(tid_bin)
                 info = self._leased.get(tid)
                 if info is None or (nid is not None and info[0] != nid):
@@ -737,7 +755,7 @@ class Scheduler:
                 if rec is not None and rec.state == "LEASED":
                     rec.state = "RUNNING"
                     rec.start_time = time.monotonic()
-                    self._record_event(rec.spec, "RUNNING")
+                    self._record_event(rec.spec, "RUNNING", ts=started_ts)
         elif kind == "lease_revoked":
             nid = self._daemon_conns.get(conn)
             if nid is not None:
@@ -878,6 +896,10 @@ class Scheduler:
             # holder: ref borrows from this worker are attributed to it so
             # a crashed borrower's refs get released, not leaked
             self._handle_cmd(msg[1], holder=wid)
+        elif kind == "telemetry_ack":
+            # the worker drained its TelemetryBuffer; its batch (same pipe,
+            # FIFO) has already been ingested above this ack
+            self._on_telemetry_ack(msg[1])
         elif kind == "rpc":
             _, req_id, op, args = msg
             if op in ("ensure_local", "same_host_dirs") and len(args) == 1:
@@ -1218,22 +1240,19 @@ class Scheduler:
             self._on_submit(cmd[1])
         elif kind == "profile_event":
             # user-annotated span (profiling.profile); joins the task event
-            # log so ray_tpu.timeline() shows it (TaskEventBuffer role)
-            span = cmd[1]
-            self._task_events.append(
-                {
-                    "task_id": span.get("task_id"),
-                    "name": span.get("event", "span"),
-                    "type": "PROFILE",
-                    "state": "PROFILE",
-                    "time": span.get("start", time.time()),
-                    "end_time": span.get("end"),
-                    "duration_ms": span.get("duration_ms"),
-                    "pid": span.get("pid"),
-                    "extra": span.get("extra", {}),
-                    "actor_id": None,
-                }
-            )
+            # log so ray_tpu.timeline() shows it (TaskEventBuffer role).
+            # Kept for compatibility — spans now normally arrive batched
+            # inside telemetry_batch messages.
+            self._append_profile_span(cmd[1])
+        elif kind == "telemetry_batch":
+            # one process's TelemetryBuffer flush: task events, profile
+            # spans, coalesced metric snapshots, dropped-event accounting
+            # (parity: GcsTaskManager ingesting TaskEventBuffer batches).
+            # holder (the sending worker's id) disambiguates processes:
+            # pids repeat across nodes/containers
+            self._ingest_telemetry(cmd[1], holder=holder)
+        elif kind == "telemetry_flush_bcast":
+            self._broadcast_telemetry_flush(cmd[1])
         elif kind == "put_done":
             if cmd[2][0] == "stored":
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
@@ -1421,7 +1440,7 @@ class Scheduler:
                 and a.object_id in self._ref_counts
             ):
                 self._cross_channel.add(a.object_id)
-        self._record_event(spec, "PENDING")
+        self._record_event(spec, "SUBMITTED")
         if spec.task_type == TaskType.ACTOR_CREATION:
             st = self.actors.get(spec.actor_id)
             if st is not None and st.creation_spec is None and st.state == "DEAD":
@@ -1507,6 +1526,9 @@ class Scheduler:
     def _make_schedulable(self, rec: TaskRecord):
         self._dispatch_dirty = True
         rec.state = "PENDING"
+        # deps resolved, entering the dispatch queue: the QUEUED->DISPATCHED
+        # gap in the timeline is pure scheduler queueing delay
+        self._record_event(rec.spec, "QUEUED")
         if rec.spec.task_type == TaskType.ACTOR_TASK:
             self._dispatch_actor_task(rec)
         else:
@@ -1909,6 +1931,7 @@ class Scheduler:
             actor = self.actors[rec.spec.actor_id]
             actor.worker_id = wid
             w.actor_id = rec.spec.actor_id
+        self._record_event(rec.spec, "DISPATCHED")
         self._record_event(rec.spec, "RUNNING")
         try:
             if w.accel_alloc:
@@ -1959,7 +1982,9 @@ class Scheduler:
         self._lease_count_by_node[node.node_id] += 1
         self._lease_batch.setdefault(node.node_id, []).append(spec)
         self._lease_last_activity[node.node_id] = time.monotonic()
-        self._record_event(spec, "LEASED")
+        # leasing to a node-local dispatcher IS the dispatch decision; the
+        # daemon's lease_started (with its own timestamp) marks RUNNING
+        self._record_event(spec, "DISPATCHED")
         return True
 
     def _flush_lease_batches(self) -> None:
@@ -2384,6 +2409,7 @@ class Scheduler:
                 rec.state = "RUNNING"
                 rec.worker_id = actor.worker_id
                 rec.start_time = time.monotonic()
+                self._record_event(rec.spec, "DISPATCHED")
                 self._record_event(rec.spec, "RUNNING")
                 try:
                     w.conn.send(("exec", rec.spec))
@@ -3039,7 +3065,7 @@ class Scheduler:
             pg = self.placement_groups.get(args[0])
             return None if pg is None else pg.state
         if op == "list_tasks":
-            return [
+            rows = [
                 {
                     "task_id": t.spec.task_id.hex(),
                     "name": t.spec.name,
@@ -3050,8 +3076,9 @@ class Scheduler:
                 }
                 for t in list(self.tasks.values())
             ]
+            return self._apply_limit(rows, args)
         if op == "list_actors":
-            return [
+            rows = [
                 {
                     "actor_id": a.actor_id.hex(),
                     "state": a.state,
@@ -3062,8 +3089,9 @@ class Scheduler:
                 }
                 for a in list(self.actors.values())
             ]
+            return self._apply_limit(rows, args)
         if op == "list_workers":
-            return [
+            rows = [
                 {
                     "worker_id": w.worker_id.hex(),
                     "node_id": w.node_id.hex(),
@@ -3073,8 +3101,9 @@ class Scheduler:
                 }
                 for w in list(self.workers.values())
             ]
+            return self._apply_limit(rows, args)
         if op == "list_placement_groups":
-            return [
+            rows = [
                 {
                     "placement_group_id": pg.pg_id.hex(),
                     "state": pg.state,
@@ -3084,9 +3113,11 @@ class Scheduler:
                 }
                 for pg in list(self.placement_groups.values())
             ]
+            return self._apply_limit(rows, args)
         if op == "list_objects":
             store = self._node.store_client
             out = []
+            limit = args[0] if args and isinstance(args[0], int) else None
             if store is not None:
                 for oid, size in store.list_objects():
                     out.append(
@@ -3096,6 +3127,8 @@ class Scheduler:
                             "ref_count": self._ref_counts.get(oid, 0),
                         }
                     )
+                    if limit is not None and len(out) >= limit:
+                        break
             return out
         if op == "pending_demand":
             # resource shapes the scheduler cannot currently place (autoscaler
@@ -3116,7 +3149,7 @@ class Scheduler:
                 row[t.state] = row.get(t.state, 0) + 1
             return summary
         if op == "list_nodes":
-            return [
+            rows = [
                 {
                     "node_id": n.node_id.hex(),
                     "alive": n.alive,
@@ -3126,6 +3159,7 @@ class Scheduler:
                 }
                 for n in self.nodes.values()
             ]
+            return self._apply_limit(rows, args)
         if op == "ensure_local":
             # start a transfer of oid toward node (default: head) and return
             # whether a local copy already exists there
@@ -3230,7 +3264,22 @@ class Scheduler:
                 "commits": self._commit_count,
             }
             return out
+        if op == "runtime_metrics":
+            # scheduler internals as first-class metric series (the
+            # telemetry-plane half of /metrics; app metrics come from the
+            # aggregated KV)
+            return self._runtime_metric_series()
+        if op == "task_events":
+            return list(self._task_events)
         raise ValueError(f"unknown rpc {op}")
+
+    @staticmethod
+    def _apply_limit(rows: List[dict], args) -> List[dict]:
+        """Server-side result cap for the state listers: the client pushes
+        its ``limit`` into the RPC so a 10k-task cluster doesn't serialize
+        10k rows for a LIMIT 10 query."""
+        limit = args[0] if args and isinstance(args[0], int) else None
+        return rows if limit is None else rows[:limit]
 
     # ---- misc ------------------------------------------------------------
 
@@ -3533,20 +3582,327 @@ class Scheduler:
             self.submit(spec)
         return len(specs)
 
-    def _record_event(self, spec: TaskSpec, state: str):
+    def _record_event(self, spec: TaskSpec, state: str, ts: float = None):
+        if not getattr(self.config, "telemetry_enabled", True):
+            return
         self._task_events.append(
             {
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
                 "type": spec.task_type.name,
                 "state": state,
-                "time": time.time(),
+                "time": ts if ts is not None else time.time(),
                 "actor_id": spec.actor_id.hex() if spec.actor_id else None,
             }
         )
 
     def task_events(self) -> List[dict]:
         return list(self._task_events)
+
+    # ---- telemetry plane (TelemetryBuffer ingestion + cluster flush) -----
+
+    def _append_profile_span(self, span: dict, pid=None) -> None:
+        self._task_events.append(
+            {
+                "task_id": span.get("task_id"),
+                "name": span.get("event", "span"),
+                "type": "PROFILE",
+                "state": "PROFILE",
+                "time": span.get("start", time.time()),
+                "end_time": span.get("end"),
+                "duration_ms": span.get("duration_ms"),
+                "pid": span.get("pid", pid),
+                "extra": span.get("extra", {}),
+                "actor_id": None,
+            }
+        )
+
+    def _ingest_telemetry(self, batch: dict, holder=None) -> None:
+        """Merge one process's flushed batch: lifecycle events and spans
+        join the task-event log, metric snapshots aggregate into the KV,
+        dropped counts accumulate (explicit loss accounting)."""
+        pid = batch.get("pid")
+        # unique process key: pids repeat across nodes (and in containers),
+        # so worker-relayed batches key on the cluster-unique worker id
+        proc = (holder.hex() if holder is not None else "driver", pid)
+        self._telemetry_batches += 1
+        events = batch.get("events") or ()
+        spans = batch.get("spans") or ()
+        self._telemetry_events += len(events) + len(spans)
+        for ev in events:
+            self._task_events.append(ev)
+        for span in spans:
+            self._append_profile_span(span, pid=pid)
+        for name, (kind, description, data) in (batch.get("metrics") or {}).items():
+            try:
+                self._merge_metric(name, kind, description, data, proc)
+            except Exception:
+                logger.exception("metric merge failed for %r", name)
+        self._telemetry_dropped += int(batch.get("dropped") or 0)
+
+    def _merge_metric(self, name, kind, description, data, proc) -> None:
+        """Aggregate per-process snapshots into one series (parity: the
+        metrics agent summing worker exports): counters and histograms sum
+        across processes, gauges take the latest writer per label set."""
+        entry = self._metric_procs.setdefault(
+            name, {"kind": kind, "description": description, "per_proc": {}}
+        )
+        entry["kind"] = kind
+        entry["description"] = description
+        entry["per_proc"][proc] = data
+        merged: dict = {}
+        if kind == "counter":
+            for proc_data in entry["per_proc"].values():
+                for key, val in proc_data.items():
+                    merged[key] = merged.get(key, 0.0) + val
+        elif kind == "histogram":
+            for proc_data in entry["per_proc"].values():
+                for key, val in proc_data.items():
+                    cur = merged.get(key)
+                    if (
+                        cur is None
+                        or not isinstance(val, dict)
+                        or len(cur.get("buckets", ())) != len(val.get("buckets", ()))
+                    ):
+                        merged[key] = json.loads(json.dumps(val))
+                    else:
+                        cur["count"] += val["count"]
+                        cur["sum"] += val["sum"]
+                        cur["buckets"] = [
+                            a + b for a, b in zip(cur["buckets"], val["buckets"])
+                        ]
+        else:  # gauge / untyped: most recent process wins per label set
+            for proc_data in entry["per_proc"].values():
+                for key, val in proc_data.items():
+                    merged.setdefault(key, val)
+            merged.update(data)
+        blob = json.dumps(
+            {"kind": kind, "description": description, "data": merged}
+        ).encode()
+        self.gcs.kv_put("metrics", name.encode(), blob, True)
+
+    def request_telemetry_flush(self, timeout: float = 2.0) -> bool:
+        """Cluster-wide read-your-writes flush: ask every live worker to
+        drain its TelemetryBuffer now and wait (bounded) for the acks.
+        Callable from any thread EXCEPT the scheduler loop (the loop must
+        keep running to pump the acks)."""
+        import uuid as _uuid
+
+        req_id = _uuid.uuid4().hex
+        ev = threading.Event()
+        self._telemetry_flush_waiters[req_id] = [ev, -1]
+        self.post(("telemetry_flush_bcast", req_id))
+        ok = ev.wait(timeout)
+        self._telemetry_flush_waiters.pop(req_id, None)
+        return ok
+
+    def _broadcast_telemetry_flush(self, req_id: str) -> None:
+        """Loop side of request_telemetry_flush: fan the request out over
+        every ready worker conn (loop-owned sends — no races with exec) and
+        arm the ack countdown. Workers answer from their reader thread, so
+        a busy task doesn't delay the flush."""
+        waiter = self._telemetry_flush_waiters.get(req_id)
+        if waiter is None:
+            return  # caller already timed out
+        sent = 0
+        for w in list(self.workers.values()):
+            if w.state not in ("idle", "busy", "blocked", "leased"):
+                continue
+            try:
+                w.conn.send(("flush_telemetry", req_id))
+                sent += 1
+            except (OSError, EOFError):
+                pass  # dying worker: its death handler runs on this loop
+        waiter[1] = sent
+        if sent == 0:
+            waiter[0].set()
+
+    def _on_telemetry_ack(self, req_id: str) -> None:
+        waiter = self._telemetry_flush_waiters.get(req_id)
+        if waiter is None:
+            return
+        waiter[1] -= 1
+        if waiter[1] == 0:
+            waiter[0].set()
+
+    def _runtime_metric_series(self) -> List[dict]:
+        """Runtime internals as first-class metric series for /metrics
+        (labels keyed exactly like app metrics: a sorted-json label dict).
+        Runs on the loop thread, so all loop-owned state is safe to read."""
+
+        def lk(**labels) -> str:
+            return json.dumps(labels, sort_keys=True)
+
+        series: List[dict] = []
+
+        def add(name, kind, description, data):
+            series.append(
+                {
+                    "name": name,
+                    "kind": kind,
+                    "description": description,
+                    "data": data,
+                }
+            )
+
+        add(
+            "ray_tpu_scheduler_queue_depth",
+            "gauge",
+            "tasks waiting in the scheduler's pending queue",
+            {lk(): len(self._pending)},
+        )
+        by_state: Dict[str, int] = {}
+        for t in self.tasks.values():
+            by_state[t.state] = by_state.get(t.state, 0) + 1
+        add(
+            "ray_tpu_scheduler_tasks",
+            "gauge",
+            "task records by lifecycle state",
+            {lk(state=s): n for s, n in sorted(by_state.items())},
+        )
+        by_wstate: Dict[str, int] = {}
+        for w in self.workers.values():
+            by_wstate[w.state] = by_wstate.get(w.state, 0) + 1
+        add(
+            "ray_tpu_workers",
+            "gauge",
+            "worker processes by state",
+            {lk(state=s): n for s, n in sorted(by_wstate.items())},
+        )
+        calls = {}
+        secs = {}
+        for handler, (c, t) in self._event_stats.items():
+            calls[lk(handler=handler)] = int(c)
+            secs[lk(handler=handler)] = round(t, 6)
+        add(
+            "ray_tpu_scheduler_handler_calls_total",
+            "counter",
+            "scheduler loop handler invocations (event_stats)",
+            calls,
+        )
+        add(
+            "ray_tpu_scheduler_handler_seconds_total",
+            "counter",
+            "cumulative seconds per scheduler loop handler (event_stats)",
+            secs,
+        )
+        add(
+            "ray_tpu_scheduler_loop_cpu_seconds_total",
+            "counter",
+            "scheduler loop thread CPU seconds",
+            {lk(): round(time.clock_gettime(time.CLOCK_THREAD_CPUTIME_ID), 3)},
+        )
+        add(
+            "ray_tpu_scheduler_loop_wall_seconds_total",
+            "counter",
+            "scheduler loop wall-clock seconds since start",
+            {
+                lk(): round(
+                    time.monotonic() - getattr(self, "_loop_started_at", time.monotonic()),
+                    3,
+                )
+            },
+        )
+        store = self._node.store_client
+        used = 0
+        nobj = 0
+        if store is not None:
+            try:
+                used = int(getattr(store, "usage_bytes", lambda: 0)())
+                nobj = sum(1 for _ in store.list_objects())
+            except Exception:
+                pass
+        add(
+            "ray_tpu_object_store_bytes_used",
+            "gauge",
+            "bytes of sealed objects in the head object store",
+            {lk(): used},
+        )
+        add(
+            "ray_tpu_object_store_capacity_bytes",
+            "gauge",
+            "configured object store arena capacity",
+            {lk(): int(self.config.object_store_memory)},
+        )
+        add(
+            "ray_tpu_object_store_objects",
+            "gauge",
+            "sealed objects in the head object store",
+            {lk(): nobj},
+        )
+        from ray_tpu._private import fastcopy as _fastcopy
+
+        stage_secs = {}
+        stage_bytes = {}
+        stage_gibs = {}
+        for stage, (c, t, b) in _fastcopy.stage_stats().items():
+            key = lk(stage=stage)
+            stage_secs[key] = round(t, 6)
+            stage_bytes[key] = int(b)
+            if t > 0 and b:
+                stage_gibs[key] = round(b / t / 2**30, 3)
+        add(
+            "ray_tpu_fastcopy_stage_seconds_total",
+            "counter",
+            "cumulative seconds per large-object data-path stage",
+            stage_secs,
+        )
+        add(
+            "ray_tpu_fastcopy_stage_bytes_total",
+            "counter",
+            "cumulative bytes per large-object data-path stage",
+            stage_bytes,
+        )
+        add(
+            "ray_tpu_fastcopy_stage_gib_per_s",
+            "gauge",
+            "per-stage bandwidth of the large-object data path",
+            stage_gibs,
+        )
+        add(
+            "ray_tpu_task_events_total",
+            "counter",
+            "task lifecycle events + spans held in the merged event log",
+            {lk(): len(self._task_events)},
+        )
+        add(
+            "ray_tpu_telemetry_batches_total",
+            "counter",
+            "TelemetryBuffer batches merged by the scheduler",
+            {lk(): self._telemetry_batches},
+        )
+        add(
+            "ray_tpu_telemetry_events_total",
+            "counter",
+            "events delivered through telemetry batches",
+            {lk(): self._telemetry_events},
+        )
+        add(
+            "ray_tpu_telemetry_dropped_total",
+            "counter",
+            "telemetry events dropped at capacity or on dead pipes "
+            "(explicit loss accounting)",
+            {lk(): self._telemetry_dropped},
+        )
+        add(
+            "ray_tpu_lease_backlog_depth",
+            "gauge",
+            "leased-but-unstarted tasks queued at node-local dispatchers",
+            {lk(): sum(len(q) for q in self._lease_backlog.values())},
+        )
+        add(
+            "ray_tpu_ownership_ref_ops_total",
+            "counter",
+            "head-processed reference-count mutations",
+            {lk(): self._refop_count},
+        )
+        add(
+            "ray_tpu_ownership_commits_total",
+            "counter",
+            "head-committed task results",
+            {lk(): self._commit_count},
+        )
+        return series
 
     def _terminate_worker(self, w: WorkerState):
         """Hard-kill a worker process, local or daemon-hosted."""

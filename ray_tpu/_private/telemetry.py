@@ -1,0 +1,428 @@
+"""Unified telemetry plane: per-process event buffer + batched background flush.
+
+Parity: the reference's ``TaskEventBuffer`` (``src/ray/core_worker/
+task_event_buffer.h:206``) -> ``GcsTaskManager`` pipeline plus the metrics
+agent's batched export (``python/ray/_private/metrics_agent.py``). Every
+process (driver, workers, serve replicas) accumulates three kinds of
+records in one lock-light ring buffer:
+
+* **task lifecycle events** — worker-side RUNNING/FINISHED/FAILED
+  transitions with real pids and wall-clock timestamps (the scheduler
+  records the head-side SUBMITTED/QUEUED/DISPATCHED half directly);
+* **profile spans** — ``ray_tpu._private.profiling.profile`` sections,
+  carrying the active trace context so spans form one tree across
+  processes;
+* **metric snapshots** — ``ray_tpu.util.metrics`` Counter/Gauge/Histogram
+  updates, coalesced last-writer-wins per metric so one interval produces
+  at most one KV write per metric no matter how many records landed.
+
+A background thread flushes the buffer every ``metrics_report_interval_ms``
+(the previously-unused knob) as a single ``telemetry_batch`` message to the
+scheduler, which merges events into ``_task_events`` and metric snapshots
+into the GCS KV. Overflow beyond ``task_event_buffer_max`` is *counted*,
+never silent: the per-process drop count rides every batch and aggregates
+into the ``ray_tpu_telemetry_dropped_total`` series.
+
+Read-your-writes: ``timeline()`` / ``prometheus_text()`` force a
+cluster-wide flush first (``Scheduler.request_telemetry_flush``), so reads
+are deterministic without sleeps despite the batching.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_INTERVAL_MS = 1000
+_DEFAULT_CAPACITY = 100_000
+
+
+def _runtime():
+    """The connected runtime, or None (never raises)."""
+    from ray_tpu._private import worker as worker_mod
+
+    rt = worker_mod._worker_runtime
+    if rt is not None:
+        return rt
+    return worker_mod._driver
+
+
+def enabled() -> bool:
+    """Whether the event pipeline is on (``telemetry_enabled`` flag). An
+    unconnected process reads as disabled — there is nowhere to flush to."""
+    rt = _runtime()
+    if rt is None:
+        return False
+    cfg = getattr(rt, "config", None)
+    return bool(getattr(cfg, "telemetry_enabled", True))
+
+
+class TelemetryBuffer:
+    """Lock-light ring buffer with explicit dropped-event accounting.
+
+    The lock is held only for O(1) append/drain bookkeeping; batch
+    serialization and the pipe write happen outside it.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        # None = resolve task_event_buffer_max from the runtime config on
+        # first use (the module singleton exists before init() runs)
+        self._cap = capacity
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque()
+        self._spans: collections.deque = collections.deque()
+        # name -> (kind, description, data snapshot): last writer wins, so
+        # N records within one interval flush as ONE write per metric
+        self._metrics: Dict[str, Tuple[str, str, dict]] = {}
+        self._dropped_pending = 0  # reported (and reset) with the next batch
+        self._dropped_total = 0  # cumulative, for local inspection/tests
+        self._flushes = 0
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- recording ---------------------------------------------------------
+
+    def _capacity(self) -> int:
+        cap = self._cap
+        if cap is not None:
+            return cap
+        rt = _runtime()
+        cfg = getattr(rt, "config", None)
+        cap = getattr(cfg, "task_event_buffer_max", None)
+        if cap is None:
+            return _DEFAULT_CAPACITY  # not connected yet: don't cache
+        self._cap = int(cap)
+        return self._cap
+
+    def record_event(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) + len(self._spans) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._events.append(ev)
+
+    def record_span(self, span: dict) -> None:
+        with self._lock:
+            if len(self._events) + len(self._spans) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._spans.append(span)
+
+    def record_metric(self, name: str, kind: str, description: str, data: dict) -> None:
+        with self._lock:
+            self._metrics[name] = (kind, description, data)
+
+    @property
+    def dropped_total(self) -> int:
+        return self._dropped_total
+
+    @property
+    def flushes(self) -> int:
+        return self._flushes
+
+    # -- flushing ----------------------------------------------------------
+
+    def _drain(self) -> Optional[dict]:
+        with self._lock:
+            if not (self._events or self._spans or self._metrics or self._dropped_pending):
+                return None
+            events, self._events = list(self._events), collections.deque()
+            spans, self._spans = list(self._spans), collections.deque()
+            metrics, self._metrics = dict(self._metrics), {}
+            dropped, self._dropped_pending = self._dropped_pending, 0
+        return {
+            "pid": os.getpid(),
+            "events": events,
+            "spans": spans,
+            "metrics": metrics,
+            "dropped": dropped,
+        }
+
+    def flush(self) -> bool:
+        """Drain and send one batch. On a failed send (runtime gone, pipe
+        dead) events and spans are re-counted as dropped — never silently —
+        while metric snapshots go back in the pending map (they are
+        cumulative state, so the next successful flush carries them)."""
+        batch = self._drain()
+        if batch is None:
+            return True
+        self._flushes += 1
+        if _send_batch(batch):
+            return True
+        lost = len(batch["events"]) + len(batch["spans"]) + batch["dropped"]
+        with self._lock:
+            for name, snap in batch["metrics"].items():
+                self._metrics.setdefault(name, snap)  # newer snapshot wins
+            self._dropped_pending += lost
+            self._dropped_total += lost - batch["dropped"]
+        return False
+
+    def ensure_flusher(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        t = threading.Thread(
+            target=self._run, name="ray_tpu-telemetry", daemon=True
+        )
+        self._thread = t
+        t.start()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def _interval_s(self) -> float:
+        rt = _runtime()
+        cfg = getattr(rt, "config", None)
+        ms = getattr(cfg, "metrics_report_interval_ms", _DEFAULT_INTERVAL_MS)
+        return max(0.01, (ms or _DEFAULT_INTERVAL_MS) / 1000.0)
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(self._interval_s())
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception:
+                pass  # telemetry must never take a process down
+
+
+def _send_batch(batch: dict) -> bool:
+    rt = _runtime()
+    if rt is None or getattr(rt, "closed", False):
+        return False
+    try:
+        scheduler = getattr(rt, "scheduler", None)
+        if scheduler is not None:  # in-process driver: post straight to loop
+            scheduler.post(("telemetry_batch", batch))
+        else:  # worker / remote driver: ride the command pipe (FIFO with
+            # task_done, so a task's telemetry lands before its result)
+            rt._send(("cmd", ("telemetry_batch", batch)))
+        return True
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------
+# per-process singleton surface
+# --------------------------------------------------------------------------
+
+_buffer = TelemetryBuffer()
+
+
+def get_buffer() -> TelemetryBuffer:
+    return _buffer
+
+
+def record_task_event(ev: dict) -> None:
+    if not enabled():
+        return
+    _buffer.record_event(ev)
+    _buffer.ensure_flusher()
+
+
+def record_span(span: dict) -> None:
+    if not enabled():
+        return
+    _buffer.record_span(span)
+    _buffer.ensure_flusher()
+
+
+def record_metric(name: str, kind: str, description: str, data: dict) -> None:
+    if not enabled():
+        return
+    _buffer.record_metric(name, kind, description, data)
+    _buffer.ensure_flusher()
+
+
+def flush() -> bool:
+    """Synchronously flush this process's buffer (read paths, shutdown)."""
+    return _buffer.flush()
+
+
+def dropped_total() -> int:
+    return _buffer.dropped_total
+
+
+# --------------------------------------------------------------------------
+# chrome-trace construction (ray_tpu.timeline backend)
+# --------------------------------------------------------------------------
+
+# lifecycle chain in causal order; phase names label the span ENDING at the
+# named state (SUBMITTED->QUEUED = dependency wait, etc.)
+_LIFECYCLE_ORDER = [
+    "SUBMITTED",
+    "QUEUED",
+    "DISPATCHED",
+    "RUNNING",
+    "FINISHED",
+    "FAILED",
+]
+_PHASE_NAME = {
+    "QUEUED": "deps",
+    "DISPATCHED": "queued",
+    "RUNNING": "dispatch",
+    "FINISHED": "run",
+    "FAILED": "run",
+}
+
+
+def build_chrome_trace(events: List[dict]) -> List[dict]:
+    """Convert the scheduler's merged task-event log into a chrome://tracing
+    event array: per-task lifecycle phase spans ("X"), instant markers for
+    every raw state transition ("i"), PROFILE spans, trace-context flow
+    links ("s"/"f"), and process/thread metadata ("M").
+
+    tids come from a stable first-seen registry (the seed's
+    ``hash(task_id) % 1000`` collided and changed across runs with hash
+    randomization). Every event carries ``args.state`` so consumers can
+    filter uniformly.
+    """
+    head_pid = os.getpid()
+    tids: Dict[str, int] = {}
+
+    def tid_of(task_id) -> int:
+        return tids.setdefault(task_id or "<driver>", len(tids) + 1)
+
+    out: List[dict] = []
+    by_task: Dict[str, List[dict]] = collections.defaultdict(list)
+    # span_id -> (pid, tid, ts_us) for trace-context flow binding
+    span_anchor: Dict[str, Tuple[int, int, float]] = {}
+    flow_links: List[Tuple[str, str]] = []  # (parent span_id, child span_id)
+
+    for e in events:
+        task_id = e.get("task_id")
+        tid = tid_of(task_id)
+        if e.get("type") == "PROFILE":
+            extra = e.get("extra") or {}
+            pid = e.get("pid") or head_pid
+            ts_us = (e.get("time") or 0.0) * 1e6
+            out.append(
+                {
+                    "cat": "PROFILE",
+                    "name": e.get("name", "span"),
+                    "pid": pid,
+                    "tid": tid,
+                    "ph": "X",
+                    "ts": ts_us,
+                    "dur": (e.get("duration_ms") or 0.0) * 1e3,
+                    "args": {"state": "PROFILE", "task_id": task_id, **extra},
+                }
+            )
+            span_id = extra.get("span_id")
+            if span_id:
+                span_anchor.setdefault(span_id, (pid, tid, ts_us))
+                if extra.get("parent_id"):
+                    flow_links.append((extra["parent_id"], span_id))
+            continue
+        by_task[task_id].append(e)
+        out.append(
+            {
+                "cat": e.get("type", "TASK"),
+                "name": e.get("name") or "task",
+                "pid": e.get("pid") or head_pid,
+                "tid": tid,
+                "ph": "i",
+                "s": "t",
+                "ts": (e.get("time") or 0.0) * 1e6,
+                "args": {"state": e.get("state"), "task_id": task_id},
+            }
+        )
+
+    # lifecycle phase spans: for each task, one "X" per consecutive pair of
+    # recorded states; worker-reported events (src=worker, real pid) win
+    # over the scheduler's head-side record of the same state
+    for task_id, evs in by_task.items():
+        best: Dict[str, dict] = {}
+        for e in evs:
+            state = e.get("state")
+            if state not in _PHASE_NAME and state != "SUBMITTED":
+                continue
+            cur = best.get(state)
+            e_worker = e.get("src") == "worker"
+            cur_worker = cur is not None and cur.get("src") == "worker"
+            if (
+                cur is None
+                or (e_worker and not cur_worker)
+                or (
+                    e_worker == cur_worker
+                    and (e.get("time") or 0.0) >= (cur.get("time") or 0.0)
+                )
+            ):
+                best[state] = e
+        chain = [s for s in _LIFECYCLE_ORDER if s in best]
+        tid = tid_of(task_id)
+        for prev_state, state in zip(chain, chain[1:]):
+            t0, t1 = best[prev_state]["time"], best[state]["time"]
+            ev = best[state]
+            out.append(
+                {
+                    "cat": "TASK_PHASE",
+                    "name": f"{ev.get('name') or 'task'}:{_PHASE_NAME.get(state, state.lower())}",
+                    "pid": ev.get("pid") or head_pid,
+                    "tid": tid,
+                    "ph": "X",
+                    "ts": t0 * 1e6,
+                    "dur": max(0.0, (t1 - t0) * 1e6),
+                    "args": {
+                        "state": state,
+                        "from": prev_state,
+                        "task_id": task_id,
+                    },
+                }
+            )
+
+    # trace-context parent links as chrome flow events (the visual arrows);
+    # args on the PROFILE spans carry the same ids for programmatic use
+    for parent_id, child_id in flow_links:
+        parent = span_anchor.get(parent_id)
+        child = span_anchor.get(child_id)
+        if parent is None or child is None:
+            continue
+        ppid, ptid, pts = parent
+        cpid, ctid, cts = child
+        out.append(
+            {
+                "cat": "trace",
+                "name": "trace_link",
+                "ph": "s",
+                "id": child_id,
+                "pid": ppid,
+                "tid": ptid,
+                "ts": pts,
+                "args": {"state": "TRACE"},
+            }
+        )
+        out.append(
+            {
+                "cat": "trace",
+                "name": "trace_link",
+                "ph": "f",
+                "bp": "e",
+                "id": child_id,
+                "pid": cpid,
+                "tid": ctid,
+                "ts": cts,
+                "args": {"state": "TRACE"},
+            }
+        )
+
+    # process metadata so chrome labels rows sensibly
+    pids = {e["pid"] for e in out if "pid" in e}
+    for pid in sorted(pids):
+        label = "driver+scheduler" if pid == head_pid else f"worker-{pid}"
+        out.append(
+            {
+                "cat": "__metadata",
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "ts": 0,
+                "args": {"state": "META", "name": label},
+            }
+        )
+    return out

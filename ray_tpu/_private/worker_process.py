@@ -277,6 +277,19 @@ class WorkerRuntime:
                         self._send(("stacks_reply", msg[1], format_thread_stacks()))
                     except (OSError, EOFError):
                         pass
+                elif kind == "flush_telemetry":
+                    # cluster-wide read-your-writes flush (timeline /
+                    # prometheus reads): drain the buffer NOW from this
+                    # reader thread — a busy task thread doesn't delay it.
+                    # The batch rides this same pipe before the ack (FIFO),
+                    # so the scheduler has merged it when the ack lands.
+                    from ray_tpu._private import telemetry
+
+                    try:
+                        telemetry.flush()
+                        self._send(("telemetry_ack", msg[1]))
+                    except (OSError, EOFError):
+                        pass
                 elif kind == "exit":
                     break
                 # unknown messages dropped
@@ -995,12 +1008,35 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
     pool: Optional[ThreadPoolExecutor] = None
 
+    from ray_tpu._private import telemetry
+
+    def _exec_event(spec, state: str, ts: float, duration_ms=None):
+        # worker-side lifecycle half of the telemetry plane: real pid +
+        # wall-clock execution bounds (the scheduler only knows when it
+        # SENT the task), and the only record at all for direct actor
+        # calls, which never touch the head. Batched by the buffer.
+        telemetry.record_task_event(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "type": spec.task_type.name,
+                "state": state,
+                "time": ts,
+                "pid": os.getpid(),
+                "src": "worker",
+                "duration_ms": duration_ms,
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            }
+        )
+
     def run_one(item, buffer_ok=False):
         if isinstance(item, _DirectCall):
             spec, reply = item.spec, item
         else:
             spec, reply = item, None
         rt._tls.direct_reply = reply
+        t0 = time.time()
+        _exec_event(spec, "RUNNING", t0)
         try:
             results = rt.execute(spec)
         except SystemExit:
@@ -1014,6 +1050,14 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
             return
         finally:
             rt._tls.direct_reply = None
+        t1 = time.time()
+        failed = bool(results) and results[0][0] == "error"
+        _exec_event(
+            spec,
+            "FAILED" if failed else "FINISHED",
+            t1,
+            duration_ms=(t1 - t0) * 1e3,
+        )
         if reply is not None:
             # large returns live in this node's store: register the location
             # at the head BEFORE the caller learns of them, so a borrower's
@@ -1075,6 +1119,10 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     finally:
         if pending_buf is not None:
             pending_buf.flush()
+        try:  # last telemetry batch out before the pipe closes
+            telemetry.flush()
+        except Exception:
+            pass
         if direct_server is not None:
             direct_server.close()
         if pool is not None:

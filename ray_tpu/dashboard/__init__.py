@@ -77,6 +77,12 @@ def start_dashboard(port: int = 8765) -> int:
                     from ray_tpu._private.worker import get_driver
 
                     body = get_driver().rpc("event_stats")
+                elif self.path == "/api/runtime_metrics":
+                    # scheduler internals as JSON series (the /metrics
+                    # Prometheus exposition carries the same data as text)
+                    from ray_tpu._private.worker import get_driver
+
+                    body = get_driver().rpc("runtime_metrics")
                 elif self.path == "/api/timeline":
                     body = ray_tpu.timeline()
                 elif self.path.startswith("/api/profiler/start"):

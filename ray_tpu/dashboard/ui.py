@@ -55,8 +55,9 @@ PAGE = """<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const TABS = ["overview","node_stats","tasks","actors","objects","placement_groups",
-              "serve","jobs","logs","event_stats","stacks","profile"];
+const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
+              "placement_groups","serve","jobs","logs","event_stats","stacks",
+              "profile"];
 let tab = location.hash.slice(1) || "overview";
 const $ = (id) => document.getElementById(id);
 
@@ -130,6 +131,17 @@ const RENDER = {
   async event_stats() {
     const s = await j("/api/event_stats");
     return "<pre>" + esc(JSON.stringify(s, null, 2)) + "</pre>";
+  },
+  async metrics() {
+    // runtime-internal series (telemetry plane); /metrics has the same
+    // data in Prometheus text for scrapers
+    const series = await j("/api/runtime_metrics");
+    return series.map(s => {
+      const rows = Object.entries(s.data || {}).map(([labels, v]) =>
+        ({labels, value: v}));
+      return `<h2>${esc(s.name)} <span class="meta">(${esc(s.kind)})</span></h2>` +
+        `<p class="meta">${esc(s.description || "")}</p>` + table(rows);
+    }).join("");
   },
   async stacks() {
     const s = await j("/api/stacks");

@@ -67,6 +67,30 @@ def _window() -> int:
     return max(1, DataContext.get_current().max_inflight_blocks)
 
 
+# per-operator throughput counters (parity: OpRuntimeMetrics exported by the
+# reference's metrics agent): block submissions/consumptions per stage ride
+# the batched telemetry plane into /metrics as
+# ray_tpu_data_blocks_{submitted,consumed}_total{stage=...}
+_op_metrics: dict = {}
+
+
+def _data_metrics() -> dict:
+    if not _op_metrics:
+        from ray_tpu.util.metrics import Counter
+
+        _op_metrics["submitted"] = Counter(
+            "ray_tpu_data_blocks_submitted_total",
+            "block tasks submitted per streaming-executor operator",
+            tag_keys=("stage",),
+        )
+        _op_metrics["consumed"] = Counter(
+            "ray_tpu_data_blocks_consumed_total",
+            "blocks consumed downstream per streaming-executor operator",
+            tag_keys=("stage",),
+        )
+    return _op_metrics
+
+
 def _windowed(submitted: Iterator, window: int, name: str = "stage",
               collector: Optional[List] = None) -> Iterator:
     """The backpressure core shared by every stage: pull (and thereby
@@ -82,6 +106,8 @@ def _windowed(submitted: Iterator, window: int, name: str = "stage",
     bp.track_stats(stats)
     if collector is not None:
         collector.append(stats)
+    metrics = _data_metrics()
+    tags = {"stage": name}
     pending = stats.pending
     exhausted = False
     while True:
@@ -93,6 +119,7 @@ def _windowed(submitted: Iterator, window: int, name: str = "stage",
                 break
             pending.append(ref)
             stats.submitted += 1
+            metrics["submitted"].inc(tags=tags)
         if not pending:
             if exhausted:
                 return
@@ -104,10 +131,12 @@ def _windowed(submitted: Iterator, window: int, name: str = "stage",
                 return
             pending.append(ref)
             stats.submitted += 1
+            metrics["submitted"].inc(tags=tags)
         ref = pending.popleft()
         stats._size_cache.pop(ref.id(), None)
         stats.consumed += 1
         stats.last_consumed_at = time.monotonic()
+        metrics["consumed"].inc(tags=tags)
         yield ref
 
 

@@ -113,13 +113,19 @@ def cmd_memory(args):
 
 def cmd_timeline(args):
     import ray_tpu
+    from ray_tpu.util import state
 
     _init(args)
-    events = ray_tpu.timeline()
     out = args.output or "timeline.json"
-    with open(out, "w") as fh:
-        json.dump(events, fh)
+    events = ray_tpu.timeline(filename=out)
     print(f"wrote {len(events)} events to {out} (chrome://tracing)")
+    # summarize_tasks-backed digest so the trace has headline numbers
+    summary = state.summarize_tasks()
+    if summary:
+        print("task summary (name: state counts):")
+        for name, counts in sorted(summary.items()):
+            states = " ".join(f"{s}={n}" for s, n in sorted(counts.items()))
+            print(f"  {name}: {states}")
 
 
 def cmd_job(args):

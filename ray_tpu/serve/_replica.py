@@ -21,6 +21,35 @@ import ray_tpu
 
 _request_ctx = threading.local()
 
+# replica-side telemetry (parity: serve's autoscaling/latency metrics,
+# ray_serve_replica_processing_queries / ray_serve_deployment_processing_
+# latency_ms). Lazy module-level singletons: records are local dict updates
+# batched by the telemetry plane — cheap enough for the request hot path.
+_metrics: dict = {}
+
+
+def _replica_metrics() -> dict:
+    if not _metrics:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _metrics["queue_depth"] = Gauge(
+            "ray_tpu_serve_replica_queue_depth",
+            "queued + running requests on one replica (autoscaling metric)",
+            tag_keys=("deployment",),
+        )
+        _metrics["latency"] = Histogram(
+            "ray_tpu_serve_request_latency_ms",
+            "end-to-end request execution latency per deployment",
+            boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000],
+            tag_keys=("deployment", "method"),
+        )
+        _metrics["requests"] = Counter(
+            "ray_tpu_serve_requests_total",
+            "requests executed per deployment",
+            tag_keys=("deployment", "method"),
+        )
+    return _metrics
+
 
 def get_multiplexed_model_id() -> str:
     """Parity: ``serve.get_multiplexed_model_id`` — valid inside a request."""
@@ -85,7 +114,8 @@ def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
 @ray_tpu.remote
 class Replica:
     def __init__(self, callable_blob: bytes, init_args, init_kwargs,
-                 max_ongoing: int = 8, user_config=None):
+                 max_ongoing: int = 8, user_config=None, deployment: str = ""):
+        self._deployment = deployment
         # nested DeploymentHandles (model composition) arrive pre-resolved
         # inside init_args/kwargs
         target = cloudpickle.loads(callable_blob)
@@ -116,6 +146,8 @@ class Replica:
     def _enter(self, model_id: str):
         with self._ongoing_lock:
             self._ongoing += 1
+            depth = self._ongoing
+        self._record_depth(depth)
         self._gate.acquire()
         _request_ctx.multiplexed_model_id = model_id
 
@@ -124,6 +156,25 @@ class Replica:
         _request_ctx.multiplexed_model_id = ""
         with self._ongoing_lock:
             self._ongoing -= 1
+            depth = self._ongoing
+        self._record_depth(depth)
+
+    def _record_depth(self, depth: int) -> None:
+        try:
+            _replica_metrics()["queue_depth"].set(
+                float(depth), tags={"deployment": self._deployment}
+            )
+        except Exception:
+            pass  # metrics never fail a request
+
+    def _record_latency(self, method: str, seconds: float) -> None:
+        try:
+            tags = {"deployment": self._deployment, "method": method}
+            m = _replica_metrics()
+            m["latency"].observe(seconds * 1e3, tags=tags)
+            m["requests"].inc(tags=tags)
+        except Exception:
+            pass
 
     def is_asgi(self) -> bool:
         """Whether this deployment mounts an ASGI app (serve.ingress)."""
@@ -148,12 +199,16 @@ class Replica:
             return (self._direct_host, srv.port)
 
     def handle_request(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
+        import time as _time
+
         self._enter(model_id)
+        t0 = _time.perf_counter()
         try:
             if method == "__call__":
                 return self._callable(*args, **kwargs)
             return getattr(self._callable, method)(*args, **kwargs)
         finally:
+            self._record_latency(method, _time.perf_counter() - t0)
             self._exit()
 
     def handle_request_streaming(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
@@ -161,7 +216,10 @@ class Replica:
         (parity: streaming responses, _private/proxy_response_generator.py).
         The reserved ``__asgi__`` method drives the mounted ASGI app and
         streams its response events."""
+        import time as _time
+
         self._enter(model_id)
+        t0 = _time.perf_counter()
         try:
             if method == "__asgi__":
                 from ray_tpu.serve._asgi import run_asgi_request
@@ -181,6 +239,9 @@ class Replica:
             for item in fn(*args, **kwargs):
                 yield item
         finally:
+            # stream duration: entry to last yield (parity: serve counts a
+            # streaming response until its generator finishes)
+            self._record_latency(method, _time.perf_counter() - t0)
             self._exit()
 
     def handle_websocket(self, conn, scope) -> None:

@@ -180,7 +180,7 @@ class ServeController:
                 num_tpus=opts.get("num_tpus", 0.0),
                 resources=opts.get("resources"),
             ).remote(spec["callable_blob"], init_args, init_kwargs, max_ongoing,
-                     spec.get("user_config"))
+                     spec.get("user_config"), spec.get("name", ""))
             replicas.append(r)
         # wait until they respond (surface init errors early)
         ray_tpu.get([r.check_health.remote() for r in replicas], timeout=120)

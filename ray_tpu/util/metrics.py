@@ -1,36 +1,40 @@
 """Application metrics: Counter / Gauge / Histogram.
 
 Parity: ``python/ray/util/metrics.py`` + the metrics agent's Prometheus
-exposition (``python/ray/_private/metrics_agent.py:483``). Metrics recorded in
-any process are aggregated in the GCS KV (namespace ``metrics``) and exposed
-in Prometheus text format via :func:`prometheus_text`.
+exposition (``python/ray/_private/metrics_agent.py:483``). Records update a
+process-local shadow and ride the telemetry plane
+(``ray_tpu._private.telemetry``): the background flusher ships at most ONE
+snapshot per metric per ``metrics_report_interval_ms`` — the seed did a
+blocking KV RPC on *every* ``Counter.inc()`` and silently swallowed
+failures. The scheduler merges per-process snapshots (counters/histograms
+sum across processes, gauges last-writer-wins) into the GCS KV, and
+:func:`prometheus_text` exposes them plus the runtime-internal series
+(scheduler queue depth, handler event_stats, object-store usage, fastcopy
+stage bandwidth, telemetry drop counters) in Prometheus text format.
 """
 
 from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.worker import get_runtime
 
 _NS = "metrics"
 _lock = threading.Lock()
-# local shadow (flushed to GCS KV on record): name -> {labels_json: value}
+# local shadow (shipped in batches by the telemetry flusher): name ->
+# {labels_json: value}
 _local: Dict[str, Dict[str, object]] = {}
 
 
-def _flush(name: str, kind: str, description: str, data: Dict[str, object]):
-    try:
-        rt = get_runtime()
-        blob = json.dumps({"kind": kind, "description": description, "data": data}).encode()
-        if hasattr(rt, "scheduler_rpc"):
-            rt.scheduler_rpc("kv_put", (_NS, name.encode(), blob, True))
-        else:
-            rt.rpc("kv_put", _NS, name.encode(), blob, True)
-    except Exception:
-        pass  # metrics never break the app
+def _enqueue(name: str, kind: str, description: str, data: Dict[str, object]):
+    """Queue this metric's latest snapshot for the next batched flush (one
+    KV write per interval per metric, not per record). Loss is accounted by
+    ``ray_tpu_telemetry_dropped_total``, not swallowed."""
+    from ray_tpu._private import telemetry
+
+    telemetry.record_metric(name, kind, description, data)
 
 
 class _Metric:
@@ -56,7 +60,7 @@ class _Metric:
         with _lock:
             _local[self._name][key] = value
             snapshot = dict(_local[self._name])
-        _flush(self._name, self.KIND, self._description, snapshot)
+        _enqueue(self._name, self.KIND, self._description, snapshot)
 
 
 class Counter(_Metric):
@@ -105,32 +109,77 @@ class Histogram(_Metric):
         self._store(key, entry)
 
 
+def _sync_cluster_telemetry(rt) -> None:
+    """Read-your-writes for the batched pipeline: flush this process's
+    buffer, then ask the scheduler to pull every worker's (bounded wait).
+    Remote (socket-attached) drivers skip the cluster pull — their view may
+    lag one flush interval."""
+    from ray_tpu._private import telemetry
+
+    telemetry.flush()
+    scheduler = getattr(rt, "scheduler", None)
+    if scheduler is not None:
+        try:
+            scheduler.request_telemetry_flush()
+        except Exception:
+            pass
+
+
+def _format_series(lines: List[str], name: str, kind: str, description: str,
+                   data: Dict[str, object]) -> None:
+    lines.append(f"# HELP {name} {description}")
+    lines.append(f"# TYPE {name} {kind if kind != 'untyped' else 'gauge'}")
+    for labels_json, value in data.items():
+        labels = json.loads(labels_json) if labels_json.startswith("{") else {}
+        label_str = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        label_part = "{" + label_str + "}" if label_str else ""
+        if kind == "histogram" and isinstance(value, dict):
+            lines.append(f"{name}_count{label_part} {value['count']}")
+            lines.append(f"{name}_sum{label_part} {value['sum']}")
+            bounds = value.get("boundaries") or []
+            cumulative = 0
+            for b, n in zip(bounds, value.get("buckets", ())):
+                cumulative += n
+                le = "{" + ",".join(filter(None, [label_str, f'le="{b}"'])) + "}"
+                lines.append(f"{name}_bucket{le} {cumulative}")
+            le_inf = "{" + ",".join(filter(None, [label_str, 'le="+Inf"'])) + "}"
+            lines.append(f"{name}_bucket{le_inf} {value['count']}")
+        else:
+            lines.append(f"{name}{label_part} {value}")
+
+
 def prometheus_text() -> str:
-    """All recorded metrics in Prometheus exposition format (driver-side)."""
+    """All recorded metrics — application (GCS KV aggregated) plus the
+    scheduler's runtime-internal series — in Prometheus exposition format."""
     rt = get_runtime()
+    _sync_cluster_telemetry(rt)
     if hasattr(rt, "scheduler_rpc"):
         keys = rt.scheduler_rpc("kv_keys", (_NS, b""))
         get = lambda k: rt.scheduler_rpc("kv_get", (_NS, k))  # noqa: E731
+        runtime_series = rt.scheduler_rpc("runtime_metrics", ())
     else:
         keys = rt.rpc("kv_keys", _NS, b"")
         get = lambda k: rt.rpc("kv_get", _NS, k)  # noqa: E731
-    lines = []
-    for key in keys:
+        runtime_series = rt.rpc("runtime_metrics")
+    lines: List[str] = []
+    for key in sorted(keys):
         raw = get(key)
         if raw is None:
             continue
         payload = json.loads(raw)
-        name = key.decode()
-        kind = payload["kind"]
-        lines.append(f"# HELP {name} {payload.get('description', '')}")
-        lines.append(f"# TYPE {name} {kind if kind != 'untyped' else 'gauge'}")
-        for labels_json, value in payload["data"].items():
-            labels = json.loads(labels_json)
-            label_str = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-            label_part = "{" + label_str + "}" if label_str else ""
-            if kind == "histogram" and isinstance(value, dict):
-                lines.append(f"{name}_count{label_part} {value['count']}")
-                lines.append(f"{name}_sum{label_part} {value['sum']}")
-            else:
-                lines.append(f"{name}{label_part} {value}")
+        _format_series(
+            lines,
+            key.decode(),
+            payload["kind"],
+            payload.get("description", ""),
+            payload["data"],
+        )
+    for series in runtime_series or ():
+        _format_series(
+            lines,
+            series["name"],
+            series.get("kind", "gauge"),
+            series.get("description", ""),
+            series.get("data", {}),
+        )
     return "\n".join(lines) + "\n"

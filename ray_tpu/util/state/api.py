@@ -14,45 +14,70 @@ def _rpc(op: str, *args):
     return rt.rpc(op, *args)
 
 
+def _compare(op: str, have, value) -> bool:
+    """One filter predicate. ``=``/``!=`` compare raw; the ordering
+    operators compare numerically (parity: the reference state API's
+    ``<``/``>``/``<=``/``>=`` on numeric columns) and a non-numeric or
+    missing field never matches an ordering filter."""
+    if op == "=":
+        return have == value
+    if op == "!=":
+        return have != value
+    if op not in ("<", ">", "<=", ">="):
+        raise ValueError(f"unsupported filter operator {op!r}")
+    try:
+        a, b = float(have), float(value)
+    except (TypeError, ValueError):
+        return False
+    if op == "<":
+        return a < b
+    if op == ">":
+        return a > b
+    if op == "<=":
+        return a <= b
+    return a >= b
+
+
 def _filtered(rows: List[dict], filters) -> List[dict]:
     if not filters:
         return rows
-    out = []
-    for row in rows:
-        ok = True
-        for key, op, value in filters:
-            have = row.get(key)
-            if op == "=" and have != value:
-                ok = False
-            elif op == "!=" and have == value:
-                ok = False
-        if ok:
-            out.append(row)
-    return out
+    return [
+        row
+        for row in rows
+        if all(_compare(op, row.get(key), value) for key, op, value in filters)
+    ]
+
+
+def _list(op: str, filters, limit: int) -> List[dict]:
+    # limit is pushed INTO the rpc: the server truncates at the source, so
+    # a LIMIT-10 query against a 10k-task cluster never serializes 10k
+    # rows. Client-side filters then apply to the capped fetch (same
+    # contract as the reference: limit bounds rows *examined*).
+    return _filtered(_rpc(op, limit), filters)[:limit]
 
 
 def list_tasks(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_tasks"), filters)[:limit]
+    return _list("list_tasks", filters, limit)
 
 
 def list_actors(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_actors"), filters)[:limit]
+    return _list("list_actors", filters, limit)
 
 
 def list_workers(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_workers"), filters)[:limit]
+    return _list("list_workers", filters, limit)
 
 
 def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_nodes"), filters)[:limit]
+    return _list("list_nodes", filters, limit)
 
 
 def list_objects(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_objects"), filters)[:limit]
+    return _list("list_objects", filters, limit)
 
 
 def list_placement_groups(filters=None, limit: int = 10_000) -> List[dict]:
-    return _filtered(_rpc("list_placement_groups"), filters)[:limit]
+    return _list("list_placement_groups", filters, limit)
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:

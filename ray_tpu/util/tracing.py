@@ -114,3 +114,11 @@ def extract_and_activate(runtime_env: Optional[dict]) -> Optional[TraceContext]:
 
 def deactivate() -> None:
     _set_current_context(None)
+
+
+def context_args() -> Dict[str, str]:
+    """The active context as chrome-trace/span args ({} when untraced) —
+    the telemetry plane stamps these onto profile spans so timeline
+    consumers can rebuild the parent-linked tree across processes."""
+    ctx = get_current_context()
+    return ctx.to_dict() if ctx is not None else {}
