@@ -80,6 +80,29 @@ class _Actor:
 
 
 @ray_tpu.remote
+def _print_heavy(n: int):
+    """Log-plane load shape: one task, n log lines. Prints to stderr so the
+    driver-side echo never interleaves with the bench's stdout JSON."""
+    import sys
+
+    for i in range(n):
+        print(f"bench-log-line-{i}", file=sys.stderr)
+    return None
+
+
+@ray_tpu.remote
+def _print_burst(n: int):
+    """Worker-local per-line timing: n prints, returns elapsed seconds."""
+    import sys
+    import time as _t
+
+    t0 = _t.perf_counter()
+    for _ in range(n):
+        print("bench-burst-line", file=sys.stderr)
+    return _t.perf_counter() - t0
+
+
+@ray_tpu.remote
 class _PutClient:
     """One concurrent putter for the multi-client put shape (parity:
     ray_perf's multi_client_put_gigabytes worker actors)."""
@@ -273,6 +296,82 @@ def main():
             {
                 "metric": "telemetry_overhead_pct",
                 "value": round(overhead_pct, 2),
+                "unit": "%",
+                "budget_pct": 5.0,
+            }
+        ),
+        flush=True,
+    )
+
+    # --- log-plane overhead (tracked budget: structured logs <= 5%) ---
+    # print-heavy task loop (10 lines/task) with log_to_driver on vs off:
+    # "on" pays the tee + per-line tagging + batched shipping + head-side
+    # echo/persist; "off" has no tee installed at all. Alternating pairs +
+    # medians because fresh-cluster throughput swings 2x+ on small shared
+    # boxes; the per-line burst microbench below is the stable signal.
+    import statistics
+
+    logp = {True: [], False: []}
+    line_us = {}
+    for _ in range(3 if not args.quick else 1):
+        for flag in (True, False):
+            ray_tpu.shutdown()
+            ray_tpu.init(
+                num_cpus=args.num_cpus,
+                ignore_reinit_error=True,
+                log_to_driver=flag,
+                # "off" = whole log plane off (no tee): persistence alone
+                # would otherwise keep the tee installed
+                _system_config={"persist_worker_logs": flag},
+            )
+            ray_tpu.get([_noop.remote() for _ in range(20)], timeout=60)
+
+            def print_tasks():
+                ray_tpu.get(
+                    [_print_heavy.remote(10) for _ in range(50)], timeout=120
+                )
+
+            _, v = timeit(
+                "print_heavy_tasks_log",
+                print_tasks,
+                multiplier=50,
+                duration=duration,
+            )
+            logp[flag].append(v)
+            # per-line cost INSIDE one worker (20k-line burst): within-
+            # process, so box-level throughput noise divides out
+            t = ray_tpu.get(_print_burst.remote(20_000), timeout=120)
+            line_us.setdefault(flag, []).append(t / 20_000 * 1e6)
+    for flag, label in ((True, "on"), (False, "off")):
+        rows.append(
+            report(
+                f"print_heavy_tasks_log_to_driver_{label}",
+                statistics.median(logp[flag]),
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"log_line_cost_us_log_to_driver_{label}",
+                    "value": round(statistics.median(line_us[flag]), 2),
+                    "unit": "us/line",
+                }
+            ),
+            flush=True,
+        )
+    log_overhead_pct = (
+        1 - statistics.median(logp[True]) / statistics.median(logp[False])
+    ) * 100
+    line_overhead_pct = (
+        statistics.median(line_us[True]) / statistics.median(line_us[False])
+        - 1
+    ) * 100
+    print(
+        json.dumps(
+            {
+                "metric": "log_plane_overhead_pct",
+                "value": round(log_overhead_pct, 2),
+                "per_line_overhead_pct": round(line_overhead_pct, 2),
                 "unit": "%",
                 "budget_pct": 5.0,
             }

@@ -115,6 +115,24 @@ class Config:
     # profile spans, batched metrics, scheduler task-event log); off trades
     # observability for the last few percent of small-task throughput
     telemetry_enabled: bool = True
+    # --- failure forensics (cluster event log, watchdogs) ---
+    # bound on the scheduler's structured cluster-event log (WORKER_DIED,
+    # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest
+    cluster_event_log_max: int = 10_000
+    # persist worker stdout/stderr (structured log records) into
+    # <session>/logs/worker-*.out|.err so list_logs/get_log see them
+    persist_worker_logs: bool = True
+    # straggler watchdog: a RUNNING task is flagged (WARN event +
+    # ray_tpu_stragglers_total) once its elapsed time exceeds
+    # factor x p95 of its function's completed runtimes — needs at least
+    # min_samples completions, and never fires under min_runtime_s
+    straggler_detect_factor: float = 10.0
+    straggler_min_samples: int = 5
+    straggler_min_runtime_s: float = 5.0
+    # driver-side hung-get watchdog: a get() blocked past this many seconds
+    # prints a digest of the pending task chain (states, workers) and
+    # records a HUNG_GET event; 0 disables
+    hung_get_warn_s: float = 60.0
     # --- misc ---
     session_dir_root: str = "/tmp/ray_tpu_sessions"
     log_to_driver: bool = True

@@ -105,8 +105,23 @@ def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
         _, _, victim = candidates[0]
         try:
             victim.proc.terminate()
-            return True
         except Exception:
             return False
+        try:
+            # forensics only: must not flip the kill verdict — a False here
+            # would make the monitor escalate onto a second worker while
+            # the first is already dying
+            scheduler.record_cluster_event(
+                "OOM",
+                f"memory monitor killed worker {victim.worker_id.hex()[:12]} "
+                f"(pid {victim.proc.pid}) to relieve node memory pressure",
+                severity="ERROR",
+                worker_id=victim.worker_id.hex(),
+                node_id=victim.node_id.hex(),
+                pid=victim.proc.pid,
+            )
+        except Exception:
+            pass
+        return True
 
     return kill

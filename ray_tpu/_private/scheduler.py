@@ -318,6 +318,13 @@ class TaskRecord:
     submit_time: float = field(default_factory=time.monotonic)
     start_time: Optional[float] = None
     end_time: Optional[float] = None
+    # failure forensics: how many times this task was handed to a worker,
+    # and — when it errored — what failed, where (filled by the scheduler;
+    # surfaced in list_tasks rows and linked from TASK_FAILED events)
+    attempt: int = 0
+    error_type: Optional[str] = None
+    error_pid: Optional[int] = None
+    error_node: Optional[str] = None
 
 
 @dataclass
@@ -440,6 +447,32 @@ class Scheduler:
         # the TTL is constant, so expiry only ever pops from the left
         self._transit_pins: collections.deque = collections.deque()
         self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
+        # ---- failure-forensics plane ----
+        # structured cluster events (WORKER_DIED, NODE_DEAD, TASK_RETRY,
+        # TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ...);
+        # deque append is atomic, so record_cluster_event is callable from
+        # any thread (memory monitor, driver watchdogs)
+        self._cluster_events: Deque[dict] = collections.deque(
+            maxlen=getattr(config, "cluster_event_log_max", 10_000)
+        )
+        self._cluster_event_seq = 0
+        self._cluster_event_counts: Dict[str, int] = {}
+        # guards seq/counts: events arrive from the loop AND from other
+        # threads (memory monitor, driver watchdog rpcs)
+        self._cluster_event_lock = threading.Lock()
+        # per-function completed runtimes (bounded) feeding the straggler
+        # watchdog's p95; flagged set keyed (task_id, attempt) so a retry
+        # can be re-flagged but one attempt fires at most once
+        self._func_runtimes: Dict[str, Deque[float]] = {}
+        self._straggler_flagged: Set[Tuple[TaskID, int]] = set()
+        # tasks that entered RUNNING and have not been observed settled:
+        # the straggler scan walks THIS set (pruning settled ids lazily),
+        # not the never-pruned self.tasks table — O(running), not O(ever)
+        self._running_watch: Set[TaskID] = set()
+        self._straggler_count = 0
+        self._last_straggler_scan = time.monotonic()
+        # persisted worker-log files: filename -> open handle (bounded)
+        self._log_files: Dict[str, Any] = {}
         # ---- telemetry plane (merged TelemetryBuffer batches) ----
         # metric aggregation across processes: name -> {kind, description,
         # per_proc: {pid: data}}; the merged view is written to the GCS KV
@@ -755,6 +788,7 @@ class Scheduler:
                 if rec is not None and rec.state == "LEASED":
                     rec.state = "RUNNING"
                     rec.start_time = time.monotonic()
+                    self._running_watch.add(tid)
                     self._record_event(rec.spec, "RUNNING", ts=started_ts)
         elif kind == "lease_revoked":
             nid = self._daemon_conns.get(conn)
@@ -795,6 +829,12 @@ class Scheduler:
             pass
         if nid is not None:
             logger.warning("node daemon %s disconnected; removing node", nid.hex()[:8])
+            self.record_cluster_event(
+                "NODE_DEAD",
+                f"node {nid.hex()[:12]} daemon disconnected or missed heartbeats",
+                severity="ERROR",
+                node_id=nid.hex(),
+            )
             for locs in self._object_locations.values():
                 locs.discard(nid)
             self._lease_budget_sent.pop(nid, None)
@@ -875,23 +915,26 @@ class Scheduler:
                     ),
                 )
         elif kind == "log":
-            # worker stdout/stderr forwarded to the driver (log_to_driver;
-            # parity: python/ray/_private/log_monitor.py)
-            if self.config.log_to_driver:
-                _, stream, pid, line = msg
-                name = ""
-                if w.current_task is not None:
-                    rec = self.tasks.get(w.current_task)
-                    if rec is not None:
-                        name = rec.spec.name or ""
-                try:
-                    import sys as _sys
-
-                    out = _sys.stderr if stream == "stderr" else _sys.stdout
-                    out.write(f"({name or 'worker'} pid={pid}) {line}\n")
-                    out.flush()
-                except Exception:
-                    pass
+            # legacy per-line worker stdout/stderr (telemetry disabled);
+            # parity: python/ray/_private/log_monitor.py. Routed through the
+            # same echo+persist path as structured batches.
+            _, stream, pid, line = msg
+            name = None
+            if w.current_task is not None:
+                trec = self.tasks.get(w.current_task)
+                if trec is not None:
+                    name = trec.spec.name
+            self._handle_log_record(
+                {
+                    "time": time.time(),
+                    "stream": stream,
+                    "pid": pid,
+                    "line": line,
+                    "task_name": name,
+                    "task_id": w.current_task.hex() if w.current_task else None,
+                },
+                holder=wid,
+            )
         elif kind == "cmd":
             # holder: ref borrows from this worker are attributed to it so
             # a crashed borrower's refs get released, not leaked
@@ -1178,6 +1221,15 @@ class Scheduler:
             rec.spec.name or oid.task_id().hex()[:8],
             rec.retries_left,
         )
+        self.record_cluster_event(
+            "OBJECT_LOST",
+            f"every copy of {oid.hex()[:16]} was lost; reconstructing via "
+            f"re-execution of {rec.spec.name or oid.task_id().hex()[:12]}",
+            severity="WARNING",
+            object_id=oid.hex(),
+            task_id=rec.spec.task_id.hex(),
+            retries_left=rec.retries_left,
+        )
         # evict lost returns so consumers wait for the recomputation
         for ret in rec.spec.return_ids():
             if not self._object_locations.get(ret) and not self._node.store_client.contains(ret):
@@ -1261,6 +1313,13 @@ class Scheduler:
             self._dispatch_dirty = True
             node: NodeState = cmd[1]
             self.nodes[node.node_id] = node
+            self.record_cluster_event(
+                "NODE_ADDED",
+                f"node {node.node_id.hex()[:12]} joined "
+                f"(total={dict(node.total)})",
+                source="AUTOSCALER",
+                node_id=node.node_id.hex(),
+            )
             self._retry_pending_pgs()
         elif kind == "remove_node":
             self._on_remove_node(cmd[1])
@@ -1293,6 +1352,12 @@ class Scheduler:
             self._daemon_send_locks[conn] = threading.Lock()
             self._sel_register(conn)
             ns.last_heartbeat = time.monotonic()
+            self.record_cluster_event(
+                "NODE_ADDED",
+                f"node {ns.node_id.hex()[:12]} registered its daemon",
+                source="AUTOSCALER",
+                node_id=ns.node_id.hex(),
+            )
             # a re-registering daemon restarted its local dispatcher (and
             # killed its workers): requeue whatever was leased to it, and
             # forget the budget we last sent so the fresh one goes out
@@ -1585,6 +1650,10 @@ class Scheduler:
                 self._write_gcs_snapshot()
             except Exception:
                 logger.exception("gcs snapshot failed")
+        try:
+            self._maybe_detect_stragglers()
+        except Exception:
+            logger.exception("straggler scan failed")
         if self._daemon_conns and now0 - self._last_budget_sync > 0.5:
             self._last_budget_sync = now0
             self._sync_lease_budgets()
@@ -1926,6 +1995,8 @@ class Scheduler:
         rec.state = "RUNNING"
         rec.worker_id = wid
         rec.start_time = time.monotonic()
+        rec.attempt += 1
+        self._running_watch.add(rec.spec.task_id)
         w.current_task = rec.spec.task_id
         if rec.spec.task_type == TaskType.ACTOR_CREATION:
             actor = self.actors[rec.spec.actor_id]
@@ -1978,6 +2049,7 @@ class Scheduler:
             self._lease_backlog[node.node_id].append(spec.task_id)
         rec.state = "LEASED"
         rec.worker_id = None
+        rec.attempt += 1
         self._leased[spec.task_id] = (node.node_id, acquired, dict(spec.resources))
         self._lease_count_by_node[node.node_id] += 1
         self._lease_batch.setdefault(node.node_id, []).append(spec)
@@ -2223,11 +2295,21 @@ class Scheduler:
             ):
                 rec.retries_left -= 1
                 self._record_event(spec, "RETRY")
+                self._record_task_retry(rec, "application exception matched retry_exceptions")
                 self._make_schedulable(rec)
                 continue
             rec.state = "FINISHED"
             rec.end_time = time.monotonic()
             self._record_event(spec, "FINISHED")
+            if results and results[0][0] == "error":
+                self._note_task_error(
+                    rec,
+                    results[0],
+                    self.workers.get(rec.worker_id),
+                    node_hint=nid.hex(),
+                )
+            else:
+                self._note_task_runtime(rec)
             for i, entry in enumerate(results):
                 oid = ObjectID.for_return(spec.task_id, i)
                 if entry[0] == "stored":
@@ -2260,6 +2342,7 @@ class Scheduler:
             rec.worker_id = None
             self._pending.append(tid)
             self._dispatch_dirty = True
+            self._record_task_retry(rec, "lease worker died")
         else:
             self._fail_task(
                 rec,
@@ -2354,6 +2437,16 @@ class Scheduler:
         if node is not None:
             node.lease_acquired.clear()
         doomed = [tid for tid, info in self._leased.items() if info[0] == nid]
+        if doomed:
+            self.record_cluster_event(
+                "LEASE_FAILED",
+                f"node {nid.hex()[:12]} lost its lease batch; requeuing "
+                f"{len(doomed)} leased tasks",
+                severity="WARNING",
+                node_id=nid.hex(),
+                tasks=len(doomed),
+                consume_retry=consume_retry,
+            )
         for tid in doomed:
             info = self._lease_pop(tid)
             if info[1] and node is not None and node.alive:
@@ -2409,6 +2502,8 @@ class Scheduler:
                 rec.state = "RUNNING"
                 rec.worker_id = actor.worker_id
                 rec.start_time = time.monotonic()
+                rec.attempt += 1
+                self._running_watch.add(rec.spec.task_id)
                 self._record_event(rec.spec, "DISPATCHED")
                 self._record_event(rec.spec, "RUNNING")
                 try:
@@ -2443,6 +2538,7 @@ class Scheduler:
         ):
             rec.retries_left -= 1
             self._record_event(spec, "RETRY")
+            self._record_task_retry(rec, "application exception matched retry_exceptions")
             if w.state in ("busy", "blocked"):
                 self._release_resources(w)
                 w.current_task = None
@@ -2455,6 +2551,10 @@ class Scheduler:
             rec.state = "FINISHED"
             rec.end_time = time.monotonic()
             self._record_event(rec.spec, "FINISHED")
+            if results and results[0][0] == "error":
+                self._note_task_error(rec, results[0], w)
+            else:
+                self._note_task_runtime(rec)
             if spec is not None and spec.task_type == TaskType.ACTOR_TASK:
                 self._actor_task_settled(spec.actor_id)
         # commit each return
@@ -2633,6 +2733,25 @@ class Scheduler:
         rec.state = "FAILED"
         rec.end_time = time.monotonic()
         self._record_event(rec.spec, "FAILED")
+        rec.error_type = type(error).__name__
+        if rec.error_node is None and rec.worker_id is not None:
+            w = self.workers.get(rec.worker_id)
+            if w is not None:
+                rec.error_node = w.node_id.hex()
+                if w.proc is not None:
+                    rec.error_pid = w.proc.pid
+        self.record_cluster_event(
+            "TASK_FAILED",
+            f"task {rec.spec.name or rec.spec.task_id.hex()[:16]} failed: "
+            f"{rec.error_type}: {error}",
+            severity="ERROR",
+            task_id=rec.spec.task_id.hex(),
+            name=rec.spec.name,
+            error_type=rec.error_type,
+            attempt=rec.attempt,
+            node_id=rec.error_node,
+            pid=rec.error_pid,
+        )
         blob = pickle.dumps(error)
         for oid in rec.spec.return_ids():
             self._commit_result(oid, ("error", blob))
@@ -2670,6 +2789,25 @@ class Scheduler:
             )
         w.state = "dead"
         w.dead_since = time.monotonic()
+        dead_pid = w.proc.pid if w.proc is not None else None
+        running_name = None
+        if w.current_task is not None:
+            trec = self.tasks.get(w.current_task)
+            if trec is not None:
+                running_name = trec.spec.name
+        self.record_cluster_event(
+            "WORKER_DIED",
+            f"worker {wid.hex()[:12]} "
+            + ("exited" if graceful else "died unexpectedly")
+            + (f" while running {running_name}" if running_name and not graceful else ""),
+            severity="INFO" if graceful else "ERROR",
+            worker_id=wid.hex(),
+            node_id=w.node_id.hex(),
+            pid=dead_pid,
+            actor_id=w.actor_id.hex() if w.actor_id else None,
+            task_id=w.current_task.hex() if w.current_task else None,
+            graceful=graceful,
+        )
         if self._conn_to_worker.pop(w.conn, None) is not None:
             self._sel_unregister(w.conn)
         try:
@@ -2703,11 +2841,15 @@ class Scheduler:
         if w.current_task is not None:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
+                # provenance: where the attempt died, whatever happens next
+                rec.error_node = w.node_id.hex()
+                rec.error_pid = dead_pid
                 if not graceful and rec.retries_left > 0 and rec.spec.task_type == TaskType.NORMAL_TASK:
                     rec.retries_left -= 1
                     rec.state = "PENDING"
                     rec.worker_id = None
                     self._pending.append(rec.spec.task_id)
+                    self._record_task_retry(rec, "worker died")
                 elif not graceful:
                     self._fail_task(
                         rec,
@@ -3065,17 +3207,29 @@ class Scheduler:
             pg = self.placement_groups.get(args[0])
             return None if pg is None else pg.state
         if op == "list_tasks":
-            rows = [
-                {
+            def _task_row(t: TaskRecord) -> dict:
+                w = self.workers.get(t.worker_id) if t.worker_id else None
+                node = t.error_node
+                pid = t.error_pid
+                if w is not None:
+                    node = node or w.node_id.hex()
+                    if pid is None and w.proc is not None:
+                        pid = w.proc.pid
+                return {
                     "task_id": t.spec.task_id.hex(),
                     "name": t.spec.name,
                     "type": t.spec.task_type.name,
                     "state": t.state,
                     "worker_id": t.worker_id.hex() if t.worker_id else None,
                     "retries_left": t.retries_left,
+                    # failure forensics: which attempt, what failed, where
+                    "attempt": t.attempt,
+                    "error_type": t.error_type,
+                    "node_id": node,
+                    "pid": pid,
                 }
-                for t in list(self.tasks.values())
-            ]
+
+            rows = [_task_row(t) for t in list(self.tasks.values())]
             return self._apply_limit(rows, args)
         if op == "list_actors":
             rows = [
@@ -3271,6 +3425,14 @@ class Scheduler:
             return self._runtime_metric_series()
         if op == "task_events":
             return list(self._task_events)
+        if op == "list_cluster_events":
+            rows = list(self._cluster_events)
+            limit = args[0] if args and isinstance(args[0], int) else None
+            # newest events are the forensically interesting ones: truncate
+            # from the front, keep chronological order
+            return rows[-limit:] if limit is not None else rows
+        if op == "hung_get_digest":
+            return self.hung_get_digest(list(args[0]))
         raise ValueError(f"unknown rpc {op}")
 
     @staticmethod
@@ -3599,6 +3761,313 @@ class Scheduler:
     def task_events(self) -> List[dict]:
         return list(self._task_events)
 
+    # ---- failure forensics (cluster events, logs, watchdogs) -------------
+
+    def record_cluster_event(
+        self,
+        type: str,
+        message: str,
+        severity: str = "INFO",
+        source: str = "SCHEDULER",
+        **extra,
+    ) -> None:
+        """Append one structured cluster event (parity: the reference's
+        exported event stream / event.proto). Lock-guarded, so it is safe
+        from any thread (loop, memory monitor, watchdog rpcs); readers go
+        through the loop rpc."""
+        if not getattr(self.config, "telemetry_enabled", True):
+            return
+        ev = {
+            "time": time.time(),
+            "severity": severity,
+            "source": source,
+            "type": type,
+            "message": message,
+        }
+        ev.update(extra)
+        self._ingest_cluster_event(ev)
+
+    def _ingest_cluster_event(self, ev: dict) -> None:
+        etype = ev.get("type", "UNKNOWN")
+        with self._cluster_event_lock:
+            self._cluster_event_seq += 1
+            ev.setdefault("event_id", self._cluster_event_seq)
+            self._cluster_event_counts[etype] = (
+                self._cluster_event_counts.get(etype, 0) + 1
+            )
+            self._cluster_events.append(ev)
+        if ev.get("severity") == "ERROR":
+            logger.warning(
+                "cluster event %s: %s", etype, ev.get("message", "")
+            )
+
+    def _note_task_runtime(self, rec: TaskRecord) -> None:
+        """Feed the straggler watchdog's per-function runtime history."""
+        if rec.start_time is None or rec.end_time is None:
+            return
+        name = rec.spec.name or "unnamed"
+        hist = self._func_runtimes.get(name)
+        if hist is None:
+            hist = self._func_runtimes[name] = collections.deque(maxlen=64)
+        hist.append(rec.end_time - rec.start_time)
+
+    def _record_task_retry(self, rec: TaskRecord, why: str) -> None:
+        self.record_cluster_event(
+            "TASK_RETRY",
+            f"task {rec.spec.name or rec.spec.task_id.hex()[:16]} retrying "
+            f"({why}); {rec.retries_left} retries left",
+            severity="WARNING",
+            task_id=rec.spec.task_id.hex(),
+            name=rec.spec.name,
+            attempt=rec.attempt,
+            retries_left=rec.retries_left,
+            reason=why,
+        )
+
+    def _note_task_error(
+        self, rec: TaskRecord, entry: Tuple, w=None, node_hint=None
+    ) -> None:
+        """An application error committed for this task: extract provenance
+        (error type, node, pid, attempt) into the TaskRecord and the event
+        log. Unpickles the error blob — errors are rare, so the cost is
+        paid off the hot path."""
+        err_type = "Exception"
+        err_pid = None
+        err_node = None
+        try:
+            err = pickle.loads(entry[1])
+            cause = getattr(err, "cause", None)
+            err_type = type(cause).__name__ if cause is not None else type(err).__name__
+            err_pid = getattr(err, "pid", None)
+            err_node = getattr(err, "node_id", None)
+        except Exception:
+            pass
+        rec.error_type = err_type
+        rec.error_pid = err_pid if err_pid is not None else (
+            w.proc.pid if w is not None and w.proc is not None else None
+        )
+        # node provenance: scheduler-known node ids first, then the error's
+        # own record (host string). Leased tasks report through the daemon
+        # with rec.worker_id cleared — the reporting node rides node_hint;
+        # never default to the head, which would misplace exactly the
+        # remote failures this plane exists to locate.
+        if w is not None:
+            rec.error_node = w.node_id.hex()
+        elif node_hint is not None:
+            rec.error_node = node_hint
+        elif err_node is not None:
+            rec.error_node = str(err_node)
+        self.record_cluster_event(
+            "TASK_FAILED",
+            f"task {rec.spec.name or rec.spec.task_id.hex()[:16]} failed: "
+            f"{err_type}",
+            severity="ERROR",
+            task_id=rec.spec.task_id.hex(),
+            name=rec.spec.name,
+            error_type=err_type,
+            attempt=rec.attempt,
+            node_id=rec.error_node,
+            pid=rec.error_pid,
+        )
+
+    def _maybe_detect_stragglers(self) -> None:
+        """Flag RUNNING tasks exceeding factor x p95 of their function's
+        completed runtimes as WARN events + ray_tpu_stragglers_total
+        (parity role: the reference's slow-task/lineage debugging signals;
+        runs on the loop, rate-limited to 1 Hz)."""
+        cfg = self.config
+        factor = getattr(cfg, "straggler_detect_factor", 0.0)
+        if not factor or not getattr(cfg, "telemetry_enabled", True):
+            # dispatch still feeds _running_watch unconditionally; without
+            # the scan's lazy pruning it would grow one id per task ever run
+            if self._running_watch:
+                self._running_watch.clear()
+            return
+        now = time.monotonic()
+        if now - self._last_straggler_scan < 1.0:
+            return
+        self._last_straggler_scan = now
+        min_samples = getattr(cfg, "straggler_min_samples", 5)
+        min_runtime = getattr(cfg, "straggler_min_runtime_s", 5.0)
+        for tid in list(self._running_watch):
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != "RUNNING" or rec.start_time is None:
+                self._running_watch.discard(tid)  # settled since: lazy prune
+                continue
+            key = (rec.spec.task_id, rec.attempt)
+            if key in self._straggler_flagged:
+                continue
+            hist = self._func_runtimes.get(rec.spec.name or "unnamed")
+            if hist is None or len(hist) < min_samples:
+                continue
+            ordered = sorted(hist)
+            p95 = ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+            threshold = max(factor * p95, min_runtime)
+            elapsed = now - rec.start_time
+            if elapsed <= threshold:
+                continue
+            self._straggler_flagged.add(key)
+            self._straggler_count += 1
+            w = self.workers.get(rec.worker_id) if rec.worker_id else None
+            self.record_cluster_event(
+                "STRAGGLER",
+                f"task {rec.spec.name or rec.spec.task_id.hex()[:16]} running "
+                f"{elapsed:.1f}s, {elapsed / p95 if p95 > 0 else 0:.0f}x its "
+                f"p95 of {p95:.3f}s",
+                severity="WARNING",
+                task_id=rec.spec.task_id.hex(),
+                name=rec.spec.name,
+                attempt=rec.attempt,
+                elapsed_s=round(elapsed, 3),
+                p95_s=round(p95, 4),
+                node_id=w.node_id.hex() if w is not None else None,
+                pid=w.proc.pid if w is not None and w.proc is not None else None,
+            )
+        # flagged entries for settled tasks can't fire again; prune so the
+        # set tracks live suspicion, not history
+        if len(self._straggler_flagged) > 256:
+            self._straggler_flagged = {
+                (tid, att)
+                for tid, att in self._straggler_flagged
+                if tid in self._running_watch
+            }
+
+    def hung_get_digest(self, oid_hexes: List[str]) -> str:
+        """Forensic digest for a blocked get(): each pending object's
+        producing task chain with states/workers (driver watchdog; runs on
+        the loop via local_rpc). Also records a HUNG_GET event."""
+        lines = []
+        for oh in oid_hexes[:16]:
+            try:
+                oid = ObjectID(bytes.fromhex(oh))
+            except ValueError:
+                continue
+            rec = self.tasks.get(oid.task_id())
+            chain = []
+            depth = 0
+            while rec is not None and depth < 8:
+                w = self.workers.get(rec.worker_id) if rec.worker_id else None
+                loc = ""
+                if w is not None:
+                    pid = w.proc.pid if w.proc is not None else None
+                    loc = f" worker={w.worker_id.hex()[:8]} pid={pid}"
+                chain.append(
+                    f"{rec.spec.name or rec.spec.task_id.hex()[:12]}"
+                    f" [{rec.state}{loc} attempt={rec.attempt}]"
+                )
+                # follow the first unresolved ref arg to its producer
+                nxt = None
+                for dep in rec.unresolved_deps:
+                    nxt = self.tasks.get(dep.task_id())
+                    if nxt is not None:
+                        break
+                rec = nxt
+                depth += 1
+            if chain:
+                lines.append(f"  {oh[:16]}: " + " <- ".join(chain))
+            else:
+                lines.append(f"  {oh[:16]}: no producing task known (lost put?)")
+        states: Dict[str, int] = {}
+        for t in self.tasks.values():
+            states[t.state] = states.get(t.state, 0) + 1
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(states.items()))
+        digest = (
+            f"get() blocked on {len(oid_hexes)} objects; cluster tasks: "
+            f"{summary}\n" + "\n".join(lines)
+        )
+        self.record_cluster_event(
+            "HUNG_GET",
+            f"driver get() blocked on {len(oid_hexes)} objects",
+            severity="WARNING",
+            source="DRIVER",
+            objects=[o[:16] for o in oid_hexes[:16]],
+        )
+        return digest
+
+    # ---- worker log persistence (the reference log_monitor role) ---------
+
+    def _handle_log_record(self, rec: dict, holder=None) -> None:
+        self._handle_log_batch([rec], holder)
+
+    def _handle_log_batch(self, recs: List[dict], holder=None) -> None:
+        """A batch of structured worker log lines: echo to the driver's
+        streams (log_to_driver) and persist under <session>/logs. Writes
+        are coalesced — one stream write + flush and one file write per
+        (destination, batch), not per line — so a print-heavy task loop
+        costs syscalls proportional to batches, not lines."""
+        echo: Dict[str, List[str]] = {}
+        persist = getattr(self.config, "persist_worker_logs", True)
+        to_driver = self.config.log_to_driver
+        files: Dict[str, List[str]] = {}
+        for rec in recs:
+            line = rec.get("line", "")
+            pid = rec.get("pid")
+            if to_driver:
+                name = rec.get("task_name")
+                if not name and rec.get("task_id"):
+                    try:
+                        trec = self.tasks.get(
+                            TaskID(bytes.fromhex(rec["task_id"]))
+                        )
+                        if trec is not None:
+                            name = trec.spec.name
+                    except (ValueError, KeyError):
+                        name = None
+                echo.setdefault(rec.get("stream") or "stdout", []).append(
+                    f"({name or 'worker'} pid={pid}) {line}\n"
+                )
+            if persist:
+                ext = "err" if rec.get("stream") == "stderr" else "out"
+                who = holder.hex()[:8] if holder is not None else "driver"
+                ts = rec.get("time") or time.time()
+                stamp = time.strftime(
+                    "%Y-%m-%d %H:%M:%S", time.localtime(ts)
+                )
+                files.setdefault(f"worker-{who}-{pid}.{ext}", []).append(
+                    f"[{stamp}.{int((ts % 1) * 1000):03d} "
+                    f"{(rec.get('sev') or 'INFO')[0]} "
+                    f"task={rec.get('task_id') or '-'} "
+                    f"actor={rec.get('actor_id') or '-'} "
+                    f"job={rec.get('job_id') or '-'}] {line}\n"
+                )
+        for stream, lines in echo.items():
+            try:
+                import sys as _sys
+
+                out = _sys.stderr if stream == "stderr" else _sys.stdout
+                out.write("".join(lines))
+                out.flush()
+            except Exception:
+                pass
+        for fname, lines in files.items():
+            try:
+                self._log_file_for(fname).write("".join(lines))
+            except Exception:
+                pass
+
+    def _log_file_for(self, fname: str):
+        fh = self._log_files.get(fname)
+        if fh is None:
+            if len(self._log_files) >= 128:  # bound open handles: evict the
+                # OLDEST entry (popitem() would pop the newest and churn the
+                # hottest files while dead workers' handles stay pinned)
+                oldest = next(iter(self._log_files))
+                try:
+                    self._log_files.pop(oldest).close()
+                except OSError:
+                    pass
+            path = os.path.join(self._node.session_dir, "logs", fname)
+            fh = self._log_files[fname] = open(path, "a", buffering=1)
+        return fh
+
+    def _close_log_files(self) -> None:
+        for fh in self._log_files.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+        self._log_files.clear()
+
     # ---- telemetry plane (TelemetryBuffer ingestion + cluster flush) -----
 
     def _append_profile_span(self, span: dict, pid=None) -> None:
@@ -3633,6 +4102,14 @@ class Scheduler:
             self._task_events.append(ev)
         for span in spans:
             self._append_profile_span(span, pid=pid)
+        logs = batch.get("logs")
+        if logs:
+            try:
+                self._handle_log_batch(logs, holder=holder)
+            except Exception:
+                logger.exception("log record handling failed")
+        for cev in batch.get("cluster_events") or ():
+            self._ingest_cluster_event(dict(cev))
         for name, (kind, description, data) in (batch.get("metrics") or {}).items():
             try:
                 self._merge_metric(name, kind, description, data, proc)
@@ -3885,6 +4362,20 @@ class Scheduler:
             {lk(): self._telemetry_dropped},
         )
         add(
+            "ray_tpu_stragglers_total",
+            "counter",
+            "running tasks flagged by the straggler watchdog "
+            "(elapsed > factor x p95 of the function's runtimes)",
+            {lk(): self._straggler_count},
+        )
+        add(
+            "ray_tpu_cluster_events_total",
+            "counter",
+            "structured cluster events recorded (failure forensics plane)",
+            {lk(type=t): n for t, n in sorted(self._cluster_event_counts.items())}
+            or {lk(): 0},
+        )
+        add(
             "ray_tpu_lease_backlog_depth",
             "gauge",
             "leased-but-unstarted tasks queued at node-local dispatchers",
@@ -3918,6 +4409,7 @@ class Scheduler:
                 pass
 
     def _shutdown_workers(self):
+        self._close_log_files()
         for w in self.workers.values():
             if w.state != "dead":
                 try:

@@ -74,6 +74,13 @@ class TelemetryBuffer:
         self._lock = threading.Lock()
         self._events: collections.deque = collections.deque()
         self._spans: collections.deque = collections.deque()
+        # structured worker log lines (the forensics plane: one record per
+        # stdout/stderr line, tagged with task/actor ids) — batched with the
+        # same cadence instead of one pipe send per print
+        self._logs: collections.deque = collections.deque()
+        # cluster events recorded OUTSIDE the scheduler (serve replicas,
+        # library code); merged into the scheduler's event log on flush
+        self._cluster_events: collections.deque = collections.deque()
         # name -> (kind, description, data snapshot): last writer wins, so
         # N records within one interval flush as ONE write per metric
         self._metrics: Dict[str, Tuple[str, str, dict]] = {}
@@ -113,6 +120,22 @@ class TelemetryBuffer:
                 return
             self._spans.append(span)
 
+    def record_log(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._logs) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._logs.append(rec)
+
+    def record_cluster_event(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._cluster_events) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._cluster_events.append(ev)
+
     def record_metric(self, name: str, kind: str, description: str, data: dict) -> None:
         with self._lock:
             self._metrics[name] = (kind, description, data)
@@ -129,16 +152,30 @@ class TelemetryBuffer:
 
     def _drain(self) -> Optional[dict]:
         with self._lock:
-            if not (self._events or self._spans or self._metrics or self._dropped_pending):
+            if not (
+                self._events
+                or self._spans
+                or self._logs
+                or self._cluster_events
+                or self._metrics
+                or self._dropped_pending
+            ):
                 return None
             events, self._events = list(self._events), collections.deque()
             spans, self._spans = list(self._spans), collections.deque()
+            logs, self._logs = list(self._logs), collections.deque()
+            cluster_events, self._cluster_events = (
+                list(self._cluster_events),
+                collections.deque(),
+            )
             metrics, self._metrics = dict(self._metrics), {}
             dropped, self._dropped_pending = self._dropped_pending, 0
         return {
             "pid": os.getpid(),
             "events": events,
             "spans": spans,
+            "logs": logs,
+            "cluster_events": cluster_events,
             "metrics": metrics,
             "dropped": dropped,
         }
@@ -154,7 +191,13 @@ class TelemetryBuffer:
         self._flushes += 1
         if _send_batch(batch):
             return True
-        lost = len(batch["events"]) + len(batch["spans"]) + batch["dropped"]
+        lost = (
+            len(batch["events"])
+            + len(batch["spans"])
+            + len(batch["logs"])
+            + len(batch["cluster_events"])
+            + batch["dropped"]
+        )
         with self._lock:
             for name, snap in batch["metrics"].items():
                 self._metrics.setdefault(name, snap)  # newer snapshot wins
@@ -236,6 +279,57 @@ def record_metric(name: str, kind: str, description: str, data: dict) -> None:
         return
     _buffer.record_metric(name, kind, description, data)
     _buffer.ensure_flusher()
+
+
+def record_log(rec: dict) -> None:
+    """One structured worker log line (forensics plane); batched."""
+    if not enabled():
+        return
+    _buffer.record_log(rec)
+    _buffer.ensure_flusher()
+
+
+def record_cluster_event(
+    type: str,
+    message: str,
+    severity: str = "INFO",
+    source: str = "WORKER",
+    **extra,
+) -> None:
+    """Record a cluster event from a non-scheduler process (serve replicas,
+    library code); merged into the scheduler's event log with the next
+    telemetry batch. The scheduler records its own events directly via
+    ``Scheduler.record_cluster_event``."""
+    if not enabled():
+        return
+    ev = {
+        "time": time.time(),
+        "severity": severity,
+        "source": source,
+        "type": type,
+        "message": message,
+        "pid": os.getpid(),
+    }
+    ev.update(extra)
+    _buffer.record_cluster_event(ev)
+    _buffer.ensure_flusher()
+
+
+_SEV_ERROR_PREFIXES = ("ERROR", "CRITICAL", "FATAL", "Traceback (")
+_SEV_WARN_PREFIXES = ("WARNING", "WARN")
+
+
+def guess_severity(line: str, stream: str) -> str:
+    """Cheap severity heuristic for untagged stdout/stderr lines (parity:
+    the reference log monitor treating stderr as higher-signal)."""
+    stripped = line.lstrip()
+    for p in _SEV_ERROR_PREFIXES:
+        if stripped.startswith(p):
+            return "ERROR"
+    for p in _SEV_WARN_PREFIXES:
+        if stripped.startswith(p):
+            return "WARNING"
+    return "ERROR" if stream == "stderr" and "Error" in line else "INFO"
 
 
 def flush() -> bool:

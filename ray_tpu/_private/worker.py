@@ -377,10 +377,25 @@ class DriverRuntime:
         if missing and self._direct is not None:
             self._direct.flush()
         if missing:
-            ready = ms.wait_for(missing, timeout)
-            if len(ready) < len(missing):
+            # hung-get watchdog: a get blocked past the threshold prints a
+            # forensic digest (pending task chain + cluster task states) and
+            # records a HUNG_GET event, then keeps waiting. At most two
+            # wait_for calls per get — no polling on the happy path.
+            warn_s = float(getattr(self.config, "hung_get_warn_s", 0.0) or 0.0)
+            split_wait = warn_s > 0 and (timeout is None or timeout > warn_s)
+            ready = ms.wait_for(missing, warn_s if split_wait else timeout)
+            pending = [o for o in missing if o not in ready]
+            if pending and split_wait:
+                self._warn_hung_get(pending, warn_s)
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is None or remaining > 0:
+                    ready = ready | ms.wait_for(pending, remaining)
+                pending = [o for o in missing if o not in ready]
+            if pending:
                 raise exc.GetTimeoutError(
-                    f"get() timed out waiting for {len(missing) - len(ready)} objects"
+                    f"get() timed out waiting for {len(pending)} objects"
                 )
         out = []
         for oid in oids:
@@ -401,6 +416,28 @@ class DriverRuntime:
                 raise val
             out.append(val)
         return out
+
+    def _warn_hung_get(self, pending: List[ObjectID], warn_s: float) -> None:
+        """Print the scheduler's forensic digest for a get() that has been
+        blocked for ``warn_s`` seconds (parity role: the reference's
+        'waiting for ...' warning + ray stack guidance, here with the
+        actual pending task chain)."""
+        try:
+            digest = self.scheduler_rpc(
+                "hung_get_digest", ([o.hex() for o in pending],)
+            )
+        except Exception:
+            digest = f"get() blocked on {len(pending)} objects (digest unavailable)"
+        try:
+            import sys as _sys
+
+            _sys.stderr.write(
+                f"[ray_tpu] get() has been blocked for {warn_s:.0f}s:\n"
+                f"{digest}\n"
+            )
+            _sys.stderr.flush()
+        except Exception:
+            pass
 
     def _entry_value(self, oid: ObjectID, entry: Tuple, timeout=None) -> Tuple[Any, bool]:
         """Returns (value, is_error). Error-ness comes from the entry kind so

@@ -885,11 +885,19 @@ class WorkerRuntime:
             raise
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
+            prov = {
+                "task_id": spec.task_id.hex(),
+                "pid": os.getpid(),
+                "node_id": getattr(self.config, "node_host", None),
+            }
             if isinstance(e, exc.TaskError):
                 err = e  # error from an upstream dependency: propagate as-is
             else:
                 err = exc.TaskError(
-                    spec.name or "task", tb, e if isinstance(e, Exception) else None
+                    spec.name or "task",
+                    tb,
+                    e if isinstance(e, Exception) else None,
+                    **prov,
                 )
             try:
                 # cloudpickle: user exception classes defined in the driver's
@@ -897,7 +905,7 @@ class WorkerRuntime:
                 # pickling to survive the trip back
                 blob = cloudpickle.dumps(err)
             except Exception:
-                err = exc.TaskError(spec.name or "task", tb, None)
+                err = exc.TaskError(spec.name or "task", tb, None, **prov)
                 blob = pickle.dumps(err)
             return [("error", blob)] * max(1, spec.num_returns)
         finally:
@@ -912,37 +920,128 @@ class WorkerRuntime:
 
 class _TeeStream:
     """Line-buffered tee: worker prints go to the original stream AND to the
-    driver over the pipe (parity: the reference's log monitor publishing
-    worker stdout/stderr to drivers, python/ray/_private/log_monitor.py:1)."""
+    driver (parity: the reference's log monitor attributing worker
+    stdout/stderr to tasks/jobs, python/ray/_private/log_monitor.py:1).
+
+    Each line becomes a structured record — timestamp, severity guess,
+    current task/actor/job id (per-thread TLS, so threaded actors attribute
+    correctly) — shipped in telemetry batches instead of one pipe send per
+    line. When the telemetry plane is disabled the raw line falls back to
+    the legacy per-line ``("log", ...)`` pipe message so ``log_to_driver``
+    keeps working."""
 
     def __init__(self, original, rt, name: str):
         self._original = original
         self._rt = rt
         self._name = name
-        self._buf = ""
+        # PER-THREAD line buffers: print() issues separate write("text") /
+        # write("\n") calls, so a process-wide buffer interleaves concurrent
+        # threaded-actor prints into merged lines attributed to whichever
+        # thread wrote the newline. Keyed by thread ident (each thread only
+        # touches its own slot) instead of threading.local so flush_all()
+        # at worker exit can drain EVERY thread's residue, not just the
+        # main thread's.
+        self._bufs: Dict[int, str] = {}
+        self._bufs_lock = threading.Lock()
         self._pid = os.getpid()
-        self._lock = threading.Lock()  # threaded actors print concurrently
+
+    def _emit(self, lines, ctx=None):
+        """ctx: (task_id, actor_id) captured at write time — used when the
+        emitting thread is not the one that printed (flush_all from the
+        exit/drain path); None reads the calling thread's TLS."""
+        from ray_tpu._private import telemetry
+
+        structured = telemetry.enabled()
+        urgent = False
+        for line in lines:
+            if structured:
+                if ctx is not None:
+                    tid, aid = ctx
+                else:
+                    tid = self._rt.current_task_id
+                    aid = self._rt._actor_id
+                sev = telemetry.guess_severity(line, self._name)
+                urgent = urgent or sev == "ERROR"
+                telemetry.record_log(
+                    {
+                        "time": time.time(),
+                        "sev": sev,
+                        "stream": self._name,
+                        "pid": self._pid,
+                        "task_id": tid.hex() if tid else None,
+                        "actor_id": aid.hex() if aid else None,
+                        "job_id": tid.job_id().hex() if tid else None,
+                        "line": line,
+                    }
+                )
+            else:
+                try:
+                    self._rt._send(("log", self._name, self._pid, line))
+                except Exception:
+                    pass
+        if urgent:
+            # error-looking output is what forensics reads after a crash:
+            # wake the flusher now instead of waiting out the interval (a
+            # SIGKILL between print and the next cadence would lose it)
+            telemetry.get_buffer().wake()
 
     def write(self, text):
         try:
             self._original.write(text)
         except Exception:
             pass
-        lines = []
-        with self._lock:
-            self._buf += text
-            while "\n" in self._buf:
-                line, self._buf = self._buf.split("\n", 1)
-                if line:
-                    lines.append(line)
-        for line in lines:
+        ident = threading.get_ident()
+        with self._bufs_lock:
+            entry = self._bufs.get(ident)
+            buf = (entry[0] if entry else "") + text
+            lines = buf.split("\n")
+            residue = lines.pop()  # trailing partial line stays buffered
+            if residue:
+                # capture the printing thread's task context WITH the
+                # residue, so an exit-path flush from another thread still
+                # attributes it correctly
+                self._bufs[ident] = (
+                    residue,
+                    (self._rt.current_task_id, self._rt._actor_id),
+                )
+            else:
+                self._bufs.pop(ident, None)
+        lines = [line for line in lines if line]
+        if lines:
             try:
-                self._rt._send(("log", self._name, self._pid, line))
+                self._emit(lines)
             except Exception:
                 pass
         return len(text)
 
     def flush(self):
+        # ship the calling thread's trailing partial line too: text printed
+        # without a final newline (progress bars, sys.stdout.write) used to
+        # sit buffered forever and vanish at worker exit
+        with self._bufs_lock:
+            entry = self._bufs.pop(threading.get_ident(), None)
+        if entry is not None:
+            try:
+                self._emit([entry[0]], ctx=entry[1])
+            except Exception:
+                pass
+        try:
+            self._original.flush()
+        except Exception:
+            pass
+
+    def flush_all(self):
+        """Worker exit: drain EVERY thread's residue (threaded-actor pool
+        threads can't flush themselves once the loop stops), each under the
+        task context captured when it was buffered."""
+        with self._bufs_lock:
+            entries = list(self._bufs.values())
+            self._bufs.clear()
+        for residue, ctx in entries:
+            try:
+                self._emit([residue], ctx=ctx)
+            except Exception:
+                pass
         try:
             self._original.flush()
         except Exception:
@@ -983,9 +1082,49 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     rt.shm_dir = shm_dir
     worker_mod._set_worker_runtime(rt)
 
-    if config.log_to_driver:
+    tee_streams = []
+    # the tee feeds BOTH consumers — driver echo (log_to_driver) and the
+    # persisted session logs (persist_worker_logs); the scheduler decides
+    # per-batch which of the two applies, so install it if either is on
+    if config.log_to_driver or getattr(config, "persist_worker_logs", True):
         sys.stdout = _TeeStream(sys.stdout, rt, "stdout")
         sys.stderr = _TeeStream(sys.stderr, rt, "stderr")
+        tee_streams = [sys.stdout, sys.stderr]
+
+    def _on_sigterm(signum, frame):
+        # a terminate() (memory-monitor kill, force-cancel) must still drain
+        # buffered log records — the dying task's output is exactly what
+        # forensics reads afterwards. Drain from a SIDE thread (the handler
+        # runs mid-bytecode and could be holding the very locks a flush
+        # needs), then hard-exit: os._exit closes the pipe abruptly so the
+        # head still sees a NON-graceful death and retries/fails the
+        # running task exactly as an uncaught SIGTERM did.
+        def _drain_and_die():
+            from ray_tpu._private import telemetry as _tele
+
+            for tee in tee_streams:
+                try:
+                    tee.flush_all()
+                except Exception:
+                    pass
+            try:
+                _tele.flush()
+            except Exception:
+                pass
+            os._exit(143)
+
+        threading.Thread(target=_drain_and_die, daemon=True).start()
+        # backstop: if a flush wedges on a dead pipe, die anyway
+        t = threading.Timer(3.0, os._exit, args=(143,))
+        t.daemon = True
+        t.start()
+
+    import signal as _signal
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / unsupported platform: keep default
 
     reader = threading.Thread(target=rt.reader_loop, name="reader", daemon=True)
     reader.start()
@@ -1119,6 +1258,11 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     finally:
         if pending_buf is not None:
             pending_buf.flush()
+        for tee in tee_streams:  # residual partial lines precede the batch
+            try:
+                tee.flush_all()  # every thread's residue, not just main's
+            except Exception:
+                pass
         try:  # last telemetry batch out before the pipe closes
             telemetry.flush()
         except Exception:

@@ -10,6 +10,7 @@ stdlib HTTP server in the driver serves a dependency-free single-page UI
   /api/cluster_status   resources + nodes
   /api/tasks            task table            /api/actors     actor table
   /api/objects          object store          /api/jobs       job table
+  /api/events           cluster event log (failure forensics)
   /api/stacks           thread stacks of driver + every node daemon
                         (the reporter-agent py-spy role)
   /api/profiler/start|stop   jax.profiler XPlane device traces
@@ -69,6 +70,12 @@ def start_dashboard(port: int = 8765) -> int:
                         body = {}
                 elif self.path == "/api/logs":
                     body = state.list_logs()
+                elif urlparse(self.path).path == "/api/events":
+                    # structured cluster events (failure forensics plane):
+                    # WORKER_DIED, TASK_FAILED, STRAGGLER, OOM, ...
+                    q = parse_qs(urlparse(self.path).query)
+                    limit = int(q.get("limit", ["500"])[0])
+                    body = state.list_cluster_events(limit=limit)
                 elif self.path == "/api/jobs":
                     from ray_tpu.job_submission import JobSubmissionClient
 

@@ -56,8 +56,8 @@ PAGE = """<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
-              "placement_groups","serve","jobs","logs","event_stats","stacks",
-              "profile"];
+              "placement_groups","serve","jobs","logs","events","event_stats",
+              "stacks","profile"];
 let tab = location.hash.slice(1) || "overview";
 const $ = (id) => document.getElementById(id);
 
@@ -83,8 +83,8 @@ function table(rows, cols) {
       if (v !== null && typeof v === "object") v = JSON.stringify(v);
       let cls = "";
       if (c === "state" || c === "status" || c === "alive")
-        cls = /ALIVE|FINISHED|RUNNING|true|SUCCEEDED|HEALTHY/i.test(String(v)) ? "ok"
-            : /DEAD|FAILED|false|UNHEALTHY/i.test(String(v)) ? "bad" : "";
+        cls = /ALIVE|FINISHED|RUNNING|true|SUCCEEDED|HEALTHY|^INFO$/i.test(String(v)) ? "ok"
+            : /DEAD|FAILED|false|UNHEALTHY|ERROR/i.test(String(v)) ? "bad" : "";
       return `<td class="${cls}">${esc(v===undefined?"":v)}</td>`;
     }).join("") + "</tr>").join("") + "</table>";
 }
@@ -128,6 +128,23 @@ const RENDER = {
   },
   async jobs() { return table(await j("/api/jobs")); },
   async logs() { return table(await j("/api/logs")); },
+  async events() {
+    // cluster event log (failure forensics): newest first, severity colored
+    const rows = await j("/api/events?limit=500");
+    const by = {};
+    rows.forEach(r => { by[r.severity] = (by[r.severity]||0)+1; });
+    const cols = ["event_id","state","type","source","message","task_id",
+                  "node_id","pid"];
+    const shaped = rows.slice().reverse().map(r => {
+      const o = {};
+      cols.forEach(c => { o[c] = r[c]; });
+      o.state = r.severity;  // severity under the colorized "state" column
+      return o;
+    });
+    return "<h2>by severity</h2><p>" +
+      Object.entries(by).map(([k,v])=>`${k}: ${v}`).join(" · ") + "</p>" +
+      "<h2>latest</h2>" + table(shaped, cols);
+  },
   async event_stats() {
     const s = await j("/api/event_stats");
     return "<pre>" + esc(JSON.stringify(s, null, 2)) + "</pre>";

@@ -12,20 +12,49 @@ class RayTpuError(Exception):
 
 
 class TaskError(RayTpuError):
-    """A remote task raised an exception; carries the remote traceback.
+    """A remote task raised an exception; carries the remote traceback plus
+    its origin: task id, attempt number, node, and executing pid.
 
     Mirrors ``RayTaskError`` (python/ray/exceptions.py): re-raised at
-    ``get()`` with cause chained to the user's original exception.
+    ``get()`` with cause chained to the user's original exception, and the
+    provenance fields survive pickling (parity: RayTaskError carrying
+    proctitle/pid/ip through the object store).
     """
 
-    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+    def __init__(
+        self,
+        function_name: str,
+        traceback_str: str,
+        cause: Exception | None = None,
+        task_id: str | None = None,
+        attempt: int | None = None,
+        node_id: str | None = None,
+        pid: int | None = None,
+    ):
         self.function_name = function_name
         self.traceback_str = traceback_str
         self.cause = cause
-        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+        self.task_id = task_id
+        self.attempt = attempt
+        self.node_id = node_id
+        self.pid = pid
+        parts = [
+            f"{k}={v}"
+            for k, v in (("pid", pid), ("node", node_id), ("attempt", attempt))
+            if v is not None
+        ]
+        where = f" ({', '.join(parts)})" if parts else ""
+        super().__init__(f"task {function_name} failed{where}:\n{traceback_str}")
+
+    def _provenance(self) -> tuple:
+        return (self.task_id, self.attempt, self.node_id, self.pid)
 
     def __reduce__(self):
-        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+        return (
+            TaskError,
+            (self.function_name, self.traceback_str, self.cause)
+            + self._provenance(),
+        )
 
     def as_instanceof_cause(self):
         """Return an exception that is both a TaskError and the cause's type."""
@@ -39,14 +68,22 @@ class TaskError(RayTpuError):
                 def __init__(self, inner):
                     self._inner = inner
                     TaskError.__init__(
-                        self, inner.function_name, inner.traceback_str, inner.cause
+                        self,
+                        inner.function_name,
+                        inner.traceback_str,
+                        inner.cause,
+                        *inner._provenance(),
                     )
 
                 def __str__(self):
                     return TaskError.__str__(self._inner)
 
                 def __reduce__(self):
-                    return (_rebuild_task_error, (self.function_name, self.traceback_str, self.cause))
+                    return (
+                        _rebuild_task_error,
+                        (self.function_name, self.traceback_str, self.cause)
+                        + self._provenance(),
+                    )
 
             _Wrapped.__name__ = cause_cls.__name__
             _Wrapped.__qualname__ = cause_cls.__qualname__
@@ -55,8 +92,18 @@ class TaskError(RayTpuError):
             return self
 
 
-def _rebuild_task_error(function_name, traceback_str, cause):
-    return TaskError(function_name, traceback_str, cause).as_instanceof_cause()
+def _rebuild_task_error(
+    function_name,
+    traceback_str,
+    cause,
+    task_id=None,
+    attempt=None,
+    node_id=None,
+    pid=None,
+):
+    return TaskError(
+        function_name, traceback_str, cause, task_id, attempt, node_id, pid
+    ).as_instanceof_cause()
 
 
 class WorkerCrashedError(RayTpuError):

@@ -111,6 +111,41 @@ def cmd_memory(args):
         print(f"  {r['object_id'][:16]} {r['size_bytes']:>12} bytes refs={r['ref_count']}")
 
 
+def cmd_events(args):
+    """Cluster event log (failure forensics): WORKER_DIED, TASK_FAILED,
+    STRAGGLER, OOM, ... with severity/source/provenance."""
+    import time as _time
+
+    from ray_tpu.util import state
+
+    _init(args)
+    filters = []
+    if args.severity:
+        filters.append(("severity", "=", args.severity.upper()))
+    if args.type:
+        filters.append(("type", "=", args.type.upper()))
+    rows = state.list_cluster_events(filters=filters or None, limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    for ev in rows:
+        stamp = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(ev.get("time", 0))
+        )
+        where = " ".join(
+            f"{k}={ev[k]}"
+            for k in ("task_id", "node_id", "pid", "attempt")
+            if ev.get(k) is not None
+        )
+        print(
+            f"{stamp} {ev.get('severity', 'INFO'):<7} "
+            f"{ev.get('type', '?'):<16} [{ev.get('source', '?')}] "
+            f"{ev.get('message', '')}" + (f"  ({where})" if where else "")
+        )
+    if not rows:
+        print("no cluster events recorded")
+
+
 def cmd_timeline(args):
     import ray_tpu
     from ray_tpu.util import state
@@ -244,6 +279,15 @@ def main(argv=None):
     p = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     p.add_argument("--output", "-o")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "events", help="cluster event log (failure forensics)"
+    )
+    p.add_argument("--severity", help="filter: INFO | WARNING | ERROR")
+    p.add_argument("--type", help="filter: WORKER_DIED, TASK_FAILED, ...")
+    p.add_argument("--limit", type=int, default=200)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("job", help="job submission")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -176,6 +176,31 @@ class Replica:
         except Exception:
             pass
 
+    def _record_failure(self, method: str, error: BaseException) -> None:
+        """Ship a request failure into the cluster event log (forensics
+        plane) so ``list_cluster_events`` covers the serving path, not just
+        core tasks. Rides the telemetry batch pipeline; never fails (or
+        delays) the request path."""
+        try:
+            from ray_tpu._private.telemetry import record_cluster_event
+            from ray_tpu._private.worker import get_runtime
+
+            rt = get_runtime()
+            replica_id = getattr(rt, "_actor_id", None)
+            record_cluster_event(
+                "REPLICA_REQUEST_FAILED",
+                f"deployment {self._deployment or '?'}.{method} raised "
+                f"{type(error).__name__}: {error}",
+                severity="ERROR",
+                source="SERVE",
+                deployment=self._deployment,
+                method=method,
+                error_type=type(error).__name__,
+                replica_id=replica_id.hex() if replica_id else None,
+            )
+        except Exception:
+            pass
+
     def is_asgi(self) -> bool:
         """Whether this deployment mounts an ASGI app (serve.ingress)."""
         return getattr(self._callable, "__serve_asgi_app__", None) is not None
@@ -207,6 +232,9 @@ class Replica:
             if method == "__call__":
                 return self._callable(*args, **kwargs)
             return getattr(self._callable, method)(*args, **kwargs)
+        except BaseException as e:
+            self._record_failure(method, e)
+            raise
         finally:
             self._record_latency(method, _time.perf_counter() - t0)
             self._exit()
@@ -238,6 +266,11 @@ class Replica:
             )
             for item in fn(*args, **kwargs):
                 yield item
+        except GeneratorExit:
+            raise  # consumer stopped early: not a request failure
+        except BaseException as e:
+            self._record_failure(method, e)
+            raise
         finally:
             # stream duration: entry to last yield (parity: serve counts a
             # streaming response until its generator finishes)
@@ -258,6 +291,9 @@ class Replica:
         self._enter("")
         try:
             run_asgi_websocket(app, scope, conn, instance=self._callable)
+        except BaseException as e:
+            self._record_failure("__websocket__", e)
+            raise
         finally:
             self._exit()
 

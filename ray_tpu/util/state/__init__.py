@@ -9,6 +9,7 @@ backed by the scheduler's task-event buffer and tables (the reference's
 from ray_tpu.util.state.api import (
     get_log,
     list_actors,
+    list_cluster_events,
     list_logs,
     list_nodes,
     list_objects,
@@ -25,6 +26,7 @@ __all__ = [
     "list_nodes",
     "list_workers",
     "list_placement_groups",
+    "list_cluster_events",
     "list_logs",
     "get_log",
     "summarize_tasks",

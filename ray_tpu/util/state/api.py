@@ -84,6 +84,24 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return _rpc("summarize_tasks")
 
 
+def list_cluster_events(filters=None, limit: int = 10_000) -> List[dict]:
+    """Structured cluster events — WORKER_DIED, NODE_DEAD, TASK_RETRY,
+    TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ... — in
+    chronological order (parity: ``ray.util.state.list_cluster_events``).
+    Flushes the telemetry plane first so worker/serve-recorded events are
+    read-your-writes."""
+    rt = get_runtime()
+    if hasattr(rt, "scheduler"):
+        from ray_tpu._private import telemetry
+
+        telemetry.flush()
+        try:
+            rt.scheduler.request_telemetry_flush()
+        except Exception:
+            pass
+    return _list("list_cluster_events", filters, limit)
+
+
 def _session_logs_dir() -> str:
     import os
 
@@ -106,18 +124,52 @@ def list_logs(limit: int = 10_000) -> List[dict]:
 
     logs_dir = _session_logs_dir()
     out = []
-    for path in sorted(glob.glob(os.path.join(logs_dir, "*")))[:limit]:
+    # skip directories (spill/, runtime env dirs) BEFORE applying the
+    # limit, or a handful of subdirectories could mask every real file
+    for path in sorted(glob.glob(os.path.join(logs_dir, "*"))):
+        if not os.path.isfile(path):
+            continue
         st = os.stat(path)
         out.append({"filename": os.path.basename(path), "path": path,
                     "size_bytes": st.st_size, "mtime": st.st_mtime})
+        if len(out) >= limit:
+            break
     return out
 
 
-def get_log(filename: str, *, tail: int = 1000) -> str:
-    """Read (the tail of) one session log file."""
+def get_log(
+    filename: str = "",
+    *,
+    task_id: str = "",
+    tail: int = 1000,
+) -> str:
+    """Read (the tail of) one session log file, or — with ``task_id=`` —
+    every persisted worker-log line attributed to that task, across all
+    worker files (the structured-log plane tags each line with the task id
+    that printed it, threaded actors included)."""
     import collections
+    import glob
     import os
 
-    path = os.path.join(_session_logs_dir(), os.path.basename(filename))
+    logs_dir = _session_logs_dir()
+    if task_id:
+        # read-your-writes: pull workers' buffered log batches first
+        from ray_tpu._private.worker import get_driver
+
+        try:
+            get_driver().scheduler.request_telemetry_flush()
+        except Exception:
+            pass
+        needle = f"task={task_id}"
+        hits: List[str] = []
+        for path in sorted(glob.glob(os.path.join(logs_dir, "worker-*"))):
+            if not os.path.isfile(path):
+                continue
+            with open(path, errors="replace") as fh:
+                hits.extend(line for line in fh if needle in line)
+        return "".join(hits[-tail:])
+    if not filename:
+        raise ValueError("get_log() needs a filename or a task_id")
+    path = os.path.join(logs_dir, os.path.basename(filename))
     with open(path, errors="replace") as fh:
         return "".join(collections.deque(fh, maxlen=tail))

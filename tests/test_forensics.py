@@ -1,0 +1,374 @@
+"""Tests: the failure-forensics plane.
+
+Task-attributed structured logs (``_TeeStream`` -> telemetry batches ->
+persisted session logs), the cluster event log (``list_cluster_events`` /
+``ray_tpu events``), TaskError provenance, and the straggler / hung-get
+watchdogs. Parity: ``python/ray/tests/test_output.py`` (log attribution),
+the exported event stream, and RayTaskError's origin fields.
+"""
+
+import os
+import pickle
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.util import state
+
+
+def _events_of(type_, timeout=10.0):
+    """Poll list_cluster_events for a type (batches land asynchronously)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        rows = [e for e in state.list_cluster_events() if e["type"] == type_]
+        if rows or time.monotonic() >= deadline:
+            return rows
+        time.sleep(0.2)
+
+
+# -- structured logs ---------------------------------------------------------
+
+
+def test_log_lines_attributed_to_tasks(ray_start_regular):
+    """Worker prints persist under <session>/logs tagged with the printing
+    task's id; get_log(task_id=) returns exactly that task's lines."""
+
+    @ray_tpu.remote
+    def speak(i):
+        print(f"voice-{i}")
+        return i
+
+    refs = [speak.remote(i) for i in range(3)]
+    assert ray_tpu.get(refs, timeout=60) == [0, 1, 2]
+
+    rows = {r.hex(): i for i, r in ((i, refs[i].id().task_id()) for i in range(3))}
+    for tid_hex, i in rows.items():
+        txt = state.get_log(task_id=tid_hex)
+        assert f"voice-{i}" in txt, (tid_hex, txt)
+        # only this task's lines match
+        for j in range(3):
+            if j != i:
+                assert f"voice-{j}" not in txt
+
+
+def test_log_attribution_threaded_actor(ray_start_regular):
+    """Concurrent method calls on a threaded actor attribute their prints to
+    the right task (per-thread TLS, not a process-global)."""
+
+    @ray_tpu.remote(max_concurrency=4)
+    class Chorus:
+        def sing(self, i):
+            time.sleep(0.05)  # force overlap
+            print(f"note-{i}")
+            return i
+
+    c = Chorus.remote()
+    refs = [c.sing.remote(i) for i in range(4)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 1, 2, 3]
+    for i, ref in enumerate(refs):
+        txt = state.get_log(task_id=ref.id().task_id().hex())
+        assert f"note-{i}" in txt
+        assert f"note-{(i + 1) % 4}" not in txt
+
+
+def test_tee_stream_flushes_partial_line():
+    """Text without a trailing newline must not vanish (the seed buffered it
+    forever); flush() ships the residue as a line."""
+    from ray_tpu._private.worker_process import _TeeStream
+
+    sent = []
+
+    class FakeRt:
+        current_task_id = None
+        _actor_id = None
+
+        def _send(self, msg):
+            sent.append(msg)
+
+    import io
+
+    tee = _TeeStream(io.StringIO(), FakeRt(), "stdout")
+    tee.write("no newline here")
+    assert sent == []  # still buffered
+    tee.flush()
+    # unconnected process: telemetry disabled -> legacy pipe fallback
+    assert sent == [("log", "stdout", os.getpid(), "no newline here")]
+    tee.flush()
+    assert len(sent) == 1  # residue shipped exactly once
+
+
+def test_list_logs_skips_directories_and_limits(ray_start_regular):
+    """list_logs must not count subdirectories against the limit (the seed
+    applied [:limit] before filtering) and must skip them entirely."""
+
+    @ray_tpu.remote
+    def ping():
+        print("logged-line")
+        return 1
+
+    assert ray_tpu.get(ping.remote(), timeout=60) == 1
+    # force the batched log through and give a directory a low sort key
+    drv = ray_tpu.get_runtime()
+    drv.scheduler.request_telemetry_flush()
+    time.sleep(0.2)
+    logs_dir = os.path.join(drv.node.session_dir, "logs")
+    os.makedirs(os.path.join(logs_dir, "aaa-subdir"), exist_ok=True)
+    os.makedirs(os.path.join(logs_dir, "aab-subdir"), exist_ok=True)
+    rows = state.list_logs(limit=1)
+    assert len(rows) == 1
+    assert rows[0]["filename"] not in ("aaa-subdir", "aab-subdir")
+    assert os.path.isfile(rows[0]["path"])
+
+
+# -- cluster event log -------------------------------------------------------
+
+
+def test_worker_died_event_and_task_provenance(ray_start_regular):
+    """Killing a worker mid-task yields a WORKER_DIED event, and the failed
+    task's list_tasks row carries error_type, attempt, node, and pid."""
+
+    @ray_tpu.remote(max_retries=0)
+    def hang():
+        time.sleep(60)
+
+    ref = hang.remote()
+    deadline = time.monotonic() + 30
+    row = None
+    while time.monotonic() < deadline:
+        rows = [
+            r
+            for r in state.list_tasks()
+            if r["name"] == "hang" and r["state"] == "RUNNING" and r["pid"]
+        ]
+        if rows:
+            row = rows[0]
+            break
+        time.sleep(0.1)
+    assert row is not None
+    os.kill(row["pid"], signal.SIGKILL)
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(ref, timeout=60)
+
+    died = _events_of("WORKER_DIED")
+    assert any(e["severity"] == "ERROR" and e.get("pid") == row["pid"] for e in died)
+    failed = [r for r in state.list_tasks() if r["name"] == "hang"][0]
+    assert failed["state"] == "FAILED"
+    assert failed["error_type"] == "WorkerCrashedError"
+    assert failed["attempt"] == 1
+    assert failed["pid"] == row["pid"]
+    assert failed["node_id"]
+    # the TASK_FAILED event links the same provenance
+    tf = [e for e in _events_of("TASK_FAILED") if e.get("name") == "hang"]
+    assert tf and tf[0]["error_type"] == "WorkerCrashedError"
+
+
+def test_task_retry_events_on_worker_kill(ray_start_regular):
+    """A retriable task killed mid-run emits TASK_RETRY and completes; its
+    row records the successful attempt number."""
+
+    @ray_tpu.remote(max_retries=5)
+    def phoenix():
+        time.sleep(0.8)
+        return "risen"
+
+    ref = phoenix.remote()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rows = [
+            r
+            for r in state.list_tasks()
+            if r["name"] == "phoenix" and r["state"] == "RUNNING" and r["pid"]
+        ]
+        if rows:
+            os.kill(rows[0]["pid"], signal.SIGKILL)
+            break
+        time.sleep(0.05)
+    assert ray_tpu.get(ref, timeout=120) == "risen"
+    assert _events_of("TASK_RETRY")
+    row = [r for r in state.list_tasks() if r["name"] == "phoenix"][0]
+    assert row["attempt"] >= 2
+
+
+def test_app_error_provenance_in_events_and_rows(ray_start_regular):
+    """An application exception surfaces its cause type (not just TaskError)
+    in the TASK_FAILED event and the task row, and the raised error carries
+    task_id + pid provenance through pickling."""
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ZeroDivisionError("1/0")
+
+    ref = boom.remote()
+    with pytest.raises(ZeroDivisionError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    err = ei.value
+    assert isinstance(err, exc.TaskError)
+    assert err.task_id == ref.id().task_id().hex()
+    assert err.pid is not None
+    # provenance survives another pickling round-trip (returns/args)
+    err2 = pickle.loads(pickle.dumps(err))
+    assert isinstance(err2, ZeroDivisionError)
+    assert (err2.task_id, err2.pid) == (err.task_id, err.pid)
+
+    tf = [e for e in _events_of("TASK_FAILED") if e.get("name") == "boom"]
+    assert tf and tf[0]["error_type"] == "ZeroDivisionError"
+    row = [r for r in state.list_tasks() if r["name"] == "boom"][0]
+    assert row["error_type"] == "ZeroDivisionError"
+    assert row["pid"] is not None
+
+
+def test_taskerror_provenance_defaults():
+    """Constructing/pickling TaskError without provenance stays compatible."""
+    e = exc.TaskError("f", "tb")
+    e2 = pickle.loads(pickle.dumps(e))
+    assert (e2.task_id, e2.attempt, e2.node_id, e2.pid) == (None,) * 4
+    w = exc.TaskError(
+        "f", "tb", ValueError("x"), task_id="t", attempt=3, node_id="n", pid=9
+    ).as_instanceof_cause()
+    w2 = pickle.loads(pickle.dumps(w))
+    assert isinstance(w2, ValueError) and isinstance(w2, exc.TaskError)
+    assert (w2.task_id, w2.attempt, w2.node_id, w2.pid) == ("t", 3, "n", 9)
+
+
+def test_list_cluster_events_filters_and_limit(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        raise RuntimeError("no")
+
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(die.remote(), timeout=60)
+    assert _events_of("TASK_FAILED")
+    errs = state.list_cluster_events(filters=[("severity", "=", "ERROR")])
+    assert errs and all(e["severity"] == "ERROR" for e in errs)
+    assert len(state.list_cluster_events(limit=1)) == 1
+    # event ids are assigned in arrival order
+    rows = state.list_cluster_events()
+    ids = [e["event_id"] for e in rows]
+    assert ids == sorted(ids)
+
+
+# -- watchdogs ---------------------------------------------------------------
+
+
+@pytest.fixture
+def watchdog_runtime():
+    rt = ray_tpu.init(
+        num_cpus=4,
+        ignore_reinit_error=True,
+        _system_config={
+            "straggler_detect_factor": 2.0,
+            "straggler_min_samples": 3,
+            "straggler_min_runtime_s": 0.3,
+            "hung_get_warn_s": 1.0,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_straggler_warn_event_deterministic(watchdog_runtime):
+    """With a lowered threshold, a 10x-slow task is flagged: one STRAGGLER
+    WARN event + the ray_tpu_stragglers_total counter."""
+
+    @ray_tpu.remote
+    def work(d):
+        time.sleep(d)
+        return d
+
+    ray_tpu.get([work.remote(0.01) for _ in range(5)], timeout=60)
+    slow = work.remote(8.0)
+    evs = _events_of("STRAGGLER", timeout=15.0)
+    assert evs, "straggler watchdog never fired"
+    ev = evs[0]
+    assert ev["severity"] == "WARNING"
+    assert ev["name"] == "work"
+    assert ev["elapsed_s"] > 2.0 * ev["p95_s"]
+    # one attempt is flagged at most once
+    time.sleep(2.5)
+    assert len(_events_of("STRAGGLER")) == 1
+    from ray_tpu.util.metrics import prometheus_text
+
+    line = next(
+        l
+        for l in prometheus_text().splitlines()
+        if l.startswith("ray_tpu_stragglers_total")
+    )
+    assert float(line.split()[-1]) >= 1
+    ray_tpu.cancel(slow, force=True)
+
+
+def test_hung_get_digest(watchdog_runtime, capfd):
+    """A get() blocked past hung_get_warn_s prints the pending task chain
+    and records a HUNG_GET event, then still honors its timeout."""
+
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(30)
+
+    ref = sleepy.remote()
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(ref, timeout=2.5)
+    out, err = capfd.readouterr()
+    assert "get() has been blocked" in err
+    assert "sleepy" in err  # the pending task chain names the producer
+    assert _events_of("HUNG_GET", timeout=5.0)
+    ray_tpu.cancel(ref, force=True)
+
+
+# -- serve path --------------------------------------------------------------
+
+
+def test_serve_replica_failure_event(ray_start_regular):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Fragile:
+        def __call__(self, x):
+            if x == "boom":
+                raise ValueError("bad input")
+            return x
+
+    h = serve.run(Fragile.bind(), name="fragile")
+    try:
+        assert h.remote("ok").result(timeout_s=60) == "ok"
+        with pytest.raises(Exception):
+            h.remote("boom").result(timeout_s=60)
+        evs = _events_of("REPLICA_REQUEST_FAILED")
+        assert evs
+        ev = evs[0]
+        assert ev["source"] == "SERVE"
+        assert ev["deployment"] == "Fragile"
+        assert ev["error_type"] == "ValueError"
+        assert ev["replica_id"]
+    finally:
+        serve.shutdown()
+
+
+# -- regression guards: PR 2 surfaces unchanged ------------------------------
+
+
+def test_timeline_and_prometheus_unaffected(ray_start_regular):
+    """The forensics plane must not disturb the PR 2 telemetry outputs:
+    timeline() still renders the lifecycle spans and /metrics still parses
+    (log records and cluster events ride the same batches but never enter
+    the task-event log)."""
+
+    @ray_tpu.remote
+    def noisy():
+        print("timeline-noise")
+        return 1
+
+    assert ray_tpu.get([noisy.remote() for _ in range(3)], timeout=60) == [1, 1, 1]
+    events = ray_tpu.timeline()
+    states = {e["args"]["state"] for e in events}
+    assert {"SUBMITTED", "QUEUED", "DISPATCHED", "RUNNING", "FINISHED"} <= states
+    # no log/cluster-event record leaked into the chrome trace
+    assert all("line" not in e.get("args", {}) for e in events)
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "ray_tpu_scheduler_queue_depth" in text
+    assert "ray_tpu_cluster_events_total" in text
