@@ -379,6 +379,135 @@ def main():
         flush=True,
     )
 
+    # --- checkpoint-plane goodput (tentpole acceptance: async save
+    # overhead per train step < 20% of the blocking-save overhead) ---
+    # One simulated train loop, three variants over identical local
+    # snapshots: no upload (baseline), blocking commit per step (the
+    # seed's behavior), and the manager's background commit. The storage
+    # backend is throttled (fixed per-object latency) so the bench models
+    # a remote store instead of the local page cache.
+    import shutil
+    import tempfile
+
+    from ray_tpu._private import external_storage as xstorage
+    from ray_tpu.train import checkpointing as ckpt_plane
+    from ray_tpu.train._checkpoint import Checkpoint
+
+    class _ThrottledStore(xstorage.FileBackend):
+        DELAY_S = 0.05  # per-object round-trip latency (remote-store model)
+
+        def write_bytes(self, path, data):
+            time.sleep(self.DELAY_S)
+            super().write_bytes(path, data)
+
+        def write_stream(self, path, chunks):
+            # commit_dir_to_uri uploads payload through write_stream — the
+            # throttle must cover it or only the 2 marker files pay latency
+            time.sleep(self.DELAY_S)
+            super().write_stream(path, chunks)
+
+        def read_bytes(self, path):
+            time.sleep(self.DELAY_S)
+            return super().read_bytes(path)
+
+        def read_into(self, path, make_dest):
+            time.sleep(self.DELAY_S)
+            return super().read_into(path, make_dest)
+
+    xstorage.register_backend("benchstore", _ThrottledStore)
+    ck_root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    src = os.path.join(ck_root, "src")
+    os.makedirs(src)
+    ckpt_mb = 4 if args.quick else 16
+    with open(os.path.join(src, "model.bin"), "wb") as fh:
+        fh.write(os.urandom(ckpt_mb * 1024 * 1024))
+    with open(os.path.join(src, "meta.json"), "w") as fh:
+        fh.write('{"bench": true}')
+    ck_steps = 4 if args.quick else 8
+    step_compute_s = 0.05
+
+    def ckpt_loop(base, on_step):
+        """steps x (simulated compute + local snapshot + on_step hook);
+        returns wall seconds."""
+        os.makedirs(base, exist_ok=True)
+        t0 = time.perf_counter()
+        for step in range(1, ck_steps + 1):
+            time.sleep(step_compute_s)
+            sd = os.path.join(base, ckpt_plane.step_dir_name(step))
+            shutil.copytree(src, sd, dirs_exist_ok=True)
+            on_step(step, sd)
+        return time.perf_counter() - t0
+
+    t_base = ckpt_loop(os.path.join(ck_root, "base"), lambda s, d: None)
+
+    sync_uri = f"benchstore://{ck_root}/sync_mirror"
+    t_sync = ckpt_loop(
+        os.path.join(ck_root, "sync"),
+        lambda s, d: xstorage.commit_dir_to_uri(
+            d, xstorage.join(sync_uri, ckpt_plane.step_dir_name(s))
+        ),
+    )
+
+    async_uri = f"benchstore://{ck_root}/async_mirror"
+    mgr = ckpt_plane.CheckpointManager(
+        os.path.join(ck_root, "async"),
+        storage_uri=async_uri,
+        world_size=1,
+        run_name="bench",
+    )
+    t_async = ckpt_loop(
+        os.path.join(ck_root, "async"), lambda s, d: mgr.note_shard(0, s, d)
+    )
+    drain_t0 = time.perf_counter()
+    mgr.wait(timeout=300)
+    drain_s = time.perf_counter() - drain_t0
+    mgr.shutdown()
+
+    sync_ms = (t_sync - t_base) / ck_steps * 1e3
+    async_ms = (t_async - t_base) / ck_steps * 1e3
+    ratio_pct = (async_ms / sync_ms * 100) if sync_ms > 0 else None
+    print(
+        json.dumps(
+            {
+                "metric": "checkpoint_save_overhead_ms_per_step",
+                "sync_blocking": round(sync_ms, 2),
+                "async_manager": round(async_ms, 2),
+                "async_vs_sync_pct": round(ratio_pct, 1) if ratio_pct is not None else None,
+                "budget_pct": 20.0,
+                "unit": "ms/step",
+                "ckpt_mb": ckpt_mb,
+                "steps": ck_steps,
+                "uploader_drain_s": round(drain_s, 2),
+            }
+        ),
+        flush=True,
+    )
+
+    # restore latency: cold (real download + digest verify) and cached
+    latest = ckpt_plane.latest_step(async_uri)
+    latest_uri = xstorage.join(async_uri, ckpt_plane.step_dir_name(latest))
+    ckpt_plane.clear_restore_cache()
+    t0 = time.perf_counter()
+    Checkpoint.from_uri(latest_uri)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    Checkpoint.from_uri(latest_uri)
+    cached_ms = (time.perf_counter() - t0) * 1e3
+    print(
+        json.dumps(
+            {
+                "metric": "checkpoint_restore_latency_ms",
+                "cold_verified": round(cold_ms, 2),
+                "cached": round(cached_ms, 2),
+                "unit": "ms",
+                "ckpt_mb": ckpt_mb,
+            }
+        ),
+        flush=True,
+    )
+    ckpt_plane.clear_restore_cache()
+    shutil.rmtree(ck_root, ignore_errors=True)
+
     # per-stage attribution of the driver's put pipeline (serialize /
     # alloc / copy / seal — the same registry event_stats exports)
     from ray_tpu._private import fastcopy

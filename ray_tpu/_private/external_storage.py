@@ -271,6 +271,256 @@ def list_uri(uri: str) -> List[str]:
     return [f"{scheme}://{p}" if has_scheme(uri) else p for p in backend.list(path)]
 
 
+# --------------------------------------------------------------------------
+# checkpoint commit protocol (manifest + atomic COMMIT marker)
+# --------------------------------------------------------------------------
+#
+# A committed directory-object (a train checkpoint) is three things under one
+# prefix:
+#
+#   <prefix>/<payload files...>        uploaded first, any order
+#   <prefix>/MANIFEST.json             per-file sizes + sha256 digests
+#   <prefix>/COMMIT                    written LAST; content = manifest digest
+#
+# Readers treat COMMIT as the linearization point: a prefix without a valid
+# COMMIT (missing, or whose content does not match the manifest's digest) is
+# garbage from a crashed writer and must never be restored. Each individual
+# write is atomic per backend (FileBackend tmp+rename), so a crash at ANY
+# point leaves either no COMMIT or a fully consistent triple.
+
+MANIFEST_FILE = "MANIFEST.json"
+COMMIT_FILE = "COMMIT"
+_DIGEST_CHUNK = 8 * 1024 * 1024
+
+
+class IntegrityError(RuntimeError):
+    """A committed object failed verification (size or digest mismatch)."""
+
+
+def file_digest(path: str) -> str:
+    """sha256 of one local file, streamed."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(_DIGEST_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def build_manifest(local_dir: str, **meta) -> dict:
+    """Walk ``local_dir`` into a manifest: relpath -> {size, digest}. The
+    protocol's own marker files are excluded (a manifest never describes
+    itself). ``meta`` (step, world_size, ...) rides along for readers."""
+    files: Dict[str, dict] = {}
+    for root, _dirs, names in os.walk(local_dir):
+        for name in sorted(names):
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, local_dir)
+            if rel in (MANIFEST_FILE, COMMIT_FILE):
+                continue
+            files[rel] = {
+                "size": os.path.getsize(p),
+                "digest": file_digest(p),
+            }
+    manifest = {"files": files}
+    manifest.update(meta)
+    return manifest
+
+
+def manifest_digest(manifest: dict) -> str:
+    """Digest of the canonical manifest encoding — the COMMIT marker's
+    content, binding the marker to exactly one manifest."""
+    import hashlib
+    import json
+
+    blob = json.dumps(manifest, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def write_commit_markers(prefix: str, manifest: dict) -> str:
+    """Write MANIFEST.json then COMMIT (order is the protocol) under a
+    path-or-URI prefix. Returns the manifest digest."""
+    import json
+
+    blob = json.dumps(manifest, sort_keys=True, indent=1).encode()
+    write_bytes(join(prefix, MANIFEST_FILE), blob)
+    digest = manifest_digest(manifest)
+    write_bytes(join(prefix, COMMIT_FILE), digest.encode())
+    return digest
+
+
+def read_committed_manifest(prefix: str) -> Optional[dict]:
+    """The manifest of a committed prefix, or None when the prefix is
+    uncommitted (no/invalid COMMIT, or COMMIT does not match the manifest —
+    a torn write from a crashed committer)."""
+    import json
+
+    marker = read_bytes(join(prefix, COMMIT_FILE))
+    if marker is None:
+        return None
+    blob = read_bytes(join(prefix, MANIFEST_FILE))
+    if blob is None:
+        return None
+    try:
+        manifest = json.loads(blob)
+    except ValueError:
+        return None
+    if manifest_digest(manifest) != marker.decode(errors="replace").strip():
+        return None
+    return manifest
+
+
+def is_committed(prefix: str) -> bool:
+    return read_committed_manifest(prefix) is not None
+
+
+def commit_dir_to_uri(local_dir: str, uri: str, manifest: Optional[dict] = None) -> dict:
+    """Upload a local directory as ONE committed object: payload files
+    first, then manifest + COMMIT. A crash mid-upload leaves an uncommitted
+    prefix that readers ignore and GC reclaims. Files upload through
+    ``write_stream`` so a multi-GB shard is never staged whole in memory."""
+    if manifest is None:
+        manifest = build_manifest(local_dir)
+
+    def _chunks(path):
+        with open(path, "rb") as fh:
+            while True:
+                block = fh.read(_DIGEST_CHUNK)
+                if not block:
+                    break
+                yield block
+
+    for rel in manifest["files"]:
+        p = os.path.join(local_dir, rel)
+        write_stream(join(uri, rel.replace(os.sep, "/")), _chunks(p))
+    write_commit_markers(uri, manifest)
+    return manifest
+
+
+def verify_file(prefix: str, rel: str, entry: dict, dest_path: Optional[str] = None) -> None:
+    """Fetch ONE committed file, verifying size + sha256 against its
+    manifest entry; with ``dest_path`` the bytes stream through
+    ``read_into`` straight into an mmap-backed file (no whole-file
+    staging), without it the file is hashed in place (verify-only).
+    Raises :class:`IntegrityError` on any mismatch; a failed dest is
+    unlinked, never left half-written."""
+    import hashlib
+    import mmap
+
+    key = join(prefix, rel.replace(os.sep, "/"))
+    expected = int(entry["size"])
+    h = hashlib.sha256()
+    if dest_path is None:
+        backend, path = resolve(key)
+        if isinstance(backend, FileBackend):
+            # local object: constant-memory streaming hash, no staging
+            if not os.path.isfile(path):
+                raise IntegrityError(f"{prefix}: committed file {rel!r} missing")
+            if os.path.getsize(path) != expected:
+                raise IntegrityError(
+                    f"{prefix}: {rel!r} size {os.path.getsize(path)} != "
+                    f"manifest {expected}"
+                )
+            if file_digest(path) != entry["digest"]:
+                raise IntegrityError(f"{prefix}: {rel!r} digest mismatch")
+            return
+        if expected == 0:
+            if not exists(key):
+                raise IntegrityError(f"{prefix}: committed file {rel!r} missing")
+        else:
+            buf = bytearray(expected)
+
+            def make_dest(size):
+                return memoryview(buf) if size == expected else None
+
+            n = read_into(key, make_dest)
+            if n is None:
+                raise IntegrityError(f"{prefix}: committed file {rel!r} missing")
+            if n != expected:
+                raise IntegrityError(
+                    f"{prefix}: {rel!r} size {n} != manifest {expected}"
+                )
+            for off in range(0, expected, _DIGEST_CHUNK):
+                h.update(buf[off : off + _DIGEST_CHUNK])
+        if h.hexdigest() != entry["digest"]:
+            raise IntegrityError(f"{prefix}: {rel!r} digest mismatch")
+        return
+
+    os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+    try:
+        with open(dest_path, "wb+") as fh:
+            if expected:
+                fh.truncate(expected)
+                mm = mmap.mmap(fh.fileno(), expected)
+                try:
+                    def make_dest(size):
+                        return memoryview(mm) if size == expected else None
+
+                    n = read_into(key, make_dest)
+                    if n is None:
+                        raise IntegrityError(
+                            f"{prefix}: committed file {rel!r} missing"
+                        )
+                    if n != expected:
+                        raise IntegrityError(
+                            f"{prefix}: {rel!r} size {n} != manifest {expected}"
+                        )
+                    for off in range(0, expected, _DIGEST_CHUNK):
+                        h.update(mm[off : off + _DIGEST_CHUNK])
+                finally:
+                    mm.close()
+            elif not exists(key):
+                raise IntegrityError(f"{prefix}: committed file {rel!r} missing")
+        if h.hexdigest() != entry["digest"]:
+            raise IntegrityError(f"{prefix}: {rel!r} digest mismatch")
+    except IntegrityError:
+        try:
+            os.unlink(dest_path)
+        except OSError:
+            pass
+        raise
+
+
+def restore_committed_uri_to_dir(uri: str, local_dir: str, manifest: Optional[dict] = None) -> List[str]:
+    """Materialize a committed prefix locally, verifying every file's size
+    and digest against the manifest. Raises :class:`IntegrityError` on any
+    mismatch (and on an uncommitted prefix), so a reader can never act on a
+    torn or corrupted checkpoint."""
+    if manifest is None:
+        manifest = read_committed_manifest(uri)
+    if manifest is None:
+        raise IntegrityError(f"no committed manifest under {uri}")
+    out = []
+    for rel, entry in manifest["files"].items():
+        dest = os.path.join(local_dir, rel)
+        verify_file(uri, rel, entry, dest_path=dest)
+        out.append(dest)
+    return out
+
+
+def delete_prefix(prefix: str) -> int:
+    """Delete every object under a prefix — COMMIT first, so an interrupted
+    delete demotes the object to uncommitted garbage instead of leaving a
+    committed-looking partial. Returns the number of objects removed."""
+    n = 0
+    commit_key = join(prefix, COMMIT_FILE)
+    if exists(commit_key):
+        n += int(delete(commit_key))
+    for key in list_uri(prefix.rstrip("/") + "/"):
+        n += int(delete(key))
+    # local backends leave empty directory skeletons behind
+    backend, path = resolve(prefix)
+    if isinstance(backend, FileBackend) and os.path.isdir(path):
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    return n
+
+
 def sync_dir_to_uri(local_dir: str, uri: str) -> List[str]:
     """Mirror a local directory tree into external storage (checkpoint
     upload; parity: the trainable's storage sync)."""

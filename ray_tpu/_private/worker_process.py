@@ -1102,6 +1102,17 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
         def _drain_and_die():
             from ray_tpu._private import telemetry as _tele
 
+            # checkpoint plane: a preempted worker gets one bounded window
+            # for a best-effort final snapshot — user-registered hooks may
+            # train.report(checkpoint=) one last time, and any live
+            # CheckpointManager drains its commit queue so barriered saves
+            # reach COMMIT before the process dies
+            _ckpt = sys.modules.get("ray_tpu.train.checkpointing")
+            if _ckpt is not None:  # only if this worker actually trained
+                try:
+                    _ckpt.run_preemption_hooks(timeout_s=2.0)
+                except Exception:
+                    pass
             for tee in tee_streams:
                 try:
                     tee.flush_all()

@@ -181,8 +181,10 @@ def _block(cfg: TransformerConfig, x, layer, cos, sin, positions, context_axis, 
 
         from jax.sharding import PartitionSpec as P
 
+        from ray_tpu.parallel._shard_map import shard_map as _shard_map
+
         spec = P(None, context_axis, None, None)
-        att = jax.shard_map(
+        att = _shard_map(
             functools.partial(ring_attention, axis_name=context_axis, causal=True),
             mesh=mesh,
             in_specs=(spec, spec, spec),

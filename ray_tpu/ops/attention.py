@@ -237,13 +237,18 @@ def make_context_parallel_attention(mesh, axis_name: str = "context", causal: bo
     """Wrap ``ring_attention`` in shard_map for direct use on global arrays."""
     from jax.sharding import PartitionSpec as P
 
+    from ray_tpu.parallel._shard_map import shard_map as _shard_map
+
     spec = P(None, axis_name, None, None)
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # ring attention is manual over the context axis only; other mesh
+        # axes (batch/model) stay under GSPMD
+        axis_names={axis_name},
     )
     def cp_attention(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)

@@ -1,0 +1,46 @@
+"""shard_map compatibility across the jax API split.
+
+The repo targets the new-API ``jax.shard_map(..., axis_names=)`` (partial
+manual: only the named axes go manual, everything else stays under GSPMD).
+jax 0.4.x only ships ``jax.experimental.shard_map.shard_map`` where the same
+contract is spelled as the complement — ``auto=`` names the axes that STAY
+automatic — and mixing manual+auto requires ``check_rep=False`` (the 0.4.x
+replication checker also predates the vma typing these ring-ppermute
+kernels rely on, so the check stays off on the legacy path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names: Optional[Set] = None):
+    """``jax.shard_map`` when available; else the ``jax.experimental``
+    spelling with ``auto`` = mesh axes minus ``axis_names``.
+
+    ``axis_names=None`` means fully manual over every mesh axis (both APIs'
+    default).
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    # Legacy fallback goes FULLY manual (auto=∅) even for partial-manual
+    # call sites: 0.4.x cannot lower axis_index/partition-id with a
+    # non-empty auto set. The in/out specs don't name the other axes, so
+    # sharding on them is gathered at entry and restored at exit — correct,
+    # just without the partial-manual overlap the new API gives.
+    return _legacy_shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=False,
+    )

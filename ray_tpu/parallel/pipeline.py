@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel._shard_map import shard_map as _shard_map
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -95,7 +97,7 @@ def make_pipeline_fn(
     mspec = P()  # microbatches replicated; stage 0 consumes
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(pspec, mspec),
         out_specs=P(axis_name),

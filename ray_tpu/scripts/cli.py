@@ -146,6 +146,87 @@ def cmd_events(args):
         print("no cluster events recorded")
 
 
+def cmd_ckpt(args):
+    """Checkpoint plane: list/inspect/verify/GC committed checkpoints
+    (``ray_tpu.train.checkpointing``). With ``--storage`` the commands work
+    directly against a path or URI (no cluster needed); without it,
+    ``list``/``latest`` read the cluster's KV run registry."""
+    import time as _time
+
+    from ray_tpu.train import checkpointing
+
+    def _fmt_row(row):
+        created = row.get("created")
+        stamp = (
+            _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(created))
+            if created
+            else "-"
+        )
+        size = row.get("size_bytes")
+        size_s = f"{size / 1e6:.1f}MB" if size is not None else "-"
+        return (
+            f"{row.get('run') or '-':<24} step={row['step']:<8} "
+            f"{'COMMITTED' if row['committed'] else 'uncommitted':<12} "
+            f"{size_s:>10}  {stamp}  {row['path']}"
+        )
+
+    if args.ckpt_cmd == "list":
+        if args.storage:
+            rows = checkpointing.list_checkpoints(args.storage)
+        else:
+            from ray_tpu.util import state
+
+            _init(args)
+            rows = state.list_checkpoints(limit=args.limit)
+        rows = rows[: args.limit]
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        for row in rows:
+            print(_fmt_row(row))
+        if not rows:
+            print("no checkpoints found")
+    elif args.ckpt_cmd == "latest":
+        if args.storage:
+            step = checkpointing.latest_step(args.storage)
+            if step is None:
+                print("no committed checkpoint")
+                sys.exit(1)
+            print(checkpointing.discover_steps(args.storage)[step])
+        else:
+            from ray_tpu.util import state
+
+            _init(args)
+            rows = [r for r in state.list_checkpoints() if r["committed"]]
+            if not rows:
+                print("no committed checkpoint")
+                sys.exit(1)
+            # newest across ALL runs — the rows come back sorted per run
+            print(_fmt_row(max(rows, key=lambda r: r.get("created") or 0)))
+    elif args.ckpt_cmd == "verify":
+        from ray_tpu._private.external_storage import IntegrityError
+
+        try:
+            manifest = checkpointing.verify_checkpoint(args.prefix)
+        except IntegrityError as e:
+            print(f"FAILED: {e}")
+            sys.exit(1)
+        files = manifest.get("files", {})
+        print(
+            f"OK: {len(files)} files, "
+            f"{sum(e['size'] for e in files.values())} bytes, "
+            f"step={manifest.get('step')} world_size={manifest.get('world_size')}"
+        )
+    elif args.ckpt_cmd == "gc":
+        deleted = checkpointing.gc_checkpoints(
+            args.storage, keep=args.keep, max_age_s=args.max_age_s
+        )
+        print(f"deleted {len(deleted)} checkpoint(s): {deleted}")
+        if args.clear_cache:
+            n = checkpointing.clear_restore_cache()
+            print(f"cleared {n} restore-cache entr{'y' if n == 1 else 'ies'}")
+
+
 def cmd_timeline(args):
     import ray_tpu
     from ray_tpu.util import state
@@ -288,6 +369,26 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=200)
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("ckpt", help="checkpoint plane (list/verify/gc)")
+    csub = p.add_subparsers(dest="ckpt_cmd", required=True)
+    ps = csub.add_parser("list", help="list checkpoints (registry or --storage)")
+    ps.add_argument("--storage", help="base path or URI (skips the cluster registry)")
+    ps.add_argument("--limit", type=int, default=200)
+    ps.add_argument("--json", action="store_true")
+    ps = csub.add_parser("latest", help="newest COMMITTED checkpoint")
+    ps.add_argument("--storage", help="base path or URI (skips the cluster registry)")
+    ps = csub.add_parser("verify", help="re-verify a committed checkpoint's digests")
+    ps.add_argument("prefix", help="checkpoint prefix (path or URI)")
+    ps = csub.add_parser("gc", help="retention GC over a base path or URI")
+    ps.add_argument("--storage", required=True)
+    ps.add_argument("--keep", type=int, help="keep the newest N committed")
+    ps.add_argument("--max-age-s", type=float, dest="max_age_s")
+    ps.add_argument(
+        "--clear-cache", action="store_true",
+        help="also drop the local Checkpoint.from_uri restore cache",
+    )
+    p.set_defaults(fn=cmd_ckpt)
 
     p = sub.add_parser("job", help="job submission")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -9,7 +9,9 @@ worker group = one actor per TPU host; collectives run inside jit over ICI
 just aligns mesh construction across hosts.
 """
 
+from ray_tpu.train import checkpointing
 from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.checkpointing import CheckpointManager, register_preemption_hook
 from ray_tpu.train._config import (
     CheckpointConfig,
     FailureConfig,
@@ -25,6 +27,9 @@ from ray_tpu.train.torch_trainer import TorchTrainer, prepare_data_loader, prepa
 __all__ = [
     "Checkpoint",
     "CheckpointConfig",
+    "CheckpointManager",
+    "checkpointing",
+    "register_preemption_hook",
     "FailureConfig",
     "RunConfig",
     "ScalingConfig",

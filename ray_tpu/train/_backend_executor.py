@@ -61,6 +61,13 @@ class _TrainWorker:
             return result
         finally:
             _set_session(None)
+            # the executor kills this worker right after the result lands;
+            # push buffered telemetry (checkpoint_save spans, save-seconds
+            # histogram) ahead of it — pipe FIFO makes the batch arrive
+            # before the task result, so nothing is lost to the kill
+            from ray_tpu._private import telemetry
+
+            telemetry.flush()
 
 
 class BackendExecutor:

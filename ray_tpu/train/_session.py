@@ -11,13 +11,30 @@ from __future__ import annotations
 import os
 import shutil
 import threading
-import uuid
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
+from ray_tpu.train import checkpointing
 from ray_tpu.train._checkpoint import Checkpoint
 
 _session_local = threading.local()
+
+
+def _manifest_step(path: str):
+    """Step recorded in a restored checkpoint's manifest (from_uri cache
+    slots keep their MANIFEST.json precisely so resume can continue the
+    numbering)."""
+    import json
+
+    from ray_tpu._private.external_storage import MANIFEST_FILE
+
+    try:
+        with open(os.path.join(path, MANIFEST_FILE)) as fh:
+            step = json.load(fh).get("step")
+        return int(step) if step is not None else None
+    except (OSError, ValueError, TypeError):
+        return None
 
 
 @dataclass
@@ -46,26 +63,81 @@ class _Session:
     def __init__(self, context: TrainContext, collector, latest_checkpoint: Optional[Checkpoint]):
         self.context = context
         self.collector = collector  # ActorHandle of _ReportCollector (or None)
-        self.latest_checkpoint = latest_checkpoint
+        # resume continues the step numbering: a restarted attempt must not
+        # re-emit checkpoint_000001 over an already-committed step 1 (the
+        # overwrite would invalidate its manifest digests)
         self.iteration = 0
+        if latest_checkpoint is not None:
+            step = checkpointing.parse_step(
+                os.path.basename(latest_checkpoint.path.rstrip("/"))
+            )
+            if step is None:
+                step = _manifest_step(latest_checkpoint.path)
+            if step is not None:
+                self.iteration = step
+        # sharded resume: a multi-rank committed checkpoint is a step dir of
+        # shard-{rank}-of-{world} subdirs; each rank sees its own shard,
+        # falling back to rank 0's (a rank-0-only checkpoint carries the
+        # gathered state every rank restores from)
+        if latest_checkpoint is not None and context.world_size > 1:
+            for rank in (context.world_rank, 0):
+                shard = os.path.join(
+                    latest_checkpoint.path,
+                    checkpointing.shard_dir_name(rank, context.world_size),
+                )
+                if os.path.isdir(shard):
+                    latest_checkpoint = Checkpoint(shard)
+                    break
+        self.latest_checkpoint = latest_checkpoint
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         self.iteration += 1
         ckpt_path = None
-        # only rank 0's checkpoint is persisted and tracked (parity: Train's
-        # default; per-shard checkpointing composes via rank-0 gathering) —
-        # other ranks' copies would otherwise accumulate untracked on disk
-        if checkpoint is not None and self.context.world_rank != 0:
-            checkpoint = None
         if checkpoint is not None:
-            # persist the checkpoint under the trial dir (parity: StorageContext
-            # upload, _internal/storage.py)
-            dest = os.path.join(
-                self.context.trial_dir,
-                f"checkpoint_{self.iteration:06d}_{uuid.uuid4().hex[:6]}",
+            # checkpoint plane save path: EVERY rank snapshots its shard
+            # locally (O(local-copy) — this is all train.report blocks on)
+            # and reports it; the head-side manager barriers the shards,
+            # then uploads + commits in the background (parity upgrade over
+            # the reference's rank-0-only blocking upload)
+            from ray_tpu._private.profiling import profile
+
+            step_dir = os.path.join(
+                self.context.trial_dir, checkpointing.step_dir_name(self.iteration)
             )
-            if os.path.abspath(checkpoint.path) != dest:
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            shard = checkpointing.shard_dir_name(
+                self.context.world_rank, self.context.world_size
+            )
+            dest = os.path.join(step_dir, shard) if shard else step_dir
+            t0 = time.monotonic()
+            with profile(
+                "checkpoint_save",
+                {"step": self.iteration, "rank": self.context.world_rank},
+            ):
+                from ray_tpu._private import external_storage as _xstorage
+
+                # a committed step dir is NEVER mutated in place (an
+                # explicit resume below an old run's latest step can land
+                # here): demote it by unlinking just its markers — each
+                # write is atomic and idempotent, so concurrent ranks can
+                # all demote without wiping each other's fresh shards (a
+                # full delete_prefix here raced exactly that way)
+                for mark in (_xstorage.COMMIT_FILE, _xstorage.MANIFEST_FILE):
+                    try:
+                        os.unlink(os.path.join(step_dir, mark))
+                    except OSError:
+                        pass
+                if os.path.abspath(checkpoint.path) != dest:
+                    shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                # a RESTORED checkpoint carries its old markers (and the
+                # restore cache's .complete): drop them from the snapshot,
+                # or the new step dir looks committed before it is — and a
+                # crash before the real commit would resume from a torn dir
+                for mark in (_xstorage.COMMIT_FILE, _xstorage.MANIFEST_FILE, ".complete"):
+                    try:
+                        os.unlink(os.path.join(dest, mark))
+                    except OSError:
+                        pass
+            checkpointing.observe_save_seconds(time.monotonic() - t0)
             ckpt_path = dest
         if self.collector is not None:
             import ray_tpu
@@ -77,12 +149,21 @@ class _Session:
             )
 
 
+_session_fallback: Optional[_Session] = None
+
+
 def _set_session(session: Optional[_Session]):
+    global _session_fallback
     _session_local.session = session
+    # process-wide fallback: the SIGTERM preemption drain runs hooks on a
+    # side thread, where the thread-local is unset — a worker runs one
+    # train session at a time, so the fallback is unambiguous there
+    _session_fallback = session
 
 
 def _get_session() -> Optional[_Session]:
-    return getattr(_session_local, "session", None)
+    session = getattr(_session_local, "session", None)
+    return session if session is not None else _session_fallback
 
 
 def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:

@@ -116,51 +116,46 @@ class JaxTrainer:
         return wrapped
 
     def fit(self) -> Result:
-        from ray_tpu._private import external_storage as _xstorage
+        from ray_tpu.train import checkpointing
 
         name = self.run_config.name or f"JaxTrainer_{time.strftime('%Y%m%d_%H%M%S')}"
-        storage_path = self.run_config.resolved_storage_path()
-        storage_uri = None
-        if _xstorage.has_scheme(storage_path) and not storage_path.startswith("file://"):
-            # external storage: train into a local staging dir, mirror each
-            # checkpoint out through the storage backend (parity: the
-            # reference's storage_path sync to FS/S3)
-            storage_uri = _xstorage.join(storage_path, name)
-            import tempfile
-
-            trial_dir = os.path.join(
-                tempfile.gettempdir(), f"ray_tpu_trial_{name}_{os.getpid()}"
-            )
-        elif storage_path.startswith("file://"):
-            trial_dir = os.path.join(storage_path[len("file://"):], name)
-        else:
-            trial_dir = os.path.join(storage_path, name)
+        # external storage: train into a local staging dir, mirror each
+        # checkpoint out through the commit protocol (parity: the
+        # reference's storage_path sync to FS/S3)
+        trial_dir, storage_uri = checkpointing.resolve_staging(
+            self.run_config.resolved_storage_path(), name, kind="trial"
+        )
         os.makedirs(trial_dir, exist_ok=True)
 
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = checkpointing.CheckpointManager(
+            trial_dir,
+            storage_uri=storage_uri,
+            world_size=self.scaling_config.num_workers,
+            keep=ckpt_cfg.num_to_keep,
+            run_name=name,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
         executor = BackendExecutor(self.scaling_config, self.run_config, trial_dir)
         last: Dict[str, Any] = {}
-        checkpoints: list = []
 
         def on_report(rank, iteration, metrics, ckpt_path):
             if rank == 0:
                 last.clear()
                 last.update(metrics)
                 last["training_iteration"] = iteration
-                if ckpt_path:
-                    ckpt = Checkpoint(ckpt_path)
-                    if storage_uri is not None:
-                        uri = _xstorage.join(
-                            storage_uri, f"checkpoint_{iteration:06d}"
-                        )
-                        ckpt.to_uri(uri)
-                        ckpt._uploaded_uri = uri  # pruning removes it too
-                    checkpoints.append(
-                        (
-                            {**metrics, "training_iteration": iteration},
-                            ckpt,
-                        )
-                    )
-                    self._prune_checkpoints(checkpoints)
+            # shard barrier: once all world ranks have landed a shard for
+            # this step — or every rank has reported it and at least one
+            # brought a shard (rank-0-only checkpointing) — the manager
+            # commits (manifest + COMMIT) in its background uploader;
+            # train.report never waits on it
+            manager.note_report(
+                rank,
+                iteration,
+                ckpt_path or None,
+                metrics=metrics if rank == 0 else None,
+            )
 
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
@@ -170,59 +165,49 @@ class JaxTrainer:
         if self.datasets:
             config = dict(config or {})
             config["__datasets__"] = self.datasets
-        while True:
-            try:
-                executor.start()
-                latest = checkpoints[-1][1] if checkpoints else self.resume_from_checkpoint
-                run_config = config
-                if self.scaling_config.use_jax_distributed:
-                    # per-attempt rendezvous key suffix (see _wrap_distributed)
-                    run_config = dict(config or {})
-                    run_config["__jaxdist_attempt__"] = attempt
-                executor.run(train_fn, run_config, latest_ckpt=latest, report_callback=on_report)
-                error = None
-                break
-            except Exception as e:  # noqa: BLE001
-                error = e
-                attempt += 1
-                executor.shutdown()
-                if max_failures != -1 and attempt > max_failures:
-                    break
-                time.sleep(1.0)
-            finally:
-                executor.shutdown()
-
-        best = checkpoints[-1][1] if checkpoints else None
-        return Result(metrics=dict(last), checkpoint=best, path=trial_dir, error=error)
-
-    def _prune_checkpoints(self, checkpoints: list) -> None:
-        cfg = self.run_config.checkpoint_config
-        if cfg.num_to_keep is None or len(checkpoints) <= cfg.num_to_keep:
-            return
-        if cfg.checkpoint_score_attribute:
-            reverse = cfg.checkpoint_score_order == "max"
-            checkpoints.sort(
-                key=lambda mc: mc[0].get(cfg.checkpoint_score_attribute, 0.0),
-                reverse=reverse,
-            )
-            doomed = checkpoints[cfg.num_to_keep :]
-            del checkpoints[cfg.num_to_keep :]
-            checkpoints.sort(key=lambda mc: mc[0].get("training_iteration", 0))
-        else:
-            doomed = checkpoints[: -cfg.num_to_keep]
-            del checkpoints[: -cfg.num_to_keep]
-        import shutil
-
-        from ray_tpu._private import external_storage as _xstorage
-
-        for _, ckpt in doomed:
-            shutil.rmtree(ckpt.path, ignore_errors=True)
-            # num_to_keep governs the EXTERNAL copies too, or a long run
-            # accumulates every checkpoint in the backend
-            uri = getattr(ckpt, "_uploaded_uri", None)
-            if uri:
+        try:
+            while True:
                 try:
-                    for key in _xstorage.list_uri(uri.rstrip("/") + "/"):
-                        _xstorage.delete(key)
-                except Exception:
-                    pass
+                    executor.start()
+                    # auto-resume: a retried attempt restarts every rank
+                    # from the latest COMMITTED step (drain in-flight
+                    # commits first so a barriered save isn't abandoned) —
+                    # never from a partial, uncommitted upload. The FIRST
+                    # attempt honors an explicit resume_from_checkpoint
+                    # even when the (reused) trial dir holds older commits.
+                    if attempt == 0 and self.resume_from_checkpoint is not None:
+                        latest = self.resume_from_checkpoint
+                    else:
+                        latest = manager.latest_checkpoint() or self.resume_from_checkpoint
+                    run_config = config
+                    if self.scaling_config.use_jax_distributed:
+                        # per-attempt rendezvous key suffix (see _wrap_distributed)
+                        run_config = dict(config or {})
+                        run_config["__jaxdist_attempt__"] = attempt
+                    executor.run(train_fn, run_config, latest_ckpt=latest, report_callback=on_report)
+                    error = None
+                    break
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                    attempt += 1
+                    executor.shutdown()
+                    # MUST fully drain before the retry: its ranks rewrite
+                    # the same step dirs a still-running commit may be
+                    # hashing — a bounded wait that gave up would let the
+                    # two interleave into a torn-but-"committed" dir
+                    manager.wait()
+                    manager.reset_barrier()
+                    if max_failures != -1 and attempt > max_failures:
+                        break
+                    time.sleep(1.0)
+                finally:
+                    executor.shutdown()
+        finally:
+            # drain the upload queue before returning: fit()'s contract is
+            # that every fully-reported checkpoint is committed (or failed
+            # loudly) by the time the Result exists
+            manager.wait(timeout=120.0)
+            manager.shutdown()
+
+        best = manager.latest_checkpoint()
+        return Result(metrics=dict(last), checkpoint=best, path=trial_dir, error=error)

@@ -21,6 +21,7 @@ import cloudpickle
 import ray_tpu
 from ray_tpu import exceptions as exc
 from ray_tpu.train._config import RunConfig
+from ray_tpu.train import checkpointing
 from ray_tpu.train._result import Result
 from ray_tpu.train._session import TrainContext, _Session, _set_session
 from ray_tpu.train._checkpoint import Checkpoint
@@ -52,7 +53,10 @@ class _TrialActor:
     def run(self, fn_blob: bytes, config: dict, collector, ckpt_path=None):
         fn = cloudpickle.loads(fn_blob)
         ctx = TrainContext(world_rank=0, world_size=1, trial_dir=self.trial_dir)
-        initial = Checkpoint(ckpt_path) if ckpt_path else None
+        # resume routes through the checkpoint plane: a URI restores via the
+        # digest-verified committed path (so a trial rescheduled onto
+        # another node is not stuck chasing a dead node's local dir)
+        initial = checkpointing.load_checkpoint(ckpt_path) if ckpt_path else None
         session = _Session(ctx, collector, initial)
         # reports carry the trial id instead of a worker rank
         session.collector = _CollectorProxy(self.trial_id, collector)
@@ -61,6 +65,11 @@ class _TrialActor:
             return fn(config)
         finally:
             _set_session(None)
+            # trial actors are killed right after their result: flush
+            # buffered telemetry (checkpoint_save spans etc.) ahead of it
+            from ray_tpu._private import telemetry
+
+            telemetry.flush()
 
 
 class _CollectorProxy:
@@ -136,11 +145,22 @@ class Tuner:
         Parity: ``Tuner.restore`` + the periodic experiment snapshot
         (``python/ray/tune/execution/experiment_state.py:1``). Unfinished
         trials are re-queued (from their last checkpoint when one exists);
-        finished trials keep their results.
+        finished trials keep their results. ``path`` may be a local
+        experiment dir or a ``scheme://`` URI — the snapshot and trial
+        checkpoints are mirrored to external storage, so a driver on a
+        fresh node can restore the whole experiment from the URI.
         """
-        state_file = os.path.join(path, "experiment_state.pkl")
-        with open(state_file, "rb") as fh:
-            snap = cloudpickle.loads(fh.read())
+        from ray_tpu._private import external_storage as _xstorage
+
+        if _xstorage.has_scheme(path) and not path.startswith("file://"):
+            blob = _xstorage.read_bytes(_xstorage.join(path, "experiment_state.pkl"))
+            if blob is None:
+                raise FileNotFoundError(f"no experiment_state.pkl under {path}")
+            snap = cloudpickle.loads(blob)
+        else:
+            state_file = os.path.join(path, "experiment_state.pkl")
+            with open(state_file, "rb") as fh:
+                snap = cloudpickle.loads(fh.read())
         tuner = cls(
             trainable if trainable is not None else cloudpickle.loads(snap["fn_blob"]),
             param_space=snap["param_space"],
@@ -151,7 +171,8 @@ class Tuner:
         return tuner
 
     @staticmethod
-    def _snapshot(exp_dir, trials, fn_blob, param_space, tune_config, run_config):
+    def _snapshot(exp_dir, trials, fn_blob, param_space, tune_config, run_config,
+                  exp_uri=None):
         snap = {
             "fn_blob": fn_blob,
             "param_space": param_space,
@@ -164,21 +185,43 @@ class Tuner:
                     "iteration": t["iteration"],
                     "last_metrics": t["last_metrics"],
                     "checkpoint_path": t["checkpoint"].path if t["checkpoint"] else None,
+                    "checkpoint_uri": t.get("checkpoint_uri"),
                     "dir": t["dir"],
                 }
                 for tid, t in trials.items()
             },
         }
+        blob = cloudpickle.dumps(snap)
         tmp = os.path.join(exp_dir, ".experiment_state.tmp")
         with open(tmp, "wb") as fh:
-            fh.write(cloudpickle.dumps(snap))
+            fh.write(blob)
         os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+        if exp_uri is not None:
+            # mirror the snapshot next to the mirrored trial checkpoints so
+            # Tuner.restore(uri) works from any node (backend writes are
+            # atomic per object)
+            from ray_tpu._private import external_storage as _xstorage
+
+            try:
+                _xstorage.write_bytes(
+                    _xstorage.join(exp_uri, "experiment_state.pkl"), blob
+                )
+            except Exception:
+                pass  # next periodic snapshot retries
 
     def fit(self) -> ResultGrid:
+        from ray_tpu._private import external_storage as _xstorage
+
         cfg = self.tune_config
         exp_name = self.run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
-        exp_dir = os.path.join(self.run_config.resolved_storage_path(), exp_name)
+        # external storage: trials stage locally, every checkpoint is
+        # committed out through a per-trial CheckpointManager and the
+        # experiment snapshot is mirrored beside them
+        exp_dir, exp_uri = checkpointing.resolve_staging(
+            self.run_config.resolved_storage_path(), exp_name, kind="tune"
+        )
         os.makedirs(exp_dir, exist_ok=True)
+        ckpt_managers: Dict[str, checkpointing.CheckpointManager] = {}
 
         scheduler = cfg.scheduler or FIFOScheduler()
         fn_blob = cloudpickle.dumps(self._as_function())
@@ -202,6 +245,12 @@ class Tuner:
         if self._restored is not None:
             for tid, st in self._restored["trials"].items():
                 ckpt = Checkpoint(st["checkpoint_path"]) if st["checkpoint_path"] else None
+                # prefer the node-local copy when it survived; fall back to
+                # the committed URI (the restore-on-another-node path); a
+                # dead local path with no mirror restarts from scratch
+                resume_from = st["checkpoint_path"]
+                if not (resume_from and os.path.isdir(resume_from)):
+                    resume_from = st.get("checkpoint_uri")
                 trials[tid] = {
                     "config": st["config"],
                     "state": st["state"],
@@ -210,9 +259,10 @@ class Tuner:
                     "last_metrics": st["last_metrics"],
                     "iteration": st["iteration"],
                     "checkpoint": ckpt,
+                    "checkpoint_uri": st.get("checkpoint_uri"),
                     "error": None,
                     "dir": st["dir"],
-                    "resume_from": st["checkpoint_path"],
+                    "resume_from": resume_from,
                 }
                 if st["state"] in ("PENDING", "RUNNING"):
                     trials[tid]["state"] = "PENDING"
@@ -238,6 +288,7 @@ class Tuner:
                     "last_metrics": {},
                     "iteration": 0,
                     "checkpoint": None,
+                    "checkpoint_uri": None,
                     "error": None,
                     "dir": os.path.join(exp_dir, tid),
                     "resume_from": None,
@@ -253,8 +304,20 @@ class Tuner:
             if t["config"] is None:
                 t["config"] = search_alg.suggest(tid)
             os.makedirs(t["dir"], exist_ok=True)
+            resume = t.get("resume_from")
+            if resume and _xstorage.has_scheme(resume) and not resume.startswith("file://"):
+                # materialize the committed checkpoint driver-side (the
+                # driver holds the backend registrations; workers get a
+                # digest-verified local directory). If the exact step the
+                # snapshot recorded never committed (driver died mid-upload)
+                # fall back to the trial's newest committed step.
+                try:
+                    resume = checkpointing.load_checkpoint(resume).path
+                except (FileNotFoundError, _xstorage.IntegrityError):
+                    ckpt = checkpointing.latest_checkpoint(resume.rsplit("/", 1)[0])
+                    resume = ckpt.path if ckpt is not None else None
             actor = _TrialActor.remote(tid, t["dir"])
-            ref = actor.run.remote(fn_blob, t["config"], collector, t.get("resume_from"))
+            ref = actor.run.remote(fn_blob, t["config"], collector, resume)
             t.update(state="RUNNING", actor=actor, ref=ref)
             running[ref] = tid
 
@@ -289,6 +352,25 @@ class Tuner:
                 t["iteration"] = iteration
                 if ckpt_path:
                     t["checkpoint"] = Checkpoint(ckpt_path)
+                    if exp_uri is not None:
+                        # commit the trial checkpoint to external storage
+                        # through the plane (async, digest-verified): the
+                        # URI is what a restore on another node resumes from
+                        mgr = ckpt_managers.get(tid)
+                        if mgr is None:
+                            mgr = ckpt_managers[tid] = checkpointing.CheckpointManager(
+                                t["dir"],
+                                storage_uri=_xstorage.join(exp_uri, tid),
+                                world_size=1,
+                                keep=self.run_config.checkpoint_config.num_to_keep,
+                                run_name=f"{exp_name}/{tid}",
+                            )
+                        step = checkpointing.parse_step(os.path.basename(ckpt_path))
+                        if step is not None:
+                            mgr.note_shard(0, step, ckpt_path, metrics=metrics)
+                            t["checkpoint_uri"] = _xstorage.join(
+                                exp_uri, tid, checkpointing.step_dir_name(step)
+                            )
                 logged = {**metrics, "training_iteration": iteration,
                           "trial_id": tid}
                 loggers.log_result(tid, t["dir"], logged)
@@ -348,11 +430,16 @@ class Tuner:
                 last_snap = now
                 self._snapshot(
                     exp_dir, trials, fn_blob, self.param_space,
-                    self.tune_config, self.run_config,
+                    self.tune_config, self.run_config, exp_uri=exp_uri,
                 )
+        # drain the per-trial checkpoint managers BEFORE the final snapshot,
+        # so the snapshot's checkpoint_uri entries are all committed
+        for mgr in ckpt_managers.values():
+            mgr.wait(timeout=60.0)
+            mgr.shutdown()
         self._snapshot(
             exp_dir, trials, fn_blob, self.param_space,
-            self.tune_config, self.run_config,
+            self.tune_config, self.run_config, exp_uri=exp_uri,
         )
         loggers.close()
 

@@ -9,6 +9,7 @@ backed by the scheduler's task-event buffer and tables (the reference's
 from ray_tpu.util.state.api import (
     get_log,
     list_actors,
+    list_checkpoints,
     list_cluster_events,
     list_logs,
     list_nodes,
@@ -22,6 +23,7 @@ from ray_tpu.util.state.api import (
 __all__ = [
     "list_tasks",
     "list_actors",
+    "list_checkpoints",
     "list_objects",
     "list_nodes",
     "list_workers",

@@ -102,6 +102,39 @@ def list_cluster_events(filters=None, limit: int = 10_000) -> List[dict]:
     return _list("list_cluster_events", filters, limit)
 
 
+def list_checkpoints(filters=None, limit: int = 10_000) -> List[dict]:
+    """Checkpoints of every run registered with the checkpoint plane
+    (``ray_tpu.train.checkpointing``): one row per checkpoint prefix with
+    ``run`` / ``step`` / ``committed`` / ``path`` (+ manifest metadata for
+    committed ones). The registry lives in the GCS KV; the storage scan
+    happens caller-side so ``memory://`` test backends resolve in the
+    calling process. Uncommitted rows are in-flight or crashed saves —
+    readers (``latest``, ``Checkpoint.from_uri``) never restore them."""
+    from ray_tpu.train import checkpointing
+
+    rows: List[dict] = []
+    for entry in checkpointing.registered_runs():
+        by_step: Dict[int, dict] = {}
+        for base_key, base in (
+            ("local", entry.get("local_base")),
+            ("storage", entry.get("storage_uri")),
+        ):
+            if not base:
+                continue
+            for row in checkpointing.list_checkpoints(base):
+                row["run"] = entry.get("run")
+                row["location"] = base_key
+                cur = by_step.get(row["step"])
+                # one logical row per step per run; a COMMITTED copy in
+                # either location wins over an uncommitted one (e.g. a
+                # half-GC'd local dir with an intact storage mirror)
+                if cur is None or (row["committed"] and not cur["committed"]):
+                    by_step[row["step"]] = row
+        rows.extend(by_step.values())
+    rows.sort(key=lambda r: (r.get("run") or "", -(r.get("step") or 0)))
+    return _filtered(rows, filters)[:limit]
+
+
 def _session_logs_dir() -> str:
     import os
 

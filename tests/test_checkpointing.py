@@ -1,0 +1,633 @@
+"""Checkpoint plane: commit protocol, chaos (crashes mid-save/upload),
+auto-resume, retention, and the state/CLI surfaces.
+
+The invariant under test everywhere: a crash injected at ANY point of
+save/upload never lets ``latest()`` / ``Checkpoint.from_uri`` observe an
+uncommitted or digest-mismatched checkpoint.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import external_storage as storage
+from ray_tpu.train import checkpointing
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+def _make_src(tmp_path, name="src", files=(("a.bin", b"A" * 256), ("sub/b.txt", b"hello"))):
+    src = tmp_path / name
+    for rel, data in files:
+        p = src / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+    return str(src)
+
+
+class _FaultyBackend(storage.MemoryBackend):
+    """Raises after ``fail_after`` writes — the crash-injection hook: the
+    uploader dies at an arbitrary point mid-upload."""
+
+    def __init__(self):
+        super().__init__()
+        self.writes = 0
+        self.fail_after = None
+
+    def write_bytes(self, path, data):
+        self.writes += 1
+        if self.fail_after is not None and self.writes > self.fail_after:
+            raise OSError("injected storage failure")
+        super().write_bytes(path, data)
+
+
+class _SlowBackend(storage.MemoryBackend):
+    def __init__(self):
+        super().__init__()
+        self.delay_s = 0.0
+
+    def write_bytes(self, path, data):
+        time.sleep(self.delay_s)
+        super().write_bytes(path, data)
+
+
+@pytest.fixture
+def faulty_scheme():
+    backend = _FaultyBackend()
+    storage.register_backend("faulty", lambda: backend)
+    yield backend
+    storage._FACTORIES.pop("faulty", None)
+    storage._BACKENDS.pop("faulty", None)
+
+
+@pytest.fixture
+def slow_scheme():
+    backend = _SlowBackend()
+    storage.register_backend("slowst", lambda: backend)
+    yield backend
+    storage._FACTORIES.pop("slowst", None)
+    storage._BACKENDS.pop("slowst", None)
+
+
+# --------------------------------------------------------------------------
+# commit protocol
+# --------------------------------------------------------------------------
+
+
+def test_crash_at_every_point_of_upload_never_observable(tmp_path, faulty_scheme):
+    """Sweep the crash point across the ENTIRE upload (every write index,
+    payload through markers): readers must either see nothing or the fully
+    committed checkpoint — no middle state."""
+    src = _make_src(tmp_path)
+    total_writes = len(storage.build_manifest(src)["files"]) + 2  # + manifest + COMMIT
+    for crash_at in range(total_writes):
+        base = f"faulty://sweep{crash_at}"
+        uri = storage.join(base, checkpointing.step_dir_name(1))
+        faulty_scheme.writes, faulty_scheme.fail_after = 0, crash_at
+        with pytest.raises(OSError):
+            storage.commit_dir_to_uri(src, uri)
+        faulty_scheme.fail_after = None
+        assert not storage.is_committed(uri)
+        assert checkpointing.latest_step(base) is None
+        with pytest.raises(FileNotFoundError):
+            Checkpoint.from_uri(uri)
+    # the un-crashed run commits and restores
+    faulty_scheme.fail_after = None
+    uri = storage.join("faulty://sweepok", checkpointing.step_dir_name(1))
+    storage.commit_dir_to_uri(src, uri)
+    assert checkpointing.latest_step("faulty://sweepok") == 1
+    restored = Checkpoint.from_uri(uri)
+    assert (
+        open(os.path.join(restored.path, "sub", "b.txt"), "rb").read() == b"hello"
+    )
+
+
+def test_uploader_killed_mid_upload_latest_stays_on_committed(tmp_path, faulty_scheme):
+    """Manager-level chaos: the background uploader dies mid-upload of step
+    2; latest() keeps answering step 1 and the failure is recorded (and
+    surfaces as CHECKPOINT_FAILED, not silence)."""
+    base = str(tmp_path / "run")
+    os.makedirs(base)
+    mgr = checkpointing.CheckpointManager(
+        base, storage_uri="faulty://chaos", world_size=1, run_name="chaos"
+    )
+    sd1 = os.path.join(base, checkpointing.step_dir_name(1))
+    os.makedirs(sd1)
+    (lambda p: open(p, "wb").write(b"one"))(os.path.join(sd1, "w.bin"))
+    assert mgr.note_shard(0, 1, sd1)
+    assert mgr.wait(timeout=30)
+    assert checkpointing.latest_step("faulty://chaos") == 1
+
+    faulty_scheme.fail_after = faulty_scheme.writes + 1  # die mid-step-2 upload
+    sd2 = os.path.join(base, checkpointing.step_dir_name(2))
+    os.makedirs(sd2)
+    open(os.path.join(sd2, "w.bin"), "wb").write(b"two")
+    assert mgr.note_shard(0, 2, sd2)
+    assert mgr.wait(timeout=30)
+    faulty_scheme.fail_after = None
+    assert checkpointing.latest_step("faulty://chaos") == 1  # never the partial
+    assert 2 in mgr.failures()
+    mgr.shutdown()
+
+
+def test_digest_mismatch_refused(tmp_path):
+    src = _make_src(tmp_path, files=(("a.bin", b"A" * 256), ("u.txt", b"digests")))
+    uri = "memory://digest/checkpoint_000001"
+    storage.commit_dir_to_uri(src, uri)
+    # corrupt one payload byte post-commit (bit-rot / torn overwrite); drop
+    # the restore cache so the read actually hits the corrupted storage (a
+    # cache hit would legitimately serve the digest-valid earlier copy)
+    storage.write_bytes(storage.join(uri, "a.bin"), b"B" * 256)
+    checkpointing.clear_restore_cache()
+    with pytest.raises(storage.IntegrityError):
+        Checkpoint.from_uri(uri)
+    # verify_checkpoint agrees
+    with pytest.raises(storage.IntegrityError):
+        checkpointing.verify_checkpoint(uri)
+
+
+def test_from_uri_cache_reuse_no_temp_leak(tmp_path):
+    """The seed leaked one ckpt_dl_* dir per from_uri call; committed
+    restores now share a digest-keyed cache slot."""
+    src = _make_src(tmp_path)
+    uri = "memory://cache/checkpoint_000001"
+    storage.commit_dir_to_uri(src, uri)
+    a = Checkpoint.from_uri(uri)
+    b = Checkpoint.from_uri(uri)
+    assert a.path == b.path
+    # legacy (uncommitted) prefixes rotate generations in a per-URI slot:
+    # re-download semantics, bounded disk (current + previous kept)
+    legacy = "memory://cache/legacy"
+    storage.write_bytes(storage.join(legacy, "x.bin"), b"x")
+    paths = [
+        Checkpoint.from_uri(legacy, allow_uncommitted=True).path for _ in range(4)
+    ]
+    slot = os.path.dirname(paths[-1])
+    assert all(os.path.dirname(p) == slot for p in paths)
+    assert len(os.listdir(slot)) <= 2, os.listdir(slot)
+
+
+def test_async_save_returns_in_local_copy_time(tmp_path, slow_scheme):
+    """note_shard (what train.report blocks on past the local copy) must
+    not wait for the upload: with a 0.2s-per-write backend, the report
+    path returns immediately and the commit lands in the background."""
+    base = str(tmp_path / "run")
+    os.makedirs(base)
+    slow_scheme.delay_s = 0.2
+    mgr = checkpointing.CheckpointManager(
+        base, storage_uri="slowst://bg", world_size=1, run_name="bg"
+    )
+    sd = os.path.join(base, checkpointing.step_dir_name(1))
+    os.makedirs(sd)
+    open(os.path.join(sd, "w.bin"), "wb").write(b"payload")
+    t0 = time.monotonic()
+    assert mgr.note_shard(0, 1, sd)
+    enqueue_s = time.monotonic() - t0
+    assert enqueue_s < 0.15, f"note_shard blocked on the upload ({enqueue_s:.3f}s)"
+    assert checkpointing.latest_step("slowst://bg") is None or enqueue_s < 0.15
+    assert mgr.wait(timeout=30)
+    assert checkpointing.latest_step("slowst://bg") == 1
+    mgr.shutdown()
+
+
+def test_retention_gc_keep_and_uncommitted_garbage(tmp_path):
+    base = str(tmp_path / "run")
+    os.makedirs(base)
+    for step in (1, 2, 3):
+        sd = os.path.join(base, checkpointing.step_dir_name(step))
+        os.makedirs(sd)
+        open(os.path.join(sd, "w.bin"), "wb").write(bytes([step]) * 32)
+        if step != 2:  # step 2 simulates a crashed, never-committed save
+            storage.write_commit_markers(
+                sd, storage.build_manifest(sd, step=step, created=time.time())
+            )
+    deleted = checkpointing.gc_checkpoints(base, keep=1)
+    # keep=1 -> committed step 1 doomed; uncommitted step 2 (older than the
+    # newest committed step 3) is crashed garbage, also reclaimed
+    assert sorted(deleted) == [1, 2]
+    rows = checkpointing.list_checkpoints(base)
+    assert [(r["step"], r["committed"]) for r in rows] == [(3, True)]
+
+
+def test_preemption_hook_can_report_from_drain_thread(tmp_path):
+    """The documented hook pattern — train.report(checkpoint=) one last
+    time — runs on the SIGTERM drain SIDE thread, where the thread-local
+    session is unset; the process-wide fallback must serve it."""
+    from ray_tpu.train._session import TrainContext, _Session, _set_session
+
+    trial = str(tmp_path / "trial")
+    os.makedirs(trial)
+    session = _Session(
+        TrainContext(world_rank=0, world_size=1, trial_dir=trial), None, None
+    )
+    _set_session(session)
+    errors = []
+
+    def hook():
+        try:
+            from ray_tpu import train
+
+            d = str(tmp_path / "src")
+            os.makedirs(d, exist_ok=True)
+            open(os.path.join(d, "final.txt"), "w").write("snap")
+            train.report({"final": 1.0}, checkpoint=train.Checkpoint.from_directory(d))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    checkpointing.register_preemption_hook(hook)
+    try:
+        t = threading.Thread(  # the drain runs hooks off the task thread
+            target=checkpointing.run_preemption_hooks, kwargs={"timeout_s": 10.0}
+        )
+        t.start()
+        t.join(timeout=30)
+    finally:
+        checkpointing.unregister_preemption_hook(hook)
+        _set_session(None)
+    assert not errors, errors
+    assert os.path.isfile(
+        os.path.join(trial, checkpointing.step_dir_name(1), "final.txt")
+    )
+
+
+def test_preemption_hook_commits_pending(tmp_path):
+    """The SIGTERM drain path: user hooks run (may report a final
+    snapshot), then live managers drain so barriered saves reach COMMIT."""
+    base = str(tmp_path / "run")
+    os.makedirs(base)
+    mgr = checkpointing.CheckpointManager(base, world_size=1, run_name="pre")
+    calls = []
+
+    def hook():
+        calls.append(True)
+        sd = os.path.join(base, checkpointing.step_dir_name(7))
+        os.makedirs(sd, exist_ok=True)
+        open(os.path.join(sd, "final.bin"), "wb").write(b"last gasp")
+        mgr.note_shard(0, 7, sd)
+
+    checkpointing.register_preemption_hook(hook)
+    try:
+        checkpointing.run_preemption_hooks(timeout_s=10.0)
+    finally:
+        checkpointing.unregister_preemption_hook(hook)
+        mgr.shutdown()
+    assert calls
+    assert checkpointing.latest_step(base) == 7
+
+
+# --------------------------------------------------------------------------
+# trainer integration (cluster)
+# --------------------------------------------------------------------------
+
+
+def _counting_loop(marker_kill=None, steps=4):
+    """A train loop that checkpoints every step and optionally SIGKILLs
+    itself (non-graceful worker death) once at step 2."""
+
+    def loop(config=None):
+        import os as _os
+        import signal as _signal
+        import tempfile
+
+        from ray_tpu import train
+
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(_os.path.join(ckpt.path, "it.txt")) as fh:
+                start = int(fh.read()) + 1
+        for i in range(start, steps):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "it.txt"), "w") as fh:
+                fh.write(str(i))
+            train.report(
+                {"it": float(i), "resumed_from": float(start)},
+                checkpoint=train.Checkpoint.from_directory(d),
+            )
+            if marker_kill and i == 1 and not _os.path.exists(marker_kill):
+                open(marker_kill, "w").close()
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    return loop
+
+
+def test_worker_killed_mid_run_resumes_from_committed(ray_start_regular, tmp_path):
+    """Chaos acceptance: SIGKILL a train worker mid-run; fit() must resume
+    from the last COMMITTED step and retention must hold (no more than
+    keep checkpoints on disk afterwards)."""
+    from ray_tpu.train import (
+        CheckpointConfig,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    marker = str(tmp_path / "killed_once")
+    keep = 2
+    trainer = JaxTrainer(
+        _counting_loop(marker_kill=marker),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            name="chaos_resume",
+            failure_config=FailureConfig(max_failures=2),
+            checkpoint_config=CheckpointConfig(num_to_keep=keep),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["it"] == 3.0
+    # the retried attempt resumed from committed step 2 (it=1), not scratch
+    assert result.metrics["resumed_from"] == 2.0
+    trial_dir = str(tmp_path / "chaos_resume")
+    ckpt_dirs = [d for d in os.listdir(trial_dir) if d.startswith("checkpoint_")]
+    assert len(ckpt_dirs) <= keep, ckpt_dirs
+    # everything still on disk is committed
+    for d in ckpt_dirs:
+        assert storage.is_committed(os.path.join(trial_dir, d))
+    # the result checkpoint is the digest-valid newest one
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "it.txt")) as fh:
+        assert fh.read() == "3"
+
+
+def test_multiworker_shard_barrier_and_manifest(ray_start_regular, tmp_path):
+    """2 ranks: each reports its own shard; the head barriers them into ONE
+    committed checkpoint whose manifest covers both shards; on resume each
+    rank sees its own shard."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config=None):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        resumed_rank = -1.0
+        if ckpt is not None:
+            with open(_os.path.join(ckpt.path, "rank.txt")) as fh:
+                resumed_rank = float(fh.read())
+        d = tempfile.mkdtemp()
+        with open(_os.path.join(d, "rank.txt"), "w") as fh:
+            fh.write(str(ctx.get_world_rank()))
+        train.report(
+            {"rank": ctx.get_world_rank(), "resumed_rank": resumed_rank},
+            checkpoint=train.Checkpoint.from_directory(d),
+        )
+
+    run_cfg = RunConfig(storage_path=str(tmp_path), name="sharded")
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2), run_config=run_cfg
+    ).fit()
+    assert result.error is None, result.error
+    step_dir = os.path.join(str(tmp_path / "sharded"), checkpointing.step_dir_name(1))
+    manifest = storage.read_committed_manifest(step_dir)
+    assert manifest is not None and manifest["world_size"] == 2
+    shards = {rel.split(os.sep)[0] for rel in manifest["files"]}
+    assert shards == {"shard-00000-of-00002", "shard-00001-of-00002"}
+    # resume: a second fit from that checkpoint gives each rank ITS shard
+    result2 = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="sharded2"),
+        resume_from_checkpoint=result.checkpoint,
+    ).fit()
+    assert result2.error is None, result2.error
+    assert result2.metrics["resumed_rank"] == 0.0  # rank 0 read shard 0
+
+
+def test_rank0_only_checkpoint_still_commits(ray_start_regular, tmp_path):
+    """The reference's default gather pattern — only rank 0 reports a
+    checkpoint — must commit a single-shard checkpoint once every rank has
+    reported the step (not stall the barrier forever)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config=None):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp()
+            open(_os.path.join(d, "gathered.txt"), "w").write("all ranks state")
+            train.report({"rank": 0}, checkpoint=train.Checkpoint.from_directory(d))
+        else:
+            train.report({"rank": ctx.get_world_rank()})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="rank0only"),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.checkpoint is not None
+    step_dir = os.path.join(str(tmp_path / "rank0only"), checkpointing.step_dir_name(1))
+    manifest = storage.read_committed_manifest(step_dir)
+    assert manifest is not None
+    shards = {rel.split(os.sep)[0] for rel in manifest["files"]}
+    assert shards == {"shard-00000-of-00002"}, shards
+
+
+def test_trainer_commits_to_uri_and_registry(ray_start_regular, tmp_path):
+    """URI storage: checkpoints are committed (not bare-mirrored) to the
+    backend, CHECKPOINT_COMMITTED events land in the cluster event log, and
+    state.list_checkpoints sees the run via the KV registry."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.util import state
+
+    result = JaxTrainer(
+        _counting_loop(steps=2),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="uri_commit", storage_path="memory://ckpt_plane"),
+    ).fit()
+    assert result.error is None, result.error
+    base = "memory://ckpt_plane/uri_commit"
+    assert checkpointing.latest_step(base) == 2
+    restored = Checkpoint.from_uri(
+        storage.join(base, checkpointing.step_dir_name(2))
+    )
+    with open(os.path.join(restored.path, "it.txt")) as fh:
+        assert fh.read() == "1"
+    rows = state.list_checkpoints(filters=[("run", "=", "uri_commit")])
+    assert rows and all(r["committed"] for r in rows if r["step"] == 2)
+    events = state.list_cluster_events(filters=[("type", "=", "CHECKPOINT_COMMITTED")])
+    assert any(e.get("run") == "uri_commit" for e in events), events
+    # save/commit spans ride the telemetry plane into the timeline
+    names = {e.get("name") for e in ray_tpu.timeline()}
+    assert any(n and "checkpoint_commit" in n for n in names), sorted(
+        n for n in names if n
+    )[:40]
+
+
+def test_tuner_resume_from_uri_after_node_loss(tmp_path):
+    """Satellite: a tune experiment on external storage survives losing
+    BOTH the driver and the node-local staging dirs — Tuner.restore(uri)
+    resumes trials from committed checkpoint URIs."""
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    import ray_tpu as rt
+
+    store = tmp_path / "store"
+    script = textwrap.dedent(f"""
+        import ray_tpu, time
+        from ray_tpu import tune
+        from ray_tpu._private import external_storage as storage
+        from ray_tpu.train import Checkpoint, RunConfig, report
+        storage.register_backend("mock", storage.FileBackend)
+        ray_tpu.init(num_cpus=2)
+
+        def slow_trial(config):
+            import os, tempfile
+            for i in range(40):
+                d = tempfile.mkdtemp()
+                open(os.path.join(d, "it.txt"), "w").write(str(i))
+                report({{"step": i, "tag": config["tag"]}},
+                       checkpoint=Checkpoint.from_directory(d))
+                time.sleep(0.4)
+
+        tune.Tuner(
+            slow_trial,
+            param_space={{"tag": tune.grid_search([1, 2])}},
+            tune_config=tune.TuneConfig(num_samples=1, max_concurrent_trials=2),
+            run_config=RunConfig(storage_path="mock://{store}", name="uexp"),
+        ).fit()
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(rt.__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env)
+    # wait until both trials have a committed checkpoint in the mirror AND
+    # the snapshot is mirrored, then kill the driver mid-sweep
+    storage.register_backend("mock", storage.FileBackend)
+    exp_uri = f"mock://{store}/uexp"
+    deadline = time.monotonic() + 90
+    try:
+        import cloudpickle
+
+        while time.monotonic() < deadline:
+            # the MIRRORED snapshot must already reference a committed URI
+            # for both trials (the 2s mirror cadence lags the commits)
+            snap_blob = storage.read_bytes(
+                storage.join(exp_uri, "experiment_state.pkl")
+            )
+            uris_ok = False
+            if snap_blob:
+                try:
+                    snap = cloudpickle.loads(snap_blob)
+                    uris = [
+                        t.get("checkpoint_uri")
+                        for t in snap["trials"].values()
+                    ]
+                    uris_ok = len(uris) >= 2 and all(
+                        u and storage.is_committed(u) for u in uris
+                    )
+                except Exception:
+                    uris_ok = False
+            if uris_ok:
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError("mirrored snapshot/checkpoints never appeared")
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=15)
+    # simulate restoring on a different node: the old driver's local
+    # staging dirs are gone
+    import shutil
+    import tempfile
+
+    for d in glob.glob(os.path.join(tempfile.gettempdir(), "ray_tpu_tune_uexp_*")):
+        shutil.rmtree(d, ignore_errors=True)
+
+    from ray_tpu import tune
+
+    def fast_trial(config):
+        import os as _os
+
+        from ray_tpu import train
+
+        ckpt = train.get_checkpoint()
+        assert ckpt is not None, "trial did not resume from the URI checkpoint"
+        with open(_os.path.join(ckpt.path, "it.txt")) as fh:
+            start = int(fh.read())
+        train.report({"tag": config["tag"], "resumed_at": start})
+
+    rt.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        results = tune.Tuner.restore(exp_uri, trainable=fast_trial).fit()
+        tags = sorted(r.metrics["tag"] for r in results)
+        assert tags == [1, 2]
+        assert all(r.error is None for r in results)
+        assert all(r.metrics["resumed_at"] >= 0 for r in results)
+    finally:
+        rt.shutdown()
+
+
+def test_ckpt_cli_list_latest_verify_gc(tmp_path, capsys):
+    """``ray_tpu ckpt`` against a bare --storage base (no cluster)."""
+    from ray_tpu.scripts.cli import main as cli_main
+
+    base = str(tmp_path / "clirun")
+    os.makedirs(base)
+    for step in (1, 2):
+        sd = os.path.join(base, checkpointing.step_dir_name(step))
+        os.makedirs(sd)
+        open(os.path.join(sd, "w.bin"), "wb").write(bytes([step]) * 64)
+        storage.write_commit_markers(
+            sd,
+            storage.build_manifest(sd, step=step, created=time.time(), run="clirun"),
+        )
+    cli_main(["ckpt", "list", "--storage", base])
+    out = capsys.readouterr().out
+    assert out.count("COMMITTED") == 2
+    cli_main(["ckpt", "latest", "--storage", base])
+    assert checkpointing.step_dir_name(2) in capsys.readouterr().out
+    cli_main(["ckpt", "verify", os.path.join(base, checkpointing.step_dir_name(1))])
+    assert capsys.readouterr().out.startswith("OK:")
+    cli_main(["ckpt", "gc", "--storage", base, "--keep", "1"])
+    assert "deleted 1" in capsys.readouterr().out
+    rows = checkpointing.list_checkpoints(base)
+    assert [r["step"] for r in rows] == [2]
+
+
+def test_bounded_queue_backpressure(tmp_path, slow_scheme):
+    """max_inflight bounds the upload queue: a burst of saves can only run
+    so far ahead of the uploader (memory safety), and every one commits."""
+    base = str(tmp_path / "run")
+    os.makedirs(base)
+    slow_scheme.delay_s = 0.05
+    mgr = checkpointing.CheckpointManager(
+        base,
+        storage_uri="slowst://burst",
+        world_size=1,
+        max_inflight=2,
+        run_name="burst",
+    )
+    done = []
+
+    def producer():
+        for step in range(1, 7):
+            sd = os.path.join(base, checkpointing.step_dir_name(step))
+            os.makedirs(sd, exist_ok=True)
+            open(os.path.join(sd, "w.bin"), "wb").write(bytes([step]) * 16)
+            mgr.note_shard(0, step, sd)
+            done.append(step)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert mgr.wait(timeout=60)
+    assert checkpointing.latest_step("slowst://burst") == 6
+    assert not mgr.failures()
+    mgr.shutdown()
