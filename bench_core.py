@@ -508,6 +508,91 @@ def main():
     ckpt_plane.clear_restore_cache()
     shutil.rmtree(ck_root, ignore_errors=True)
 
+    # --- elastic-training goodput under churn (ROADMAP item 4
+    # acceptance: a run that loses and regains workers converges to the
+    # same loss as an uninterrupted one, with goodput reported) ---
+    # A 2-worker deterministic SGD run checkpointing elastically every
+    # step, once calm and once with a seeded killer SIGKILLing train
+    # workers mid-epoch; in-run replacement re-forms the group and every
+    # resume is an N→M-capable restore from committed shards.
+    import sys as _sys
+
+    from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    # the seeded killer AND the deterministic workload are the SAME
+    # harness the chaos tests use (one implementation of victim choice,
+    # arming, and the convergence loop — not a bench-local fork)
+    _sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from chaos import ChaosMonkey, elastic_sgd_loop
+
+    el_steps = 20 if args.quick else 30
+    el_sleep = 0.08
+    el_root = tempfile.mkdtemp(prefix="bench_elastic_")
+
+    def _elastic_fit(name):
+        return JaxTrainer(
+            elastic_sgd_loop(el_steps, el_sleep),
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                storage_path=el_root,
+                name=name,
+                failure_config=FailureConfig(
+                    max_failures=8,
+                    retry_backoff_s=0.2,
+                    retry_backoff_jitter=0.0,
+                    replacement_timeout_s=60.0,
+                ),
+            ),
+        ).fit()
+
+    t0 = time.perf_counter()
+    calm = _elastic_fit("calm")
+    calm_wall = time.perf_counter() - t0
+    # arm only once a committed step exists, so every kill provably
+    # forces a resume-from-committed (not a restart-from-scratch)
+    monkey = ChaosMonkey(
+        seed=1729,
+        interval_s=(1.0, 1.8),
+        max_kills=2,
+        arm_when=lambda: (
+            ckpt_plane.latest_step(os.path.join(el_root, "churned")) or 0
+        )
+        >= 2,
+    )
+    t0 = time.perf_counter()
+    monkey.start()
+    churned = _elastic_fit("churned")
+    churn_wall = time.perf_counter() - t0
+    kills = monkey.kills
+    monkey.stop()
+    converged = (
+        calm.error is None
+        and churned.error is None
+        and churned.metrics.get("loss") == calm.metrics.get("loss")
+        and churned.metrics.get("training_iteration") == el_steps
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "elastic_train_goodput",
+                "goodput_churned": round(
+                    (churned.goodput or {}).get("goodput", 0.0), 3
+                ),
+                "goodput_calm": round((calm.goodput or {}).get("goodput", 0.0), 3),
+                "wall_calm_s": round(calm_wall, 2),
+                "wall_churned_s": round(churn_wall, 2),
+                "kills": len(kills),
+                "steps_redone": (churned.goodput or {}).get("steps_redone"),
+                "steps": el_steps,
+                "workers": 2,
+                "converged_identically": converged,
+                "unit": "fraction",
+            }
+        ),
+        flush=True,
+    )
+    shutil.rmtree(el_root, ignore_errors=True)
+
     # per-stage attribution of the driver's put pipeline (serialize /
     # alloc / copy / seal — the same registry event_stats exports)
     from ray_tpu._private import fastcopy

@@ -70,6 +70,30 @@ class StorageBackend:
             fastcopy.copy_into(dest, data)
         return len(data)
 
+    def read_range(
+        self, path: str, offset: int, length: int, make_dest
+    ) -> Optional[int]:
+        """Read ``length`` bytes starting at ``offset`` into a
+        caller-provided buffer (``make_dest(length) -> memoryview`` or
+        None to decline). The elastic re-shard path reads only the byte
+        ranges a new rank owns out of old shards, so backends should
+        override this with a true ranged read where the protocol has one
+        (HTTP Range, pread); the base implementation falls back to a
+        whole-object ``read_bytes`` and slices. Returns the number of
+        bytes read (short when the object ends inside the range), or
+        None when the object does not exist.
+        """
+        data = self.read_bytes(path)
+        if data is None:
+            return None
+        piece = data[offset : offset + length]
+        dest = make_dest(len(piece))
+        if dest is not None and len(piece):
+            from ray_tpu._private import fastcopy
+
+            fastcopy.copy_into(dest, piece)
+        return len(piece)
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -125,6 +149,32 @@ class FileBackend(StorageBackend):
                         return None  # truncated under us: discard the fill
                     off += n
                 return size
+            except OSError:
+                return None
+
+    def read_range(
+        self, path: str, offset: int, length: int, make_dest
+    ) -> Optional[int]:
+        # true ranged read: seek + bounded readinto, no whole-file staging
+        try:
+            fh = open(path, "rb")
+        except OSError:
+            return None
+        with fh:
+            try:
+                size = os.fstat(fh.fileno()).st_size
+                want = max(0, min(length, size - offset))
+                dest = make_dest(want)
+                if dest is None or want == 0:
+                    return want
+                fh.seek(offset)
+                off = 0
+                while off < want:
+                    n = fh.readinto(dest[off : min(off + _READ_CHUNK, want)])
+                    if not n:
+                        return None  # truncated under us: discard the fill
+                    off += n
+                return want
             except OSError:
                 return None
 
@@ -253,6 +303,13 @@ def read_into(uri: str, make_dest) -> Optional[int]:
     path); see :meth:`StorageBackend.read_into` for the contract."""
     backend, path = resolve(uri)
     return backend.read_into(path, make_dest)
+
+
+def read_range(uri: str, offset: int, length: int, make_dest) -> Optional[int]:
+    """Read one byte range of an object into ``make_dest(n)``'s buffer
+    (elastic re-shard restore); see :meth:`StorageBackend.read_range`."""
+    backend, path = resolve(uri)
+    return backend.read_range(path, offset, length, make_dest)
 
 
 def exists(uri: str) -> bool:

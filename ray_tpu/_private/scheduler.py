@@ -3232,17 +3232,34 @@ class Scheduler:
             rows = [_task_row(t) for t in list(self.tasks.values())]
             return self._apply_limit(rows, args)
         if op == "list_actors":
-            rows = [
-                {
-                    "actor_id": a.actor_id.hex(),
-                    "state": a.state,
-                    "name": a.name,
-                    "namespace": a.namespace,
-                    "pending_calls": len(a.pending_calls),
-                    "restarts_left": a.restarts_left,
-                }
-                for a in list(self.actors.values())
-            ]
+            rows = []
+            for a in list(self.actors.values()):
+                w = self.workers.get(a.worker_id) if a.worker_id else None
+                spec_name = (
+                    a.creation_spec.name if a.creation_spec is not None else None
+                )
+                rows.append(
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "state": a.state,
+                        "name": a.name,
+                        "namespace": a.namespace,
+                        "pending_calls": len(a.pending_calls),
+                        "restarts_left": a.restarts_left,
+                        # provenance: which class, where it runs — lets
+                        # tooling (and the chaos harness) target actors by
+                        # kind without holding their handles
+                        "class_name": (
+                            spec_name.rsplit(".", 1)[0] if spec_name else None
+                        ),
+                        "pid": (
+                            w.proc.pid
+                            if w is not None and w.proc is not None
+                            else None
+                        ),
+                        "node_id": w.node_id.hex() if w is not None else None,
+                    }
+                )
             return self._apply_limit(rows, args)
         if op == "list_workers":
             rows = [

@@ -9,7 +9,7 @@ worker group = one actor per TPU host; collectives run inside jit over ICI
 just aligns mesh construction across hosts.
 """
 
-from ray_tpu.train import checkpointing
+from ray_tpu.train import checkpointing, elastic
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.checkpointing import CheckpointManager, register_preemption_hook
 from ray_tpu.train._config import (
@@ -19,7 +19,13 @@ from ray_tpu.train._config import (
     ScalingConfig,
 )
 from ray_tpu.train._result import Result
-from ray_tpu.train._session import get_checkpoint, get_context, report
+from ray_tpu.train._session import (
+    get_checkpoint,
+    get_context,
+    load_elastic,
+    report,
+    report_elastic,
+)
 from ray_tpu.train.jax_trainer import JaxTrainer
 from ray_tpu.train.tensorflow_trainer import TensorflowTrainer, prepare_dataset_shard
 from ray_tpu.train.torch_trainer import TorchTrainer, prepare_data_loader, prepare_model
@@ -41,6 +47,9 @@ __all__ = [
     "prepare_model",
     "prepare_data_loader",
     "report",
+    "report_elastic",
+    "load_elastic",
+    "elastic",
     "get_context",
     "get_checkpoint",
 ]

@@ -6,40 +6,137 @@ worker actors gang-scheduled in a placement group, a per-framework backend
 hook, reports streamed back to the driver. The JAX backend's ``on_start``
 needs no NCCL rendezvous — single-host meshes come from ``jax.devices()`` and
 multi-host alignment is by construction (same program, same mesh).
+
+Beyond the reference (whose failure policy is "tear the group down and
+restart it at the same world size"), this executor is **elastic**: a worker
+or node death keeps the surviving ``_TrainWorker`` actors alive, aborts the
+attempt through the report control plane (survivors unwind at their next
+``train.report``), provisions replacements for the dead ranks — or shrinks
+to whatever the cluster can give within the
+``ScalingConfig.min_workers..num_workers`` band — and re-dispatches every
+rank from the last committed checkpoint with a fresh rendezvous key. The
+whole-gang restart in ``JaxTrainer.fit()`` is the fallback, not the policy.
+It also subscribes to the scheduler's cluster-event log so a preempted
+(WORKER_DIED / NODE_DEAD) or straggling (STRAGGLER, opt-in) rank triggers
+recovery *before* a collective or report timeout would surface it.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.train._config import RunConfig, ScalingConfig
-from ray_tpu.train._session import TrainContext, _Session, _set_session
+from ray_tpu.train._session import AttemptAborted, TrainContext, _Session, _set_session
 from ray_tpu.util.placement_group import placement_group, remove_placement_group
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+# sentinel returned by a worker whose attempt was aborted mid-run (the
+# actor process is fine and will be re-dispatched)
+_ABORTED = "__ray_tpu_attempt_aborted__"
+
+_DEATH_ERRORS = (
+    exc.ActorDiedError,
+    exc.ActorUnavailableError,
+    exc.WorkerCrashedError,
+)
+
+
+class WorkerGroupError(RuntimeError):
+    """In-run elastic recovery failed (could not keep >= min_workers ranks
+    alive). fit() treats this like any attempt failure: whole-gang
+    restart with backoff."""
+
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _get_metrics() -> Dict[str, Any]:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = {
+                "restarts": Counter(
+                    "ray_tpu_train_restarts_total",
+                    "training restarts (kind=in_run: elastic re-dispatch "
+                    "keeping survivors alive; kind=gang: full worker-group "
+                    "teardown + restart)",
+                    tag_keys=("kind",),
+                ),
+                "resizes": Counter(
+                    "ray_tpu_train_resizes_total",
+                    "elastic world-size changes (shrink or regrow) of a "
+                    "live training run",
+                ),
+                "lost_workers": Counter(
+                    "ray_tpu_train_lost_workers_total",
+                    "train workers lost to preemption/crash during a run",
+                ),
+                "goodput": Gauge(
+                    "ray_tpu_train_goodput",
+                    "useful-step-time / wall-time of the training run "
+                    "(1.0 = no time lost to churn, redone steps, or "
+                    "recovery)",
+                    tag_keys=("run",),
+                ),
+            }
+    return _metrics
 
 
 @ray_tpu.remote(num_cpus=0)
 class _ReportCollector:
-    """Buffers (rank, iteration, metrics, checkpoint_path) reports."""
+    """Buffers (rank, iteration, metrics, checkpoint_path) reports, and
+    doubles as the executor→worker control plane: ``report`` responses
+    carry the abort generation when the executor is re-forming the group,
+    so survivors unwind at their next report instead of timing out in a
+    collective against a dead peer."""
 
     def __init__(self):
         self.reports: List[Tuple[int, int, dict, Optional[str]]] = []
+        self._offset = 0  # entries already drained and dropped
+        self._abort_gen: Optional[int] = None
 
     def report(self, rank, iteration, metrics, ckpt_path):
         self.reports.append((rank, iteration, metrics, ckpt_path))
-        return True
+        return True if self._abort_gen is None else self._abort_gen
 
     def drain(self, start: int):
-        return self.reports[start:]
+        # drained entries are never re-read: drop them and keep a running
+        # offset — a long run's full metrics history would otherwise
+        # accumulate in this actor forever
+        idx = max(0, start - self._offset)
+        out = self.reports[idx:]
+        self._offset += len(self.reports)
+        self.reports = []
+        return out
+
+    def buffered(self) -> int:
+        """Entries currently held (regression hook for the trim)."""
+        return len(self.reports)
+
+    def signal_abort(self, generation: int):
+        self._abort_gen = generation
+        return True
+
+    def clear_abort(self):
+        self._abort_gen = None
+        return True
 
 
 @ray_tpu.remote
 class _TrainWorker:
-    """One member of the worker group; runs the user train loop."""
+    """One member of the worker group; runs the user train loop. The
+    actor outlives a single attempt: an aborted or resumed attempt is a
+    new ``run`` dispatch (possibly with a new rank/world after an elastic
+    resize), not a new process."""
 
     def __init__(self, rank: int, world_size: int, trial_dir: str):
         self.context = TrainContext(
@@ -49,7 +146,25 @@ class _TrainWorker:
             trial_dir=trial_dir,
         )
 
-    def run(self, fn_blob: bytes, config: Optional[dict], collector, latest_ckpt):
+    def ping(self):
+        import os
+
+        return os.getpid()
+
+    def run(
+        self,
+        fn_blob: bytes,
+        config: Optional[dict],
+        collector,
+        latest_ckpt,
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+    ):
+        if rank is not None:
+            self.context.world_rank = rank
+            self.context.local_rank = rank
+        if world_size is not None:
+            self.context.world_size = world_size
         fn = cloudpickle.loads(fn_blob)
         session = _Session(self.context, collector, latest_ckpt)
         _set_session(session)
@@ -59,6 +174,10 @@ class _TrainWorker:
             else:
                 result = fn()
             return result
+        except AttemptAborted:
+            # unwound by the executor's abort signal: NOT an error — the
+            # group is re-forming and this actor will be re-dispatched
+            return _ABORTED
         finally:
             _set_session(None)
             # the executor kills this worker right after the result lands;
@@ -70,14 +189,42 @@ class _TrainWorker:
             telemetry.flush()
 
 
+def _record_event(type: str, message: str, severity: str = "INFO", **extra):
+    try:
+        from ray_tpu._private import telemetry
+
+        telemetry.record_cluster_event(
+            type, message, severity=severity, source="TRAIN", **extra
+        )
+    except Exception:
+        pass
+
+
 class BackendExecutor:
     def __init__(self, scaling: ScalingConfig, run_config: RunConfig, trial_dir: str):
         self.scaling = scaling
         self.run_config = run_config
+        self.failure = run_config.failure_config
         self.trial_dir = trial_dir
         self.pg = None
         self.workers: List = []
+        self._bundles: List[Optional[int]] = []
         self.collector = None
+        self._seen = 0  # reports drained from the current collector
+        self._last_event_id = 0
+        self._last_event_poll = 0.0
+        # goodput accounting (persists across gang restarts: one fit call,
+        # one wall clock)
+        self._gp = {
+            "wall_start": None,
+            "useful_s": 0.0,
+            "max_step": 0,
+            "last_ts": None,
+            "steps_useful": 0,
+            "steps_redone": 0,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
 
     def start(self):
         res = self.scaling.worker_resources()
@@ -91,60 +238,38 @@ class BackendExecutor:
         self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy)
         if not self.pg.wait(60):
             remove_placement_group(self.pg)
+            self.pg = None
             raise RuntimeError(
                 f"could not gang-schedule {self.scaling.num_workers} workers "
                 f"with {res} each (cluster too small?)"
             )
         self.collector = _ReportCollector.remote()
-        self.workers = []
-        for rank in range(self.scaling.num_workers):
-            w = _TrainWorker.options(
-                # the actor's demand must equal the bundle's contents — a CPU
-                # default here would never fit a CPU-less bundle
-                num_cpus=res.get("CPU", 0.0),
-                num_tpus=res.get("TPU", 0.0),
-                resources={
-                    k: v for k, v in res.items() if k not in ("CPU", "TPU")
-                },
-                runtime_env=self.scaling.worker_runtime_env,
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    placement_group=self.pg, placement_group_bundle_index=rank
-                ),
-            ).remote(rank, self.scaling.num_workers, self.trial_dir)
-            self.workers.append(w)
-
-    def run(
-        self,
-        train_fn: Callable,
-        config: Optional[dict],
-        latest_ckpt=None,
-        report_callback: Optional[Callable] = None,
-        timeout: Optional[float] = None,
-    ) -> List[Any]:
-        fn_blob = cloudpickle.dumps(train_fn)
-        refs = [
-            w.run.remote(fn_blob, config, self.collector, latest_ckpt)
-            for w in self.workers
+        self._seen = 0
+        self.workers = [
+            self._spawn(rank, self.scaling.num_workers, bundle_index=rank)
+            for rank in range(self.scaling.num_workers)
         ]
-        seen = 0
-        deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(refs)
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=0.5)
-            new = ray_tpu.get(self.collector.drain.remote(seen), timeout=60)
-            seen += len(new)
-            if report_callback:
-                for r in new:
-                    report_callback(*r)
-            for r in ready:
-                ray_tpu.get(r)  # surface worker errors immediately
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("training run timed out")
-        new = ray_tpu.get(self.collector.drain.remote(seen), timeout=60)
-        if report_callback:
-            for r in new:
-                report_callback(*r)
-        return ray_tpu.get(refs)
+        # which pg bundle each live worker occupies (None = unconstrained
+        # replacement) — dead ranks free their bundle for reuse
+        self._bundles: List[Optional[int]] = list(range(self.scaling.num_workers))
+        # ignore cluster events from before this group existed
+        self._last_event_id = self._event_horizon()
+
+    def _spawn(self, rank: int, world: int, bundle_index: Optional[int] = None):
+        res = self.scaling.worker_resources()
+        opts = dict(
+            # the actor's demand must equal the bundle's contents — a CPU
+            # default here would never fit a CPU-less bundle
+            num_cpus=res.get("CPU", 0.0),
+            num_tpus=res.get("TPU", 0.0),
+            resources={k: v for k, v in res.items() if k not in ("CPU", "TPU")},
+            runtime_env=self.scaling.worker_runtime_env,
+        )
+        if bundle_index is not None and self.pg is not None:
+            opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=self.pg, placement_group_bundle_index=bundle_index
+            )
+        return _TrainWorker.options(**opts).remote(rank, world, self.trial_dir)
 
     def shutdown(self):
         for w in self.workers:
@@ -153,6 +278,497 @@ class BackendExecutor:
             except Exception:
                 pass
         self.workers = []
+        self._bundles = []
         if self.pg is not None:
             remove_placement_group(self.pg)
             self.pg = None
+
+    # -- reports / goodput --------------------------------------------------
+
+    def _drain_reports(self, report_callback: Optional[Callable]) -> None:
+        new = ray_tpu.get(self.collector.drain.remote(self._seen), timeout=60)
+        self._seen += len(new)
+        for r in new:
+            self._note_goodput(r)
+            if report_callback:
+                report_callback(*r)
+
+    def _note_goodput(self, report) -> None:
+        rank, iteration = report[0], report[1]
+        if rank != 0:
+            return
+        now = time.monotonic()
+        gp = self._gp
+        if gp["last_ts"] is not None:
+            dt = now - gp["last_ts"]
+            if iteration > gp["max_step"]:
+                gp["useful_s"] += dt
+                gp["steps_useful"] += 1
+            else:
+                gp["steps_redone"] += 1
+        gp["max_step"] = max(gp["max_step"], iteration)
+        gp["last_ts"] = now
+
+    def goodput_stats(self) -> Dict[str, float]:
+        gp = self._gp
+        wall = (
+            time.monotonic() - gp["wall_start"] if gp["wall_start"] else 0.0
+        )
+        return {
+            "wall_s": wall,
+            "useful_step_s": gp["useful_s"],
+            "steps_useful": gp["steps_useful"],
+            "steps_redone": gp["steps_redone"],
+            "goodput": (gp["useful_s"] / wall) if wall > 0 else 0.0,
+        }
+
+    def _publish_goodput(self, run_name: str) -> None:
+        try:
+            _get_metrics()["goodput"].set(
+                round(self.goodput_stats()["goodput"], 4), tags={"run": run_name}
+            )
+        except Exception:
+            pass
+
+    # -- proactive failure detection (cluster-event subscription) ----------
+
+    def _list_events(self, limit: int = 256) -> List[dict]:
+        from ray_tpu._private.worker import get_runtime
+
+        rt = get_runtime()
+        try:
+            if hasattr(rt, "scheduler_rpc"):
+                return rt.scheduler_rpc("list_cluster_events", (limit,)) or []
+            return rt.rpc("list_cluster_events", limit) or []
+        except Exception:
+            return []
+
+    def _event_horizon(self) -> int:
+        rows = self._list_events(limit=1)
+        return rows[-1].get("event_id", 0) if rows else 0
+
+    def _poll_cluster_events(self, ref_to_rank: Dict) -> Dict[int, Exception]:
+        """Ranks the scheduler's forensics plane says we should give up on
+        — before their pending run ref fails or a collective times out.
+        WORKER_DIED/NODE_DEAD name preempted ranks; STRAGGLER (opt-in via
+        FailureConfig.replace_stragglers) names slow ones, which we kill
+        so they fail over to a replacement."""
+        now = time.monotonic()
+        if now - self._last_event_poll < 1.0:
+            return {}
+        self._last_event_poll = now
+        rows = self._list_events()
+        fresh = [e for e in rows if e.get("event_id", 0) > self._last_event_id]
+        if fresh:
+            self._last_event_id = max(e.get("event_id", 0) for e in fresh)
+        if not fresh:
+            return {}
+        by_actor = {
+            w._actor_id.hex(): rank for rank, w in enumerate(self.workers)
+        }
+        by_task = {ref.id().task_id().hex(): rank for ref, rank in ref_to_rank.items()}
+        dead: Dict[int, Exception] = {}
+        dead_nodes = set()
+        for ev in fresh:
+            etype = ev.get("type")
+            if etype == "WORKER_DIED" and ev.get("actor_id") in by_actor:
+                rank = by_actor[ev["actor_id"]]
+                dead[rank] = exc.ActorDiedError(
+                    ev.get("actor_id"), f"preempted: {ev.get('message', '')}"
+                )
+            elif etype == "NODE_DEAD" and ev.get("node_id"):
+                dead_nodes.add(ev["node_id"])
+            elif (
+                etype == "STRAGGLER"
+                and self.failure.replace_stragglers
+                and ev.get("task_id") in by_task
+            ):
+                rank = by_task[ev["task_id"]]
+                try:
+                    ray_tpu.kill(self.workers[rank])
+                except Exception:
+                    pass
+                dead[rank] = exc.ActorDiedError(
+                    self.workers[rank]._actor_id,
+                    f"straggler replaced: {ev.get('message', '')}",
+                )
+        if dead_nodes:
+            # a node died: consult the actor table for which of our ranks
+            # went with it, without waiting for their collectives/reports
+            # to time out. (A ping would NOT work here: _TrainWorker is a
+            # serial actor, so a ping queues behind the whole run() and a
+            # healthy busy rank would look dead.)
+            try:
+                from ray_tpu.util.state import list_actors
+
+                rows = {row.get("actor_id"): row for row in list_actors()}
+            except Exception:
+                rows = {}
+            for aid, rank in by_actor.items():
+                if rank in dead:
+                    continue
+                row = rows.get(aid)
+                if row is not None and (
+                    row.get("node_id") in dead_nodes
+                    or row.get("state") == "DEAD"
+                ):
+                    dead[rank] = exc.ActorDiedError(
+                        aid, "node died under this rank"
+                    )
+        return dead
+
+    # -- the elastic run loop ----------------------------------------------
+
+    def run(
+        self,
+        train_fn: Callable,
+        config: Optional[dict],
+        latest_ckpt=None,
+        report_callback: Optional[Callable] = None,
+        timeout: Optional[float] = None,
+        *,
+        resume_fn: Optional[Callable[[], Any]] = None,
+        prepare_resume: Optional[Callable[[], None]] = None,
+        on_resize: Optional[Callable[[int], None]] = None,
+        attempt_tag: Any = 0,
+        run_name: str = "train",
+    ) -> List[Any]:
+        """Run the user loop on every rank; survive worker loss in-run.
+
+        ``resume_fn`` returns the checkpoint to resume from after a
+        recovery (the latest *committed* one); ``prepare_resume`` runs
+        before each re-dispatch (drain + reset the checkpoint barrier);
+        ``on_resize`` is told the new world size when the group shrinks or
+        regrows. Raises :class:`WorkerGroupError` when recovery cannot
+        hold ``min_workers`` ranks — the caller's whole-gang restart is
+        the fallback."""
+        fn_blob = cloudpickle.dumps(train_fn)
+        if self._gp["wall_start"] is None:
+            self._gp["wall_start"] = time.monotonic()
+        self._gp["last_ts"] = None
+        gen = 0
+        stalled_recoveries = 0
+        progress_mark = self._gp["max_step"]
+        results: Dict[int, Any] = {}
+        ref_to_rank: Dict[Any, int] = {}
+        current_ckpt = [latest_ckpt]
+
+        def dispatch(ckpt, only_ranks=None):
+            if only_ranks is None:
+                results.clear()
+                ref_to_rank.clear()
+                self._gp["last_ts"] = None
+            current_ckpt[0] = ckpt
+            world = len(self.workers)
+            cfg = config
+            if (
+                gen
+                and isinstance(config, dict)
+                and "__jaxdist_attempt__" in config
+            ):
+                # fresh jax.distributed rendezvous key per re-dispatch: the
+                # dead attempt's coordinator record must never be joined.
+                # Only rewritten when fit() put the key there (jax
+                # distributed runs) — other loops' configs stay untouched
+                cfg = dict(config)
+                cfg["__jaxdist_attempt__"] = f"{attempt_tag}g{gen}"
+            ranks = range(world) if only_ranks is None else sorted(only_ranks)
+            for rank in ranks:
+                ref = self.workers[rank].run.remote(
+                    fn_blob, cfg, self.collector, ckpt, rank, world
+                )
+                ref_to_rank[ref] = rank
+
+        dispatch(latest_ckpt)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while ref_to_rank:
+            ready, _ = ray_tpu.wait(
+                list(ref_to_rank), num_returns=1, timeout=0.5
+            )
+            self._drain_reports(report_callback)
+            dead: Dict[int, Exception] = {}
+            redispatch: set = set()
+            for r in ready:
+                rank = ref_to_rank.pop(r)
+                try:
+                    res = ray_tpu.get(r)
+                    if isinstance(res, str) and res == _ABORTED:
+                        # stale abort (a cleared signal raced a report):
+                        # the actor is healthy, just needs re-dispatching
+                        redispatch.add(rank)
+                    else:
+                        results[rank] = res
+                except _DEATH_ERRORS as e:
+                    dead[rank] = e
+            dead.update(self._poll_cluster_events(ref_to_rank))
+            if dead:
+                gen += 1
+                # progress-aware recovery budget: churn that advances the
+                # run recovers for free, a rank dying deterministically at
+                # the same step must not kill/replace/resume forever
+                if self._gp["max_step"] > progress_mark:
+                    progress_mark = self._gp["max_step"]
+                    stalled_recoveries = 0
+                else:
+                    stalled_recoveries += 1
+                    if stalled_recoveries > self.failure.max_recoveries_without_progress:
+                        raise WorkerGroupError(
+                            f"run {run_name}: {stalled_recoveries} consecutive "
+                            f"recoveries without completing a step (ranks keep "
+                            f"dying at step {progress_mark + 1}?) — falling "
+                            f"back to gang restart"
+                        ) from next(iter(dead.values()))
+                    # backed-off like gang restarts, so a crash-looping
+                    # rank doesn't hammer provisioning in a hot loop
+                    time.sleep(
+                        min(
+                            self.failure.retry_backoff_max_s,
+                            self.failure.retry_backoff_s
+                            * (2 ** (stalled_recoveries - 1)),
+                        )
+                    )
+                self._recover(
+                    dead,
+                    ref_to_rank,
+                    results,
+                    report_callback,
+                    gen,
+                    run_name,
+                    resume_fn=resume_fn,
+                    prepare_resume=prepare_resume,
+                    on_resize=on_resize,
+                )
+                ckpt = resume_fn() if resume_fn else latest_ckpt
+                dispatch(ckpt)
+            elif redispatch:
+                # stale abort (cleared signal raced a report): the actors
+                # are healthy — re-dispatch just those ranks
+                dispatch(current_ckpt[0], only_ranks=redispatch)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("training run timed out")
+        self._drain_reports(report_callback)
+        self._publish_goodput(run_name)
+        return [results[rank] for rank in sorted(results)]
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(
+        self,
+        dead: Dict[int, Exception],
+        ref_to_rank: Dict[Any, int],
+        results: Dict[int, Any],
+        report_callback: Optional[Callable],
+        gen: int,
+        run_name: str,
+        *,
+        resume_fn=None,
+        prepare_resume=None,
+        on_resize=None,
+    ) -> None:
+        """Re-form the worker group after losing ranks: abort survivors,
+        provision replacements (or shrink/regrow within the elasticity
+        band), and leave ``self.workers`` ready for a full re-dispatch
+        from the last committed step."""
+        if not self.failure.replace_workers:
+            raise WorkerGroupError(
+                f"ranks {sorted(dead)} died and in-run replacement is "
+                f"disabled (FailureConfig.replace_workers=False)"
+            ) from next(iter(dead.values()))
+        m = _get_metrics()
+        try:
+            m["lost_workers"].inc(len(dead))
+            m["restarts"].inc(tags={"kind": "in_run"})
+        except Exception:
+            pass
+        old_world = len(self.workers)
+        for rank, err in sorted(dead.items()):
+            _record_event(
+                "TRAIN_WORKER_DIED",
+                f"run {run_name}: rank {rank}/{old_world} lost "
+                f"({type(err).__name__}: {err}); re-forming the group",
+                severity="WARNING",
+                run=run_name,
+                rank=rank,
+                world_size=old_world,
+                generation=gen,
+            )
+
+        # 1. abort survivors: they unwind at their next train.report and
+        # return the abort sentinel, keeping their processes warm
+        ray_tpu.get(self.collector.signal_abort.remote(gen), timeout=30)
+        drain_deadline = time.monotonic() + self.failure.abort_drain_timeout_s
+        while any(rank not in dead for rank in ref_to_rank.values()):
+            live_refs = [r for r, rank in ref_to_rank.items() if rank not in dead]
+            ready, _ = ray_tpu.wait(live_refs, num_returns=1, timeout=0.5)
+            self._drain_reports(report_callback)
+            for r in ready:
+                rank = ref_to_rank.pop(r)
+                try:
+                    # abort sentinel or a full result (a rank that finished
+                    # before noticing the abort) — either way the rank is
+                    # settled and gets re-dispatched with everyone else
+                    ray_tpu.get(r)
+                except _DEATH_ERRORS as e:
+                    dead[rank] = e
+            if time.monotonic() > drain_deadline:
+                # survivors stuck outside report() (a wedged collective):
+                # kill them — their actors are lost, but the group can
+                # still re-form around replacements
+                for r, rank in list(ref_to_rank.items()):
+                    if rank in dead:
+                        continue
+                    try:
+                        ray_tpu.kill(self.workers[rank])
+                    except Exception:
+                        pass
+                    dead[rank] = exc.ActorDiedError(
+                        None, "worker did not drain by abort_drain_timeout_s"
+                    )
+                    ref_to_rank.pop(r, None)
+                break
+        # dead ranks' refs are settled failures; drop them. Kill their
+        # actors explicitly too: a rank marked dead PROACTIVELY (node-dead
+        # table lookup, transient ActorUnavailableError) might still be
+        # executing the user loop — a zombie reporting its old rank into
+        # the shared collector could otherwise complete the re-formed
+        # group's shard barrier with stale-generation shards
+        for r, rank in list(ref_to_rank.items()):
+            if rank in dead:
+                ref_to_rank.pop(r)
+        for rank in dead:
+            try:
+                ray_tpu.kill(self.workers[rank])
+            except Exception:
+                pass
+        results.clear()
+
+        # 2. re-provision toward the full num_workers (a previously shrunk
+        # group regrows here), falling back to the elasticity band
+        survivors = [
+            w for rank, w in enumerate(self.workers) if rank not in dead
+        ]
+        survivor_bundles = [
+            b for rank, b in enumerate(self._bundles) if rank not in dead
+        ]
+        free_bundles = sorted(
+            set(range(self.scaling.num_workers))
+            - {b for b in survivor_bundles if b is not None}
+        )
+        want = self.scaling.num_workers - len(survivors)
+        replacements = self._provision(want, free_bundles) if want > 0 else []
+        new_world = len(survivors) + len(replacements)
+        min_workers = self.scaling.effective_min_workers()
+        if new_world < min_workers:
+            # the fallback is a whole-gang restart: the replacements we DID
+            # provision must not outlive this recovery, or they'd keep
+            # holding resources the restarted gang needs
+            for w, _b in replacements:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            raise WorkerGroupError(
+                f"run {run_name}: only {new_world} of min {min_workers} "
+                f"workers available after losing ranks {sorted(dead)}"
+            ) from next(iter(dead.values()))
+        for i in range(len(replacements)):
+            _record_event(
+                "TRAIN_WORKER_REPLACED",
+                f"run {run_name}: provisioned replacement worker "
+                f"{i + 1}/{len(replacements)} (generation {gen})",
+                run=run_name,
+                generation=gen,
+            )
+        # survivors keep their relative order (stable re-ranking),
+        # replacements fill in after them
+        self.workers = survivors + [w for w, _b in replacements]
+        self._bundles = survivor_bundles + [b for _w, b in replacements]
+        if new_world != old_world:
+            try:
+                m["resizes"].inc()
+            except Exception:
+                pass
+            _record_event(
+                "TRAIN_RESIZED",
+                f"run {run_name}: elastic resize {old_world} -> {new_world} "
+                f"workers (band {min_workers}..{self.scaling.num_workers})",
+                severity="WARNING",
+                run=run_name,
+                old_world=old_world,
+                new_world=new_world,
+                generation=gen,
+            )
+            if on_resize:
+                on_resize(new_world)
+        # 3. quiesce the checkpoint plane (drain in-flight commits, reset
+        # the shard barrier) before ranks start rewriting step dirs
+        if prepare_resume:
+            prepare_resume()
+        ray_tpu.get(self.collector.clear_abort.remote(), timeout=30)
+
+    def _provision(
+        self, want: int, free_bundles: List[Optional[int]]
+    ) -> List[Tuple[Any, Optional[int]]]:
+        """Spawn up to ``want`` replacement workers, each proven alive by a
+        ping within FailureConfig.replacement_timeout_s. Dead ranks'
+        placement-group bundles are reused first (their resources were
+        released with the dead workers); a bundle that cannot be re-filled
+        (its node died with it) falls back to unconstrained scheduling for
+        the remaining timeout. Returns ``(worker, bundle_or_None)``
+        pairs."""
+        if want <= 0:
+            return []
+        deadline = time.monotonic() + self.failure.replacement_timeout_s
+        out: List[Tuple[Any, Optional[int]]] = []
+        for use_pg in (True, False):
+            need = want - len(out)
+            if need <= 0 or time.monotonic() >= deadline:
+                break
+            cand: List[Tuple[Any, Optional[int]]] = []
+            for i in range(need):
+                bundle = None
+                if use_pg:
+                    if self.pg is None or i >= len(free_bundles):
+                        continue
+                    bundle = free_bundles[i]
+                try:
+                    cand.append(
+                        (
+                            self._spawn(
+                                0, self.scaling.num_workers, bundle_index=bundle
+                            ),
+                            bundle,
+                        )
+                    )
+                except Exception:
+                    continue
+            if not cand:
+                continue
+            pings = {w.ping.remote(): (w, b) for w, b in cand}
+            budget = max(0.1, deadline - time.monotonic())
+            if use_pg:
+                # the pinned pass must not eat the whole window: a bundle
+                # whose node died never schedules, and the documented
+                # unconstrained fallback still needs its share
+                budget = min(budget, self.failure.replacement_timeout_s / 2)
+            ready, _ = ray_tpu.wait(
+                list(pings), num_returns=len(pings), timeout=budget
+            )
+            for r in ready:
+                w, b = pings.pop(r)
+                try:
+                    ray_tpu.get(r)
+                    out.append((w, b))
+                    if b is not None and b in free_bundles:
+                        free_bundles.remove(b)
+                except Exception:
+                    try:
+                        ray_tpu.kill(w)
+                    except Exception:
+                        pass
+            for w, _b in pings.values():  # unproven: give up on them
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+        return out[:want]

@@ -16,6 +16,12 @@ from typing import Any, Dict, Optional
 @dataclass
 class ScalingConfig:
     num_workers: int = 1
+    # elasticity band: when workers die and replacements cannot be
+    # provisioned within FailureConfig.replacement_timeout_s, the group may
+    # shrink down to this floor (and grow back toward num_workers on later
+    # recoveries) instead of failing the attempt. None = not elastic: the
+    # run needs exactly num_workers.
+    min_workers: Optional[int] = None
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
@@ -40,6 +46,11 @@ class ScalingConfig:
             res["TPU"] = 1.0
         return res
 
+    def effective_min_workers(self) -> int:
+        if self.min_workers is None:
+            return self.num_workers
+        return max(1, min(self.min_workers, self.num_workers))
+
     @property
     def total_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -51,6 +62,41 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     max_failures: int = 0  # -1 = infinite
+    # Backoff between whole-gang restart attempts: exponential from
+    # retry_backoff_s (doubling per consecutive failure) capped at
+    # retry_backoff_max_s, with +/- retry_backoff_jitter fraction of
+    # randomization so a crash-looping gang doesn't hammer the scheduler
+    # in lockstep. jitter=0 makes the schedule deterministic.
+    retry_backoff_s: float = 1.0
+    retry_backoff_max_s: float = 30.0
+    retry_backoff_jitter: float = 0.5
+    # In-run worker replacement (elastic training): on worker/actor death,
+    # keep surviving workers' processes alive, provision replacements for
+    # the dead ranks, and resume every rank from the last committed
+    # checkpoint — the whole-gang restart above becomes the fallback. A
+    # replacement that is not up within replacement_timeout_s is given up
+    # on (the group then shrinks if ScalingConfig.min_workers allows).
+    replace_workers: bool = True
+    replacement_timeout_s: float = 20.0
+    # how long to wait for surviving ranks to unwind (they notice the
+    # abort at their next train.report) before they are killed and treated
+    # as lost too
+    abort_drain_timeout_s: float = 60.0
+    # in-run recoveries are free while the run makes progress (real
+    # preemption churn advances steps between losses), but a
+    # deterministically crashing rank would otherwise kill/replace/resume
+    # forever: after this many consecutive recoveries with NO new step
+    # completed, the attempt fails over to the (max_failures-capped,
+    # backed-off) gang restart
+    max_recoveries_without_progress: int = 3
+    # proactively replace a rank flagged by the scheduler's STRAGGLER
+    # watchdog (kill + re-provision) instead of waiting for the collective
+    # to time out. Off by default: a straggler still makes progress, and
+    # the watchdog pools runtimes by METHOD name — short aborted run()
+    # attempts seed a small p95 that can flag legitimate long runs
+    # (bounded by straggler_min_runtime_s); tune straggler_* system
+    # config before enabling on long train loops.
+    replace_stragglers: bool = False
 
 
 @dataclass
@@ -58,6 +104,11 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
+    # fit() drains in-flight checkpoint commits for at most this long
+    # before returning; a drain timeout surfaces as a CHECKPOINT_FAILED
+    # cluster event plus CheckpointDrainError context on Result.error
+    # (never a silent return that looks fully committed)
+    drain_timeout_s: float = 120.0
 
 
 @dataclass

@@ -16,6 +16,10 @@ class Result:
     error: Optional[Exception] = None
     metrics_dataframe: Optional[Any] = None
     best_checkpoints: List = field(default_factory=list)
+    # elastic-training accounting: wall_s / useful_step_s / steps_redone /
+    # goodput (useful-step-time over wall-time) for the whole fit() call,
+    # across every in-run recovery and gang restart
+    goodput: Optional[Dict[str, Any]] = None
 
     @property
     def config(self):

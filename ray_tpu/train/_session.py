@@ -21,6 +21,21 @@ from ray_tpu.train._checkpoint import Checkpoint
 _session_local = threading.local()
 
 
+class AttemptAborted(Exception):
+    """Internal control-flow signal: the backend executor aborted this
+    attempt (a peer rank died and the group is re-forming). Raised out of
+    ``train.report`` to unwind the user loop; the train worker catches it
+    and returns an abort sentinel instead of an error, so the actor
+    process stays alive for the next dispatch."""
+
+    def __init__(self, generation: int):
+        self.generation = generation
+        super().__init__(
+            f"training attempt aborted by the executor (generation "
+            f"{generation}); the worker group is re-forming"
+        )
+
+
 def _manifest_step(path: str):
     """Step recorded in a restored checkpoint's manifest (from_uri cache
     slots keep their MANIFEST.json precisely so resume can continue the
@@ -35,6 +50,78 @@ def _manifest_step(path: str):
         return int(step) if step is not None else None
     except (OSError, ValueError, TypeError):
         return None
+
+
+import re as _re
+
+_SHARD_RE = _re.compile(r"^shard-(\d{5})-of-(\d{5})$")
+
+
+def _shard_dirs(step_dir: str):
+    """(name, rank, world) of every shard-XXXXX-of-YYYYY subdir of a
+    step dir."""
+    out = []
+    try:
+        names = os.listdir(step_dir)
+    except OSError:
+        return out
+    for name in names:
+        m = _SHARD_RE.match(name)
+        if m and os.path.isdir(os.path.join(step_dir, name)):
+            out.append((name, int(m.group(1)), int(m.group(2))))
+    return out
+
+
+def _pick_shard(step_dir: str, rank: int, world_size: int) -> Optional[str]:
+    """The shard subdirectory this rank should restore from, or None to
+    use the step dir itself. Exact (rank, world) match first. Across a
+    world-size CHANGE, a cross-world shard is only safe when it carries
+    the FULL state — the rank-0-gather pattern, recognizable as a step
+    dir whose sole shard is rank 0's. Anything else (a truly partitioned
+    layout at another world) returns the step dir: a different world's
+    per-rank slice is the wrong rows, and the elastic loader
+    (train.load_elastic) is the path that can re-shard it correctly."""
+    exact = os.path.join(
+        step_dir, checkpointing.shard_dir_name(rank, world_size)
+    )
+    if world_size > 1 and os.path.isdir(exact):
+        return exact
+    shards = _shard_dirs(step_dir)
+    if len(shards) == 1 and shards[0][1] == 0:
+        return os.path.join(step_dir, shards[0][0])
+    return None
+
+
+def _clear_stale_layouts(step_dir: str, world_size: int) -> None:
+    """Remove entries of a step dir that belong to a DIFFERENT world-size
+    layout: shard dirs whose ``-of-NNNNN`` suffix isn't the current world,
+    and (when the current world is sharded) leftover flat root residue
+    from a world-of-one attempt. The keep/delete decision is made from
+    each entry's NAME alone, in one pass — concurrent ranks snapshot the
+    same step simultaneously, and a peer's current-world shard dir
+    appearing between two listings must never be judged by a stale
+    snapshot (name-based judgment is time-independent)."""
+    try:
+        names = os.listdir(step_dir)
+    except OSError:
+        return
+    for name in names:
+        m = _SHARD_RE.match(name)
+        if m is not None:
+            stale = int(m.group(2)) != world_size  # other-world shard
+        else:
+            # non-shard root entry: legit only in a flat (world-1) layout
+            stale = world_size > 1
+        if not stale:
+            continue
+        p = os.path.join(step_dir, name)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 @dataclass
@@ -75,19 +162,24 @@ class _Session:
                 step = _manifest_step(latest_checkpoint.path)
             if step is not None:
                 self.iteration = step
-        # sharded resume: a multi-rank committed checkpoint is a step dir of
-        # shard-{rank}-of-{world} subdirs; each rank sees its own shard,
-        # falling back to rank 0's (a rank-0-only checkpoint carries the
-        # gathered state every rank restores from)
-        if latest_checkpoint is not None and context.world_size > 1:
-            for rank in (context.world_rank, 0):
-                shard = os.path.join(
-                    latest_checkpoint.path,
-                    checkpointing.shard_dir_name(rank, context.world_size),
-                )
-                if os.path.isdir(shard):
-                    latest_checkpoint = Checkpoint(shard)
-                    break
+        # the step-dir-level restore root (pre shard-pick): the elastic
+        # N→M loader needs ALL old shards' indexes, not one rank's view
+        self._restore_root = (
+            latest_checkpoint.path if latest_checkpoint is not None else None
+        )
+        # sharded resume: a multi-rank committed checkpoint is a step dir
+        # of shard-{rank}-of-{world} subdirs; each rank sees its exact
+        # (rank, world) shard, or the sole rank-0 shard of a gather-
+        # pattern checkpoint (full state, safe at any world). Any other
+        # world-size mismatch keeps the whole step dir — a different
+        # world's per-rank slice would be the wrong rows, and
+        # train.load_elastic() is the path that re-shards it correctly.
+        if latest_checkpoint is not None:
+            shard = _pick_shard(
+                latest_checkpoint.path, context.world_rank, context.world_size
+            )
+            if shard is not None:
+                latest_checkpoint = Checkpoint(shard)
         self.latest_checkpoint = latest_checkpoint
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
@@ -126,6 +218,15 @@ class _Session:
                         os.unlink(os.path.join(step_dir, mark))
                     except OSError:
                         pass
+                # an elastic resize can leave THIS step dir holding a dead
+                # attempt's shards from another world size (or stale flat
+                # files when the world grew past 1): the commit manifests
+                # whatever is on disk, so a mixed-layout dir would become
+                # a trusted checkpoint that restores mixed-generation
+                # state. Every rank clears the stale layout; ranks of one
+                # generation write only current-world entries, so the
+                # deletions never race a live shard.
+                _clear_stale_layouts(step_dir, self.context.world_size)
                 if os.path.abspath(checkpoint.path) != dest:
                     shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
                 # a RESTORED checkpoint carries its old markers (and the
@@ -142,11 +243,66 @@ class _Session:
         if self.collector is not None:
             import ray_tpu
 
-            ray_tpu.get(
+            resp = ray_tpu.get(
                 self.collector.report.remote(
                     self.context.world_rank, self.iteration, metrics, ckpt_path
                 )
             )
+            # the collector doubles as the executor's control plane: a
+            # non-bool int response is an abort generation — a peer rank
+            # died and the executor wants every survivor to unwind NOW
+            # (instead of timing out in the next collective) so the group
+            # can re-form and resume from the last committed step
+            if isinstance(resp, int) and not isinstance(resp, bool):
+                raise AttemptAborted(resp)
+
+    # -- elastic state ------------------------------------------------------
+
+    def load_elastic(self, arrays=None, *, full: bool = False):
+        """This rank's re-sharded slice of the latest elastic checkpoint
+        (or the fully assembled arrays with ``full=True``), plus the
+        saver's extra metadata — or None when there is nothing to resume
+        from. Works across world-size changes: the slice is computed from
+        the CURRENT (rank, world_size) over whatever shard layout was
+        committed."""
+        from ray_tpu.train import elastic
+
+        root = self._restore_root
+        if root is None:
+            return None
+        if full:
+            return elastic.load_elastic_full(root, arrays=arrays)
+        return elastic.load_elastic_state(
+            root,
+            rank=self.context.world_rank,
+            world_size=self.context.world_size,
+            arrays=arrays,
+        )
+
+    def report_elastic(self, metrics: Dict[str, Any], arrays, extra=None):
+        """Snapshot ``arrays`` as this rank's elastic shard and report it.
+        The shard carries only this rank's balanced row partition, so a
+        full-world save costs ~1/world of the state per rank and any
+        future world size can restore it."""
+        import tempfile
+
+        from ray_tpu.train import elastic
+
+        d = tempfile.mkdtemp(prefix="elastic_shard_")
+        try:
+            elastic.save_elastic_shard(
+                d,
+                arrays,
+                rank=self.context.world_rank,
+                world_size=self.context.world_size,
+                extra=extra,
+            )
+            self.report(metrics, Checkpoint(d))
+        finally:
+            # report() copied the shard into the step dir (or raised —
+            # including AttemptAborted): the staging dir must not leak one
+            # shard-sized /tmp directory per rank per step
+            shutil.rmtree(d, ignore_errors=True)
 
 
 _session_fallback: Optional[_Session] = None
@@ -185,3 +341,25 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Optional[Checkpoint]:
     s = _get_session()
     return s.latest_checkpoint if s else None
+
+
+def load_elastic(arrays=None, *, full: bool = False):
+    """Restore this rank's slice of the latest elastic checkpoint —
+    re-sharded on the fly when the world size changed since the save
+    (N→M). ``full=True`` assembles the complete arrays instead (what a
+    replicated data-parallel loop wants). Returns ``(arrays, extra)`` or
+    None when there is no checkpoint to resume from."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("train.load_elastic() called outside a training session")
+    return s.load_elastic(arrays, full=full)
+
+
+def report_elastic(metrics: Dict[str, Any], arrays, *, extra=None) -> None:
+    """Report metrics plus an elastic checkpoint of ``arrays`` (this
+    rank's balanced row partition of each). The committed result can be
+    restored at ANY world size via :func:`load_elastic`."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("train.report_elastic() called outside a training session")
+    s.report_elastic(metrics, arrays, extra=extra)

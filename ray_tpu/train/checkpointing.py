@@ -41,6 +41,21 @@ CHECKPOINT_PREFIX = "checkpoint_"
 _KV_NS = "ckpt"
 
 
+class CheckpointDrainError(RuntimeError):
+    """fit() gave up waiting for in-flight checkpoint commits. The listed
+    steps were fully reported by the workers but their background
+    upload/commit had not finished when the drain timeout expired — they
+    may still commit later, or never."""
+
+    def __init__(self, undrained_steps, timeout_s: float):
+        self.undrained_steps = sorted(undrained_steps)
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"checkpoint commit drain timed out after {timeout_s:.0f}s; "
+            f"steps {self.undrained_steps} were reported but not committed"
+        )
+
+
 def step_dir_name(step: int) -> str:
     return f"{CHECKPOINT_PREFIX}{step:06d}"
 
@@ -422,6 +437,7 @@ class CheckpointManager:
         self._committed: Dict[int, dict] = {}  # step -> manifest
         self._failed: Dict[int, str] = {}
         self._outstanding = 0  # queued + running commits
+        self._inflight_steps: set = set()  # the steps behind _outstanding
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, max_inflight))
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -485,6 +501,7 @@ class CheckpointManager:
                 del self._pending[step]
                 self._reported.pop(step, None)
                 self._outstanding += 1
+                self._inflight_steps.add(step)
             elif len(reported) >= self.world_size and not shards:
                 # metrics-only step: every rank is in, nobody checkpointed
                 self._pending.pop(step, None)
@@ -507,6 +524,23 @@ class CheckpointManager:
         with self._lock:
             self._pending.clear()
             self._reported.clear()
+
+    def resize(self, world_size: int) -> None:
+        """Elastic resize: the worker group shrank or grew (N→M). Future
+        barriers complete at the NEW world size; partially-reported steps
+        from the old world are forgotten (their surviving ranks are about
+        to resume from the last committed step and re-report them)."""
+        with self._lock:
+            self.world_size = max(1, int(world_size))
+            self._pending.clear()
+            self._reported.clear()
+        self._update_registry()
+
+    def pending_steps(self) -> List[int]:
+        """Steps whose background upload/commit is queued or running —
+        what a drain timeout leaves behind."""
+        with self._lock:
+            return sorted(self._inflight_steps)
 
     def _ensure_thread(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -567,6 +601,7 @@ class CheckpointManager:
             with self._cv:
                 self._failed[step] = repr(e)
                 self._outstanding -= 1
+                self._inflight_steps.discard(step)
                 self._cv.notify_all()
             self._set_inflight_gauge()
             try:
@@ -636,6 +671,7 @@ class CheckpointManager:
         # a half-finished GC
         with self._cv:
             self._outstanding -= 1
+            self._inflight_steps.discard(step)
             self._cv.notify_all()
         self._set_inflight_gauge()
 

@@ -26,6 +26,23 @@ from ray_tpu.train._config import RunConfig, ScalingConfig
 from ray_tpu.train._result import Result
 
 
+def _retry_backoff(attempt: int, fail_cfg) -> float:
+    """Delay before gang-restart ``attempt`` (1-based): exponential from
+    ``retry_backoff_s`` capped at ``retry_backoff_max_s``, with +/-
+    ``retry_backoff_jitter`` fraction of randomization so crash-looping
+    gangs desynchronize instead of hammering the scheduler in lockstep."""
+    import random
+
+    base = max(0.0, fail_cfg.retry_backoff_s)
+    delay = base * (2 ** max(0, attempt - 1))
+    jitter = min(1.0, max(0.0, fail_cfg.retry_backoff_jitter))
+    if jitter:
+        delay *= 1.0 + random.uniform(-jitter, jitter)
+    # the cap is applied LAST: retry_backoff_max_s is a hard bound an
+    # operator can rely on, jitter included
+    return max(0.0, min(fail_cfg.retry_backoff_max_s, delay))
+
+
 def _setup_jax_distributed(rendezvous_key: str) -> bool:
     """Join the jax.distributed coordination service (backend ``on_start``).
 
@@ -157,7 +174,8 @@ class JaxTrainer:
                 metrics=metrics if rank == 0 else None,
             )
 
-        max_failures = self.run_config.failure_config.max_failures
+        fail_cfg = self.run_config.failure_config
+        max_failures = fail_cfg.max_failures
         attempt = 0
         error: Optional[Exception] = None
         train_fn = self.train_loop
@@ -165,49 +183,118 @@ class JaxTrainer:
         if self.datasets:
             config = dict(config or {})
             config["__datasets__"] = self.datasets
+
+        def resume_fn():
+            # every (re-)dispatch resumes from the latest COMMITTED step —
+            # never from a partial, uncommitted upload
+            return manager.latest_checkpoint() or self.resume_from_checkpoint
+
+        def prepare_resume():
+            # MUST fully drain before ranks rewrite the same step dirs a
+            # still-running commit may be hashing, and a dead attempt's
+            # half-complete barrier must not bleed into the resumed one.
+            # The wait is bounded: a wedged mirror must surface as a
+            # CheckpointDrainError (failing the attempt/run), not hang
+            # recovery forever — proceeding without the drain could tear a
+            # committed-looking dir, so failing is the only safe exit.
+            drain_timeout = self.run_config.checkpoint_config.drain_timeout_s
+            if not manager.wait(timeout=drain_timeout):
+                raise checkpointing.CheckpointDrainError(
+                    manager.pending_steps(), drain_timeout
+                )
+            manager.reset_barrier()
+
         try:
             while True:
                 try:
                     executor.start()
-                    # auto-resume: a retried attempt restarts every rank
-                    # from the latest COMMITTED step (drain in-flight
-                    # commits first so a barriered save isn't abandoned) —
-                    # never from a partial, uncommitted upload. The FIRST
-                    # attempt honors an explicit resume_from_checkpoint
-                    # even when the (reused) trial dir holds older commits.
+                    # auto-resume via resume_fn; the FIRST attempt honors an
+                    # explicit resume_from_checkpoint even when the (reused)
+                    # trial dir holds older commits.
                     if attempt == 0 and self.resume_from_checkpoint is not None:
                         latest = self.resume_from_checkpoint
                     else:
-                        latest = manager.latest_checkpoint() or self.resume_from_checkpoint
+                        latest = resume_fn()
                     run_config = config
                     if self.scaling_config.use_jax_distributed:
                         # per-attempt rendezvous key suffix (see _wrap_distributed)
                         run_config = dict(config or {})
                         run_config["__jaxdist_attempt__"] = attempt
-                    executor.run(train_fn, run_config, latest_ckpt=latest, report_callback=on_report)
+                    executor.run(
+                        train_fn,
+                        run_config,
+                        latest_ckpt=latest,
+                        report_callback=on_report,
+                        resume_fn=resume_fn,
+                        prepare_resume=prepare_resume,
+                        on_resize=manager.resize,
+                        attempt_tag=attempt,
+                        run_name=name,
+                    )
                     error = None
                     break
                 except Exception as e:  # noqa: BLE001
                     error = e
                     attempt += 1
                     executor.shutdown()
-                    # MUST fully drain before the retry: its ranks rewrite
-                    # the same step dirs a still-running commit may be
-                    # hashing — a bounded wait that gave up would let the
-                    # two interleave into a torn-but-"committed" dir
-                    manager.wait()
-                    manager.reset_barrier()
+                    try:
+                        prepare_resume()
+                    except checkpointing.CheckpointDrainError as de:
+                        # the plane is wedged: retrying would hit the same
+                        # wall — surface the drain failure and stop, with
+                        # the attempt's real error preserved as the cause
+                        de.__cause__ = error
+                        error = de
+                        break
+                    # an elastic shrink may have left the barrier at M <
+                    # num_workers; the fresh gang is full-size again, and a
+                    # short barrier would commit torn (M-of-N-shard) steps
+                    manager.resize(self.scaling_config.num_workers)
                     if max_failures != -1 and attempt > max_failures:
                         break
-                    time.sleep(1.0)
+                    try:
+                        from ray_tpu.train._backend_executor import _get_metrics
+
+                        _get_metrics()["restarts"].inc(tags={"kind": "gang"})
+                    except Exception:
+                        pass
+                    time.sleep(_retry_backoff(attempt, fail_cfg))
                 finally:
                     executor.shutdown()
         finally:
             # drain the upload queue before returning: fit()'s contract is
             # that every fully-reported checkpoint is committed (or failed
-            # loudly) by the time the Result exists
-            manager.wait(timeout=120.0)
-            manager.shutdown()
+            # loudly) by the time the Result exists — and a drain that
+            # TIMES OUT must never return looking fully committed
+            drain_timeout = self.run_config.checkpoint_config.drain_timeout_s
+            if not manager.wait(timeout=drain_timeout):
+                from ray_tpu.train._backend_executor import _record_event
+
+                undrained = manager.pending_steps()
+                _record_event(
+                    "CHECKPOINT_FAILED",
+                    f"run {name}: checkpoint drain timed out after "
+                    f"{drain_timeout:.0f}s with steps {undrained} still "
+                    f"uncommitted",
+                    severity="ERROR",
+                    run=name,
+                    undrained_steps=undrained,
+                )
+                drain_err = checkpointing.CheckpointDrainError(
+                    undrained, drain_timeout
+                )
+                if error is None:
+                    error = drain_err
+                else:
+                    # the run already failed; ride along as context
+                    error.checkpoint_drain_error = drain_err
+            manager.shutdown(wait=False)
 
         best = manager.latest_checkpoint()
-        return Result(metrics=dict(last), checkpoint=best, path=trial_dir, error=error)
+        return Result(
+            metrics=dict(last),
+            checkpoint=best,
+            path=trial_dir,
+            error=error,
+            goodput=executor.goodput_stats(),
+        )

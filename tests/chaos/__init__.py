@@ -1,0 +1,14 @@
+"""Reusable chaos-injection harness for fault-tolerance tests.
+
+Seeded and deterministic: every schedule and victim choice derives from
+``CHAOS_SEED`` (env knob, see :func:`chaos_seed`), so a failing chaos run
+reproduces with ``CHAOS_SEED=<n> make chaos``.
+"""
+
+from .harness import (  # noqa: F401
+    ChaosMonkey,
+    KillSchedule,
+    chaos_seed,
+    elastic_sgd_loop,
+    train_worker_pids,
+)

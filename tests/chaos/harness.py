@@ -1,0 +1,219 @@
+"""Chaos harness: seeded, deterministic kill schedules for workers/nodes.
+
+Parity: the reference's chaos fixtures (``_ray_start_chaos_cluster``,
+``python/ray/tests/conftest.py:900``; killer actors
+``python/ray/_private/test_utils.py:1500``), with one deliberate upgrade —
+**determinism**. Every delay and every victim choice comes from one
+``random.Random(seed)`` stream over *sorted* candidate lists, so a chaos
+failure replays exactly under the same ``CHAOS_SEED`` instead of being a
+once-in-CI ghost.
+
+The monkey runs driver-side (a plain thread, not an actor): an injector
+that lived in the cluster it is attacking could kill itself or be starved
+by the very faults it injects.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+_DEFAULT_SEED = 1729
+
+
+def chaos_seed(default: int = _DEFAULT_SEED) -> int:
+    """The run's chaos seed: ``CHAOS_SEED`` env var, else ``default``.
+    Print it in failure output; re-exporting it reproduces the run."""
+    try:
+        return int(os.environ.get("CHAOS_SEED", "") or default)
+    except ValueError:
+        return default
+
+
+class KillSchedule:
+    """A deterministic sequence of inter-kill delays drawn from
+    ``interval_s = (lo, hi)``. Two schedules with the same seed are
+    identical forever."""
+
+    def __init__(self, seed: int, interval_s: Tuple[float, float] = (0.4, 1.2)):
+        self._rng = random.Random(seed)
+        self.interval_s = interval_s
+
+    def next_delay(self) -> float:
+        lo, hi = self.interval_s
+        return self._rng.uniform(lo, hi)
+
+    def choose(self, candidates: Sequence):
+        """Deterministic victim pick — candidates must be pre-sorted by
+        the caller so the choice depends only on the seed and the set."""
+        if not candidates:
+            return None
+        return self._rng.choice(list(candidates))
+
+
+def actor_pids(class_name: str) -> List[int]:
+    """PIDs of ALIVE actors of one class (``state.list_actors`` rows carry
+    class provenance), sorted for deterministic victim choice. Excludes
+    this process."""
+    from ray_tpu.util import state as state_api
+
+    me = os.getpid()
+    pids = set()
+    try:
+        for row in state_api.list_actors():
+            if (
+                row.get("state") == "ALIVE"
+                and row.get("class_name") == class_name
+                and row.get("pid")
+                and row["pid"] != me
+            ):
+                pids.add(row["pid"])
+    except Exception:
+        pass
+    return sorted(pids)
+
+
+def train_worker_pids() -> List[int]:
+    """PIDs of live train workers (the ``_TrainWorker`` actor group)."""
+    return actor_pids("_TrainWorker")
+
+
+def elastic_sgd_loop(total_steps: int, step_sleep: float = 0.0):
+    """Deterministic full-batch linear-regression SGD, world-size
+    invariant: every rank computes the identical replicated update, saves
+    only ITS row partition of the weights (a genuinely sharded elastic
+    checkpoint), and restores the full weights from whatever shard layout
+    was committed. Same step count => bitwise-same weights, at any world
+    size and through any number of resumes. Shared by the chaos
+    convergence tests and bench_core's goodput row so both measure the
+    same workload."""
+
+    def loop(config=None):
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu import train
+
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(48, 6))
+        w_true = np.array([1.0, -2.0, 3.0, 0.5, -1.5, 2.5])
+        y = X @ w_true
+        state = train.load_elastic(full=True)
+        if state is not None:
+            arrays, extra = state
+            w, start = arrays["w"], int(extra["step"])
+        else:
+            w, start = np.zeros(6), 0
+        for step in range(start, total_steps):
+            grad = 2.0 * X.T @ (X @ w - y) / len(y)
+            w = w - 0.05 * grad
+            loss = float(np.mean((X @ w - y) ** 2))
+            if step_sleep:
+                _time.sleep(step_sleep)
+            train.report_elastic(
+                {"loss": loss, "resumed_at": float(start)},
+                {"w": w},
+                extra={"step": step + 1},
+            )
+
+    return loop
+
+
+class ChaosMonkey:
+    """Driver-side thread that SIGKILLs one victim per schedule tick.
+
+    ``victims`` returns the current candidate pid list (sorted); the
+    default targets live train workers. ``node_pids`` adds node-daemon
+    pids to the pool with probability ``node_kill_prob`` per tick — a
+    node kill models whole-host preemption. Stop with :meth:`stop`;
+    ``monkey.kills`` is the ordered (timestamp, pid, kind) log."""
+
+    def __init__(
+        self,
+        *,
+        seed: Optional[int] = None,
+        interval_s: Tuple[float, float] = (0.4, 1.2),
+        victims: Callable[[], List[int]] = train_worker_pids,
+        node_pids: Callable[[], List[int]] = lambda: [],
+        node_kill_prob: float = 0.0,
+        max_kills: Optional[int] = None,
+        duration_s: Optional[float] = None,
+        arm_when: Optional[Callable[[], bool]] = None,
+    ):
+        self.seed = chaos_seed() if seed is None else seed
+        self.schedule = KillSchedule(self.seed, interval_s)
+        self._victims = victims
+        self._node_pids = node_pids
+        self._node_kill_prob = node_kill_prob
+        self._max_kills = max_kills
+        self._duration_s = duration_s
+        # optional arming predicate: hold fire until it turns true (e.g.
+        # "a committed checkpoint exists") — anchors the schedule to
+        # workload PROGRESS instead of wall time, which keeps a seeded
+        # run meaningful across hosts of different speeds
+        self._arm_when = arm_when
+        self.kills: List[Tuple[float, int, str]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-monkey", daemon=True
+        )
+
+    def start(self) -> "ChaosMonkey":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        if self._arm_when is not None:
+            while not self._stop.is_set():
+                try:
+                    if self._arm_when():
+                        break
+                except Exception:
+                    pass
+                if self._stop.wait(0.1):
+                    return
+        t0 = time.monotonic()
+        while not self._stop.is_set():
+            if self._max_kills is not None and len(self.kills) >= self._max_kills:
+                return
+            if (
+                self._duration_s is not None
+                and time.monotonic() - t0 > self._duration_s
+            ):
+                return
+            if self._stop.wait(self.schedule.next_delay()):
+                return
+            kind = "worker"
+            pool = self._victims()
+            if self._node_kill_prob > 0:
+                # the node-vs-worker coin comes from the same seeded
+                # stream, so the whole attack sequence is reproducible
+                if self.schedule._rng.random() < self._node_kill_prob:
+                    nodes = sorted(self._node_pids())
+                    if nodes:
+                        pool, kind = nodes, "node"
+            victim = self.schedule.choose(sorted(pool))
+            if victim is None:
+                continue
+            try:
+                os.kill(victim, signal.SIGKILL)
+                self.kills.append((time.monotonic() - t0, victim, kind))
+            except (ProcessLookupError, PermissionError):
+                continue
+
+    def stop(self) -> int:
+        """Stop injecting; returns the number of successful kills."""
+        self._stop.set()
+        self._thread.join(timeout=10)
+        return len(self.kills)
+
+    def __enter__(self) -> "ChaosMonkey":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()

@@ -13,6 +13,11 @@ import pytest
 import ray_tpu
 from ray_tpu import exceptions as exc
 
+# chaos runs are heavy (continuous kill/respawn churn) and the tier-1
+# budget is marginal on slow hosts: the whole module is slow-marked and
+# runs via `make chaos` (CHAOS_SEED reproduces a given schedule)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def chaos_runtime():

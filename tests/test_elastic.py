@@ -1,0 +1,446 @@
+"""Elastic training fast tests: N→M re-shard corner cases, the report
+collector's bounded buffer, retry backoff, and drain-timeout surfacing.
+(The kill-driven convergence tests live in test_elastic_chaos.py, slow.)"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import external_storage as storage
+from ray_tpu.train import checkpointing, elastic
+
+
+# --------------------------------------------------------------------------
+# partition + re-shard corner cases (pure, no cluster)
+# --------------------------------------------------------------------------
+
+
+def test_partition_rows_balanced_and_total():
+    for total in (0, 1, 5, 8, 23):
+        for world in (1, 2, 3, 7, 10):
+            parts = elastic.partition_rows(total, world)
+            assert len(parts) == world
+            assert parts[0][0] == 0 and parts[-1][1] == total
+            sizes = [hi - lo for lo, hi in parts]
+            assert sum(sizes) == total
+            assert max(sizes) - min(sizes) <= 1  # balanced
+            # contiguous, ordered
+            for (_, a), (b, _) in zip(parts, parts[1:]):
+                assert a == b
+
+
+def _commit_elastic_step(base, step, arrays, save_world, *, extra=None):
+    step_dir = os.path.join(base, checkpointing.step_dir_name(step))
+    for r in range(save_world):
+        shard = os.path.join(
+            step_dir, checkpointing.shard_dir_name(r, save_world)
+        )
+        elastic.save_elastic_shard(
+            shard or step_dir,
+            arrays,
+            rank=r,
+            world_size=save_world,
+            extra=extra or {"step": step},
+        )
+    manifest = storage.build_manifest(step_dir, step=step, world_size=save_world)
+    storage.write_commit_markers(step_dir, manifest)
+    return step_dir
+
+
+@pytest.mark.parametrize("save_world,load_world", [(3, 1), (1, 4), (2, 3), (4, 2)])
+def test_reshard_n_to_m_roundtrip(tmp_path, save_world, load_world):
+    """N→1, 1→M, and both directions of N→M: concatenating every new
+    rank's slice reproduces the original arrays bitwise."""
+    g = {
+        "w": np.arange(20 * 5, dtype=np.float32).reshape(20, 5),
+        "b": np.linspace(-1, 1, 7),
+    }
+    step_dir = _commit_elastic_step(str(tmp_path), 1, g, save_world)
+    for name, ref in g.items():
+        slices = []
+        for r in range(load_world):
+            arrays, extra = elastic.load_elastic_state(
+                step_dir, rank=r, world_size=load_world, arrays=[name]
+            )
+            assert extra == {"step": 1}
+            slices.append(arrays[name])
+        assert np.array_equal(np.concatenate(slices), ref)
+
+
+def test_reshard_m_greater_than_rows_empty_slices(tmp_path):
+    """M > row count: trailing ranks own empty (zero-row) slices and the
+    concatenation is still exact."""
+    g = {"tiny": np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])}
+    step_dir = _commit_elastic_step(str(tmp_path), 2, g, 2)
+    world = 7  # > 3 rows
+    slices = [
+        elastic.load_elastic_state(step_dir, rank=r, world_size=world)[0]["tiny"]
+        for r in range(world)
+    ]
+    assert [s.shape[0] for s in slices] == [1, 1, 1, 0, 0, 0, 0]
+    assert np.array_equal(np.concatenate(slices), g["tiny"])
+
+
+def test_rank0_only_checkpoint_into_multirank_world(tmp_path):
+    """The reference's gather pattern — one shard holding the FULL state,
+    committed under a multi-rank world — re-shards into any world."""
+    g = {"w": np.random.default_rng(0).normal(size=(11, 3))}
+    step_dir = os.path.join(str(tmp_path), checkpointing.step_dir_name(3))
+    # only rank 0 saved, and it saved everything (world_size=1 slicing)
+    elastic.save_elastic_shard(
+        os.path.join(step_dir, checkpointing.shard_dir_name(0, 4)),
+        g,
+        rank=0,
+        world_size=1,
+    )
+    storage.write_commit_markers(
+        step_dir, storage.build_manifest(step_dir, step=3, world_size=4)
+    )
+    slices = [
+        elastic.load_elastic_state(step_dir, rank=r, world_size=3)[0]["w"]
+        for r in range(3)
+    ]
+    assert np.array_equal(np.concatenate(slices), g["w"])
+
+
+def test_presliced_zero_style_save(tmp_path):
+    """Callers holding only their slice (ZeRO-sharded state) pass
+    (local, row_offset, global_rows) tuples; restore is identical."""
+    full = np.arange(12.0).reshape(6, 2)
+    base = str(tmp_path)
+    step_dir = os.path.join(base, checkpointing.step_dir_name(1))
+    for r, (lo, hi) in enumerate(elastic.partition_rows(6, 2)):
+        elastic.save_elastic_shard(
+            os.path.join(step_dir, checkpointing.shard_dir_name(r, 2)),
+            {"opt": (full[lo:hi], lo, 6)},
+            rank=r,
+            world_size=2,
+        )
+    storage.write_commit_markers(step_dir, storage.build_manifest(step_dir))
+    got, _ = elastic.load_elastic_full(step_dir)
+    assert np.array_equal(got["opt"], full)
+
+
+def test_digest_mismatch_shard_refused_mid_reshard(tmp_path):
+    """A corrupted shard is refused by the chunk digests — but only ranks
+    whose row range touches the corrupt bytes fail; others re-shard
+    cleanly (ranged reads never even see the bad shard)."""
+    g = {"w": np.arange(30.0).reshape(10, 3)}
+    step_dir = _commit_elastic_step(str(tmp_path), 1, g, 2)
+    # corrupt one byte in rank 1's shard payload
+    victim = os.path.join(
+        step_dir, checkpointing.shard_dir_name(1, 2), "w.bin"
+    )
+    with open(victim, "r+b") as fh:
+        fh.seek(4)
+        fh.write(b"\xff")
+    with pytest.raises(storage.IntegrityError, match="digest mismatch"):
+        elastic.load_elastic_full(step_dir)
+    # rank 0 of 2 owns rows 0..5 — entirely inside the intact shard 0
+    ok, _ = elastic.load_elastic_state(step_dir, rank=0, world_size=2)
+    assert np.array_equal(ok["w"], g["w"][:5])
+    # tampering with the INDEX is caught by the committed manifest
+    idx = os.path.join(
+        step_dir, checkpointing.shard_dir_name(0, 2), elastic.ELASTIC_INDEX
+    )
+    with open(idx, "a") as fh:
+        fh.write(" ")
+    with pytest.raises(storage.IntegrityError):
+        elastic.load_elastic_state(step_dir, rank=0, world_size=2)
+
+
+def test_reshard_from_memory_uri_backend(tmp_path):
+    """Re-shard straight off a scheme:// mirror: ranged reads go through
+    the backend (base-class read_range fallback), no local staging of the
+    whole checkpoint."""
+    g = {"w": np.arange(40.0).reshape(8, 5)}
+    step_dir = _commit_elastic_step(str(tmp_path), 4, g, 2)
+    uri = "memory://elastic_test/checkpoint_000004"
+    storage.commit_dir_to_uri(step_dir, uri)
+    slices = [
+        elastic.load_elastic_state(uri, rank=r, world_size=4)[0]["w"]
+        for r in range(4)
+    ]
+    assert np.array_equal(np.concatenate(slices), g["w"])
+
+
+def test_mixed_world_step_refused(tmp_path):
+    """Shards from two world sizes under one step prefix are a torn mix
+    of save generations — the loader must refuse, not interleave rows."""
+    g = {"w": np.arange(12.0).reshape(6, 2)}
+    step_dir = os.path.join(str(tmp_path), checkpointing.step_dir_name(1))
+    for r in range(2):
+        elastic.save_elastic_shard(
+            os.path.join(step_dir, checkpointing.shard_dir_name(r, 2)),
+            g, rank=r, world_size=2,
+        )
+    elastic.save_elastic_shard(
+        os.path.join(step_dir, checkpointing.shard_dir_name(0, 3)),
+        g, rank=0, world_size=3,
+    )
+    storage.write_commit_markers(step_dir, storage.build_manifest(step_dir))
+    with pytest.raises(storage.IntegrityError, match="multiple world sizes"):
+        elastic.load_elastic_full(step_dir)
+
+
+def test_resize_report_clears_stale_layout(tmp_path):
+    """A rank snapshotting a step dir left over from another world size
+    (a dead attempt's shards, or a flat world-1 residue) must clear the
+    stale layout — otherwise the commit would manifest a mixed dir."""
+    from ray_tpu.train._session import _clear_stale_layouts
+
+    step_dir = str(tmp_path / "checkpoint_000003")
+    g = {"w": np.arange(8.0).reshape(4, 2)}
+    # dead world-4 attempt left two shards; a flat file rides along too
+    for r in (0, 2):
+        elastic.save_elastic_shard(
+            os.path.join(step_dir, checkpointing.shard_dir_name(r, 4)),
+            g, rank=r, world_size=4,
+        )
+    # current world 2: rank 0's fresh shard already landed
+    elastic.save_elastic_shard(
+        os.path.join(step_dir, checkpointing.shard_dir_name(0, 2)),
+        g, rank=0, world_size=2,
+    )
+    open(os.path.join(step_dir, "stale_flat.bin"), "w").close()
+    _clear_stale_layouts(step_dir, 2)
+    assert sorted(os.listdir(step_dir)) == ["shard-00000-of-00002"]
+    # shrink to world 1: ALL shard dirs are stale (flat layout expected)
+    elastic.save_elastic_shard(
+        os.path.join(step_dir, checkpointing.shard_dir_name(1, 2)),
+        g, rank=1, world_size=2,
+    )
+    _clear_stale_layouts(step_dir, 1)
+    assert os.listdir(step_dir) == []
+
+
+def test_pick_shard_cross_world_rules(tmp_path):
+    """_pick_shard: exact (rank, world) match; a SOLE rank-0 shard (the
+    gather pattern, full state) restores into any world; a truly
+    partitioned other-world layout falls back to the step dir (a
+    different world's slice is the wrong rows)."""
+    from ray_tpu.train._session import _pick_shard
+
+    step = str(tmp_path / "checkpoint_000001")
+    for r in range(2):
+        os.makedirs(os.path.join(step, checkpointing.shard_dir_name(r, 2)))
+    # same world: exact match
+    assert _pick_shard(step, 1, 2).endswith("shard-00001-of-00002")
+    # world changed, multi-shard layout: step dir (elastic loader's job)
+    assert _pick_shard(step, 0, 3) is None
+    assert _pick_shard(step, 0, 1) is None
+    # sole rank-0 shard = gathered full state: safe at any world
+    step2 = str(tmp_path / "checkpoint_000002")
+    os.makedirs(os.path.join(step2, checkpointing.shard_dir_name(0, 4)))
+    assert _pick_shard(step2, 2, 3).endswith("shard-00000-of-00004")
+    assert _pick_shard(step2, 0, 1).endswith("shard-00000-of-00004")
+    # flat world-1 layout: no shard dirs at all
+    step3 = str(tmp_path / "checkpoint_000003")
+    os.makedirs(step3)
+    assert _pick_shard(step3, 0, 1) is None
+
+
+def test_uncovered_rows_refused(tmp_path):
+    """A checkpoint missing a shard (lost rows) must refuse ranks whose
+    partition needs them, not zero-fill."""
+    g = {"w": np.arange(12.0).reshape(6, 2)}
+    step_dir = _commit_elastic_step(str(tmp_path), 1, g, 3)
+    import shutil
+
+    shutil.rmtree(os.path.join(step_dir, checkpointing.shard_dir_name(1, 3)))
+    # re-commit so the manifest matches what's on disk (the shard was
+    # legitimately lost, not torn)
+    storage.write_commit_markers(step_dir, storage.build_manifest(step_dir))
+    with pytest.raises(storage.IntegrityError, match="not covered"):
+        elastic.load_elastic_full(step_dir)
+
+
+# --------------------------------------------------------------------------
+# trainer-level N→M resume (real worker group, tiny workload)
+# --------------------------------------------------------------------------
+
+
+def _sgd_loop(total_steps):
+    def loop(config=None):
+        from ray_tpu import train
+
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(16, 4))
+        y = X @ np.array([1.0, -2.0, 3.0, 0.5])
+        state = train.load_elastic(full=True)
+        if state is not None:
+            arrays, extra = state
+            w, start = arrays["w"], int(extra["step"])
+        else:
+            w, start = np.zeros(4), 0
+        for step in range(start, total_steps):
+            w = w - 0.05 * (2.0 * X.T @ (X @ w - y) / len(y))
+            train.report_elastic(
+                {"loss": float(np.mean((X @ w - y) ** 2))},
+                {"w": w},
+                extra={"step": step + 1},
+            )
+
+    return loop
+
+
+def test_trainer_resume_across_world_sizes(ray_start_regular, tmp_path):
+    """fit at world 2, stop, resume the SAME run at world 3: ranks restore
+    re-sharded slices of the 2-shard checkpoint, continue the step
+    numbering, and land on the loss an uninterrupted world-2 run gets."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    calm = JaxTrainer(
+        _sgd_loop(6),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="calm"),
+    ).fit()
+    assert calm.error is None, calm.error
+
+    first = JaxTrainer(
+        _sgd_loop(3),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="grow"),
+    ).fit()
+    assert first.error is None, first.error
+    manifest = storage.read_committed_manifest(
+        os.path.join(str(tmp_path / "grow"), checkpointing.step_dir_name(3))
+    )
+    assert manifest is not None and manifest["world_size"] == 2
+
+    second = JaxTrainer(
+        _sgd_loop(6),
+        scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(storage_path=str(tmp_path), name="grow"),
+    ).fit()
+    assert second.error is None, second.error
+    assert second.metrics["training_iteration"] == 6
+    assert second.metrics["loss"] == calm.metrics["loss"]
+    manifest = storage.read_committed_manifest(
+        os.path.join(str(tmp_path / "grow"), checkpointing.step_dir_name(6))
+    )
+    assert manifest is not None and manifest["world_size"] == 3
+
+
+# --------------------------------------------------------------------------
+# satellites: collector trim, backoff, drain timeout
+# --------------------------------------------------------------------------
+
+
+def test_report_collector_drops_drained_entries(ray_start_regular):
+    """Regression: drain(start) must trim the buffered history (the seed
+    kept every report forever — a long run's metrics accumulated in the
+    collector actor unbounded)."""
+    from ray_tpu.train._backend_executor import _ReportCollector
+
+    c = _ReportCollector.remote()
+    for i in range(40):
+        ray_tpu.get(c.report.remote(0, i, {"i": i}, None), timeout=30)
+    assert ray_tpu.get(c.buffered.remote(), timeout=30) == 40
+    out = ray_tpu.get(c.drain.remote(0), timeout=30)
+    assert [r[1] for r in out] == list(range(40))
+    # drained entries are gone from the actor...
+    assert ray_tpu.get(c.buffered.remote(), timeout=30) == 0
+    # ...and the offset keeps subsequent drains consistent
+    for i in range(40, 45):
+        ray_tpu.get(c.report.remote(0, i, {"i": i}, None), timeout=30)
+    out2 = ray_tpu.get(c.drain.remote(40), timeout=30)
+    assert [r[1] for r in out2] == [40, 41, 42, 43, 44]
+    assert ray_tpu.get(c.drain.remote(45), timeout=30) == []
+
+
+def test_retry_backoff_schedule():
+    from ray_tpu.train import FailureConfig
+    from ray_tpu.train.jax_trainer import _retry_backoff
+
+    cfg = FailureConfig(
+        retry_backoff_s=0.5, retry_backoff_max_s=4.0, retry_backoff_jitter=0.0
+    )
+    assert [_retry_backoff(a, cfg) for a in (1, 2, 3, 4, 5)] == [
+        0.5,
+        1.0,
+        2.0,
+        4.0,
+        4.0,  # capped
+    ]
+    jittered = FailureConfig(
+        retry_backoff_s=1.0, retry_backoff_max_s=8.0, retry_backoff_jitter=0.5
+    )
+    for attempt in (1, 3):
+        base = min(8.0, 1.0 * 2 ** (attempt - 1))
+        for _ in range(20):
+            d = _retry_backoff(attempt, jittered)
+            assert 0.5 * base <= d <= 1.5 * base
+
+
+def test_drain_timeout_surfaces_undrained_steps(ray_start_regular, tmp_path):
+    """Satellite: a drain timeout in fit()'s finally must emit a
+    CHECKPOINT_FAILED event and put the undrained steps on Result.error —
+    never return as if everything committed."""
+
+    class _HangBackend(storage.StorageBackend):
+        def __init__(self):
+            self._inner = storage.MemoryBackend()
+
+        def write_bytes(self, path, data):
+            time.sleep(8.0)  # the mirror is wedged
+            self._inner.write_bytes(path, data)
+
+        def write_stream(self, path, chunks):
+            time.sleep(8.0)
+            self._inner.write_stream(path, chunks)
+
+        def read_bytes(self, path):
+            return self._inner.read_bytes(path)
+
+        def exists(self, path):
+            return self._inner.exists(path)
+
+        def delete(self, path):
+            return self._inner.delete(path)
+
+        def list(self, prefix):
+            return self._inner.list(prefix)
+
+    storage.register_backend("hangstore", _HangBackend)
+    from ray_tpu.train import (
+        CheckpointConfig,
+        Checkpoint,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+    from ray_tpu import train
+
+    def loop(config=None):
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "m.txt"), "w") as fh:
+            fh.write("x")
+        train.report({"ok": 1.0}, checkpoint=Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path="hangstore://drainbase",
+            name="draintest",
+            checkpoint_config=CheckpointConfig(drain_timeout_s=0.5),
+        ),
+    ).fit()
+    assert isinstance(result.error, checkpointing.CheckpointDrainError), result.error
+    assert result.error.undrained_steps == [1]
+    # the local commit landed before the wedged mirror: resume point exists
+    assert result.checkpoint is not None
+    from ray_tpu.util import state as state_api
+
+    failed = [
+        e
+        for e in state_api.list_cluster_events()
+        if e["type"] == "CHECKPOINT_FAILED" and e.get("run") == "draintest"
+    ]
+    assert failed and failed[-1].get("undrained_steps") == [1], failed
