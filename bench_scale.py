@@ -60,22 +60,127 @@ def bench_nodes(cluster, n_nodes: int) -> None:
     emit("scale_node_join_rate", n_nodes / dt, "nodes/s")
 
 
-def bench_queue_depth(n_tasks: int) -> None:
+def _tick_hist_snapshot() -> dict:
+    from ray_tpu._private.worker import get_runtime
+
+    return json.loads(json.dumps(get_runtime().node.scheduler._tick_hist))
+
+
+def bench_queue_depth(n_tasks: int, curve_points: int = 10) -> None:
     @ray_tpu.remote
     def noop(i):
         return i
 
+    h0 = _tick_hist_snapshot()
     t0 = time.perf_counter()
     refs = [noop.remote(i) for i in range(n_tasks)]
     submit_dt = time.perf_counter() - t0
     emit("scale_task_submit_rate", n_tasks / submit_dt, "tasks/s")
-    # drain: the scheduler must stay responsive with a deep queue
+    # drain: the scheduler must stay responsive with a deep queue. Results
+    # are collected in ordered chunks — completions are ~FIFO, so the chunk
+    # timestamps trace the drain-rate curve.
     t1 = time.perf_counter()
-    out = ray_tpu.get(refs, timeout=3600)
+    chunk = max(1, n_tasks // curve_points)
+    curve = []
+    done = 0
+    out = []
+    for i in range(0, n_tasks, chunk):
+        out = ray_tpu.get(refs[i : i + chunk], timeout=3600)
+        done += len(out)
+        curve.append([done, round(time.perf_counter() - t1, 3)])
     drain_dt = time.perf_counter() - t1
     assert out[-1] == n_tasks - 1
     emit("scale_queued_tasks_drained", float(n_tasks), "tasks")
     emit("scale_task_drain_rate", n_tasks / drain_dt, "tasks/s")
+    emit(f"scale_task_drain_curve_{n_tasks}", curve, "[tasks,s]")
+    # per-tick dispatch cost at this depth (histogram delta over the phase):
+    # flatness across 100k -> 1M runs is the million-task acceptance signal
+    h1 = _tick_hist_snapshot()
+    dcount = h1["count"] - h0["count"]
+    dsum = h1["sum"] - h0["sum"]
+    emit(
+        f"scale_sched_tick_mean_us_{n_tasks}",
+        (dsum / dcount * 1e6) if dcount else 0.0,
+        "us/tick",
+    )
+    emit(f"scale_sched_tick_count_{n_tasks}", float(dcount), "ticks")
+
+
+def bench_locality(n_nodes: int, mib: int, rounds: int = 8) -> None:
+    """Big-arg placement: counter-based cross-node transfer accounting with
+    locality-aware dispatch ON vs OFF (host-noise-immune — counts and bytes,
+    not wall clock). Each round pre-stages a fresh blob on a rotating
+    node-affinity target (an upstream producer's output living somewhere
+    specific), then dispatches one unconstrained consumer: ON follows the
+    bytes (zero pulls), OFF lands wherever the default policy says and pays
+    a pull whenever that differs from the stage node. Consumers are pinned
+    off the head (bcast marker — the head holds every driver put, so it
+    would trivially win locality), and the shm short-circuit is disabled so
+    residency is explicit, as on a real multi-machine fleet."""
+    from ray_tpu._private.worker import get_runtime
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    sch = get_runtime().node.scheduler
+    marked = [
+        n["node_id"]
+        for n in ray_tpu.nodes()
+        if n["alive"] and n["total"].get("bcast")
+    ]
+
+    @ray_tpu.remote(num_cpus=1, resources={"bcast": 0.01})
+    def consume(x):
+        assert float(x[0]) == 1.0 and float(x[-1]) == 1.0
+        return x.nbytes
+
+    @ray_tpu.remote(num_cpus=1)
+    def stage(x):
+        return x.nbytes  # arg delivery pulls the blob onto this node
+
+    # warm per-node workers so spawn latency doesn't serialize the phase
+    small = ray_tpu.put(np.ones(8))
+    ray_tpu.get([consume.remote(small) for _ in range(n_nodes)], timeout=1200)
+
+    def run_once(flag: bool):
+        sch.config.locality_aware_dispatch = flag
+        moved = xfers = 0
+        for r in range(rounds):
+            blob = ray_tpu.put(
+                np.ones(mib * 1024 * 1024 // 8, dtype=np.float64)
+            )
+            target = marked[r % len(marked)]
+            ray_tpu.get(
+                stage.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=target
+                    )
+                ).remote(blob),
+                timeout=1200,
+            )
+            b0 = sum(sch._xfer_done_bytes)
+            c0 = sum(sch._xfer_done_count)
+            ray_tpu.get(consume.remote(blob), timeout=1200)
+            moved += sum(sch._xfer_done_bytes) - b0
+            xfers += sum(sch._xfer_done_count) - c0
+            del blob
+        return moved, xfers
+
+    sch.config.same_host_shm_transfer = False
+    try:
+        on_b, on_x = run_once(True)
+        off_b, off_x = run_once(False)
+    finally:
+        sch.config.same_host_shm_transfer = True
+        sch.config.locality_aware_dispatch = True
+    emit("scale_locality_rounds", float(rounds), "staged consumers")
+    emit("scale_locality_transfers_off", float(off_x), "transfers")
+    emit("scale_locality_transfers_on", float(on_x), "transfers")
+    emit("scale_locality_xfer_mib_off", off_b / 2**20, "MiB")
+    emit(
+        "scale_locality_xfer_mib_on",
+        on_b / 2**20,
+        "MiB",
+        reference=round(off_b / 2**20, 3) or None,
+    )
 
 
 def bench_actor_fleet(n_actors: int) -> None:
@@ -214,27 +319,35 @@ def main() -> None:
     ap.add_argument("--actors", type=int, default=1000)
     ap.add_argument("--broadcast-mib", type=int, default=256)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--locality-mib", type=int, default=32)
     ap.add_argument(
         "--only",
-        choices=["nodes", "broadcast", "tasks", "actors"],
+        choices=["nodes", "broadcast", "tasks", "actors", "locality"],
         help="run one phase (nodes are always set up first)",
     )
     args = ap.parse_args()
     if args.quick:
         args.nodes, args.tasks, args.actors = 8, 5_000, 100
         args.broadcast_mib = 64
+        args.locality_mib = 8
 
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     try:
-        # only the broadcast-only mode shrinks the fleet (it reads from at
-        # most 8 nodes anyway); task/actor phases keep the requested size so
-        # their numbers are comparable with full runs
-        n_nodes = min(args.nodes, 8) if args.only == "broadcast" else args.nodes
+        # only the broadcast/locality-only modes shrink the fleet (they use
+        # at most 8 marked nodes anyway); task/actor phases keep the
+        # requested size so their numbers are comparable with full runs
+        n_nodes = (
+            min(args.nodes, 8)
+            if args.only in ("broadcast", "locality")
+            else args.nodes
+        )
         bench_nodes(cluster, n_nodes)
         # broadcast before the churn-heavy phases: reaping thousands of
         # worker processes would otherwise contaminate its timing
         if args.only in (None, "broadcast"):
             bench_broadcast(min(n_nodes, 8), args.broadcast_mib)
+        if args.only in (None, "locality"):
+            bench_locality(min(n_nodes, 8), args.locality_mib)
         if args.only in (None, "tasks"):
             bench_queue_depth(args.tasks)
         if args.only in (None, "actors"):

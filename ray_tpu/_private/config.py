@@ -65,6 +65,12 @@ class Config:
     # shared by the daemon's _lease_tick and the head's promote mirror so
     # their dispatch orders stay aligned (local_task_manager.cc:122)
     lease_lookahead: int = 16
+    # locality-aware dispatch: tasks whose stored args total at least
+    # locality_min_arg_bytes prefer a runnable node already holding them
+    # (object-directory scoring) over the default hybrid policy — big
+    # inputs stop triggering pulls over the socket plane
+    locality_aware_dispatch: bool = True
+    locality_min_arg_bytes: int = 100 * 1024
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 = num_cpus
     worker_idle_timeout_s: float = 300.0

@@ -63,10 +63,12 @@ class StorePutMixin:
         with stage_timer("store.put.seal"):
             self.seal(oid)
 
-    def put_serialized(self, oid: ObjectID, serde, value) -> None:
+    def put_serialized(self, oid: ObjectID, serde, value) -> int:
         """Serialize straight into the store buffer (one copy fewer than
         serialize-to-bytes + put_bytes; parity: plasma clients write into the
-        create()d buffer, ``plasma_store_provider.h:88``)."""
+        create()d buffer, ``plasma_store_provider.h:88``). Returns the
+        sealed size in bytes (the head records it for locality-aware
+        dispatch and transfer accounting)."""
         with stage_timer("store.put.serialize"):
             pickled, buffers = serde.serialize(value)
             size = serde.serialized_size(pickled, buffers)
@@ -75,12 +77,13 @@ class StorePutMixin:
                 buf = self.create(oid, size)
         except ValueError:
             if self.contains(oid):
-                return  # duplicate store (task retry): first copy wins
+                return size  # duplicate store (task retry): first copy wins
             raise
         with stage_timer("store.put.copy", size):
             serde.write_to(pickled, buffers, buf)
         with stage_timer("store.put.seal"):
             self.seal(oid)
+        return size
 
 
 class ObjectStoreClient(StorePutMixin):

@@ -75,16 +75,29 @@ class MemoryStore:
         # hot spot in the deep-queue microbench)
         self._waiters: Dict[ObjectID, List[dict]] = {}
 
+    def _put_locked(self, oid: ObjectID, entry: Tuple) -> None:
+        # caller holds the lock
+        self._table[oid] = entry
+        for waiter in self._waiters.pop(oid, ()):
+            waiter["remaining"].discard(oid)
+            waiter["hits"] += 1
+            if (
+                waiter["need"] is None and not waiter["remaining"]
+            ) or (waiter["need"] is not None and waiter["hits"] >= waiter["need"]):
+                waiter["done"] = True
+
     def put(self, oid: ObjectID, entry: Tuple) -> None:
         with self._cv:
-            self._table[oid] = entry
-            for waiter in self._waiters.pop(oid, ()):
-                waiter["remaining"].discard(oid)
-                waiter["hits"] += 1
-                if (
-                    waiter["need"] is None and not waiter["remaining"]
-                ) or (waiter["need"] is not None and waiter["hits"] >= waiter["need"]):
-                    waiter["done"] = True
+            self._put_locked(oid, entry)
+            self._cv.notify_all()
+
+    def put_many(self, items) -> None:
+        """Commit a batch of (oid, entry) pairs under ONE lock round and one
+        notify — the per-task commit lock was the last per-task cost on the
+        lease completion path (a (node, tick) frame commits dozens)."""
+        with self._cv:
+            for oid, entry in items:
+                self._put_locked(oid, entry)
             self._cv.notify_all()
 
     def get_entry(self, oid: ObjectID) -> Optional[Tuple]:
@@ -327,6 +340,28 @@ class TaskRecord:
     error_node: Optional[str] = None
 
 
+# sentinel shard key for tasks whose placement is per-task, not per-shape
+# (node affinity, placement-group bundles): they keep the old bounded-scan
+# discipline inside one small shard
+_OTHER_SHARD_KEY = ("OTHER",)
+
+
+@dataclass
+class _ReadyShard:
+    """One ready-queue shard: FIFO of queued tasks sharing a scheduling
+    class. For DEFAULT/SPREAD work the class is (strategy, task type, job,
+    resource shape) and ``demand`` holds the common shape — one placement
+    probe per tick answers for every entry, so an infeasible shape costs
+    zero scans regardless of depth. ``demand`` is None only for the OTHER
+    shard (per-task placement state)."""
+
+    key: Tuple
+    kind: str
+    task_type: TaskType
+    demand: Optional[Dict[str, float]]
+    queue: Deque[TaskID] = field(default_factory=collections.deque)
+
+
 @dataclass
 class PlacementGroupState:
     pg_id: PlacementGroupID
@@ -427,7 +462,18 @@ class Scheduler:
         self.actors: Dict[ActorID, ActorState] = {}
         self.tasks: Dict[TaskID, TaskRecord] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupState] = {}
-        self._pending: Deque[TaskID] = collections.deque()
+        # ---- sharded ready queue (dispatch core; see DESIGN_MAP
+        # "Scheduler dispatch core") ----
+        # shard key -> _ReadyShard; per-tick cost is O(shards x nodes +
+        # dispatched), flat in queue depth (the old flat deque paid a
+        # deferral pass per tick per queued task)
+        self._ready_shards: Dict[Tuple, _ReadyShard] = {}
+        self._ready_count = 0  # total queued entries across shards
+        self._ready_rr = 0  # shard rotation cursor (dispatch fairness)
+        self._refill_rr = 0  # shard rotation cursor for targeted refills
+        # wall-clock timestamp shared by every event recorded within one
+        # dispatch pass / completion batch (amortizes time.time() per frame)
+        self._pass_now: Optional[float] = None
         self._dep_waiters: Dict[ObjectID, Set[TaskID]] = collections.defaultdict(set)
         # worker pulls waiting on pending objects: oid -> [(worker_id, req_id)]
         self._pull_waiters: Dict[ObjectID, List[Tuple[WorkerID, int]]] = collections.defaultdict(list)
@@ -511,6 +557,31 @@ class Scheduler:
         # copy (parity: OwnershipBasedObjectDirectory,
         # ownership_based_object_directory.h:37)
         self._object_locations: Dict[ObjectID, Set[NodeID]] = collections.defaultdict(set)
+        # object sizes the head has learned (driver/worker puts, client
+        # uploads): feeds locality-aware dispatch scoring and transfer-byte
+        # accounting; entries die with the object (_free_object)
+        self._object_sizes: Dict[ObjectID, int] = {}
+        # locality-aware dispatch accounting: big-arg tasks that landed on
+        # (hit) / off (miss) a node already holding their argument bytes
+        self._locality_hits = 0
+        self._locality_misses = 0
+        # completed inter-node transfers by path ([socket, shm]): counts and
+        # bytes (sizes where known) — the host-noise-immune locality signal
+        self._xfer_done_count = [0, 0]
+        self._xfer_done_bytes = [0, 0]
+        # per-tick dispatch-pass duration histogram (metrics.py Histogram
+        # data shape, so /metrics renders _bucket lines); flatness of the
+        # mean across queue depths is the million-task acceptance signal
+        self._tick_boundaries = [
+            0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+            0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        ]
+        self._tick_hist = {
+            "count": 0,
+            "sum": 0.0,
+            "buckets": [0] * (len(self._tick_boundaries) + 1),
+            "boundaries": list(self._tick_boundaries),
+        }
         # in-flight transfers: (oid, dest node) -> (source node, charged)
         # where charged means the transfer holds one of the source's
         # admission slots (same-host shm reads don't)
@@ -886,6 +957,8 @@ class Scheduler:
             # graceful actor termination (ray.kill / __ray_terminate__)
             self._on_worker_death(wid, graceful=True)
         elif kind == "submit_put":
+            if len(msg) > 2 and msg[2]:
+                self._object_sizes[msg[1]] = int(msg[2])
             self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
         elif kind == "put_object":
@@ -896,6 +969,7 @@ class Scheduler:
             try:
                 self._node.store_client.put_bytes(oid, blob)
                 self._object_locations[oid].add(self._node.head_node_id)
+                self._object_sizes[oid] = len(blob)
                 self._commit_result(oid, ("stored",))
             except Exception as e:  # noqa: BLE001
                 logger.exception("client put of %s failed", oid.hex()[:8])
@@ -1153,6 +1227,11 @@ class Scheduler:
         if entry is not None and entry[1]:
             self._xfer_load[entry[0]] = max(0, self._xfer_load[entry[0]] - 1)
         if ok:
+            if entry is not None:
+                # charged == socket path; uncharged == same-host shm read
+                idx = 0 if entry[1] else 1
+                self._xfer_done_count[idx] += 1
+                self._xfer_done_bytes[idx] += self._object_sizes.get(oid, 0)
             self._object_locations[oid].add(dest)
             self._shm_xfer_failed.discard((oid, dest))
         elif entry is not None and not entry[1]:
@@ -1308,6 +1387,8 @@ class Scheduler:
         elif kind == "put_done":
             if cmd[2][0] == "stored":
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
+                if len(cmd) > 3 and cmd[3]:
+                    self._object_sizes[cmd[1]] = int(cmd[3])
             self._commit_result(cmd[1], cmd[2])
         elif kind == "add_node":
             self._dispatch_dirty = True
@@ -1588,16 +1669,103 @@ class Scheduler:
                     deps.add(a.object_id)
         return deps
 
+    # ---- sharded ready queue ---------------------------------------------
+
+    def _shard_key(self, spec: TaskSpec) -> Tuple:
+        strat = spec.scheduling_strategy
+        if strat.kind in ("DEFAULT", "SPREAD"):
+            return (
+                strat.kind,
+                spec.task_type.value,
+                spec.task_id.job_id().binary(),
+                tuple(sorted(spec.resources.items())),
+            )
+        return _OTHER_SHARD_KEY
+
+    def _ready_push(self, rec: TaskRecord, front: bool = False) -> None:
+        """Queue a PENDING task in its shard. ``front`` re-queues a popped
+        head whose placement just failed — that must NOT re-dirty dispatch
+        (the fleet didn't change; a blocked shard would otherwise force a
+        full pass every loop iteration)."""
+        spec = rec.spec
+        key = self._shard_key(spec)
+        shard = self._ready_shards.get(key)
+        if shard is None:
+            shard = self._ready_shards[key] = _ReadyShard(
+                key=key,
+                kind=spec.scheduling_strategy.kind,
+                task_type=spec.task_type,
+                demand=None if key == _OTHER_SHARD_KEY else dict(spec.resources),
+            )
+        if front:
+            shard.queue.appendleft(spec.task_id)
+        else:
+            shard.queue.append(spec.task_id)
+            self._dispatch_dirty = True
+        self._ready_count += 1
+
+    def _ready_pop_valid(self, shard: _ReadyShard) -> Optional[TaskRecord]:
+        """Pop the shard's first still-PENDING task, dropping stale entries
+        (cancelled / failed / already re-dispatched) on the way."""
+        q = shard.queue
+        while q:
+            tid = q.popleft()
+            self._ready_count -= 1
+            rec = self.tasks.get(tid)
+            if rec is not None and rec.state == "PENDING":
+                return rec
+        return None
+
+    def _ready_remove(self, spec: TaskSpec) -> None:
+        """Remove one queued entry (cancellation path; rare — O(shard))."""
+        shard = self._ready_shards.get(self._shard_key(spec))
+        if shard is not None:
+            try:
+                shard.queue.remove(spec.task_id)
+                self._ready_count -= 1
+            except ValueError:
+                pass
+
+    def _any_ready_dispatchable(self) -> bool:
+        """True when some queued shard could be placed on the live fleet
+        right now (the work-steal gate: stealing node backlogs is pointless
+        while the head can still place its own queue, but an infeasible
+        head queue must not suppress it)."""
+        for shard in self._ready_shards.values():
+            if not shard.queue:
+                continue
+            if shard.demand is None:
+                return True  # per-task placement: assume placeable
+            for n in self.nodes.values():
+                if n.alive and n.can_run(shard.demand):
+                    return True
+        return False
+
+    def _now_ts(self) -> float:
+        """Wall-clock for event records: one timestamp per dispatch pass /
+        completion frame instead of a time.time() per task."""
+        return self._pass_now if self._pass_now is not None else time.time()
+
+    def _observe_tick(self, dt: float) -> None:
+        h = self._tick_hist
+        h["count"] += 1
+        h["sum"] += dt
+        for i, b in enumerate(self._tick_boundaries):
+            if dt <= b:
+                h["buckets"][i] += 1
+                break
+        else:
+            h["buckets"][-1] += 1
+
     def _make_schedulable(self, rec: TaskRecord):
-        self._dispatch_dirty = True
         rec.state = "PENDING"
         # deps resolved, entering the dispatch queue: the QUEUED->DISPATCHED
         # gap in the timeline is pure scheduler queueing delay
-        self._record_event(rec.spec, "QUEUED")
+        self._record_event(rec.spec, "QUEUED", ts=self._pass_now)
         if rec.spec.task_type == TaskType.ACTOR_TASK:
             self._dispatch_actor_task(rec)
         else:
-            self._pending.append(rec.spec.task_id)
+            self._ready_push(rec)
 
     def _schedule(self):
         """Dispatch pending tasks to idle workers; spawn workers as needed.
@@ -1732,90 +1900,125 @@ class Scheduler:
         for pg in self.placement_groups.values():
             if pg.state == "PENDING":
                 self._create_pg(pg)
-        if not self._pending:
+        if not self._ready_count:
             return
-        # event-driven dispatch: rescanning the deferred queue every loop
-        # tick is O(pending^2) under load — only rescan when capacity or the
-        # queue changed (dirty), with a periodic safety rescan bounding any
-        # missed wake-up
+        # event-driven dispatch: only sweep when capacity or the queue
+        # changed (dirty), with a periodic safety sweep bounding any missed
+        # wake-up. Each sweep is O(shards x nodes + dispatched) — flat in
+        # queue depth — so the old per-pass fail caps and rotation hacks
+        # are gone (they fought the flat deque's O(pending) deferral scans).
         now_d = time.monotonic()
         periodic = now_d - self._last_full_dispatch >= 0.5
         if not self._dispatch_dirty and not periodic:
             return
         self._dispatch_dirty = False
-        # Dirty-path scans (a worker freed, a task arrived) bail after a few
-        # consecutive placement failures: with a deep homogeneous queue the
-        # rest of the scan is O(pending) of guaranteed failures, turning the
-        # whole drain into O(pending^2). Heterogeneous stragglers that a
-        # capped scan skips are picked up by the periodic full rescan.
-        # the periodic rescan is bounded too: scanning a 100k-deep queue
-        # against a saturated fleet is O(pending x nodes) of guaranteed
-        # placement failures every 0.5s — it crushed 16-50-node drains.
-        # Rotation (below) still gives stragglers eventual coverage.
-        fail_cap = 256 if periodic else 32
         if periodic:
             self._last_full_dispatch = now_d
-        deferred = []
-        consecutive_fails = 0
-        task_id = None
+        t0 = time.perf_counter()
+        self._dispatch_pass(periodic)
+        self._observe_tick(time.perf_counter() - t0)
+
+    def _dispatch_pass(self, periodic: bool) -> None:
+        """One placement sweep over the sharded ready queue.
+
+        Shape shards (DEFAULT/SPREAD) stop at their FIRST placement failure:
+        same demand + same fleet means every deeper entry fails identically,
+        and a shape with no feasible node is skipped without popping a single
+        entry. The OTHER shard (node affinity, placement groups) keeps
+        per-task placement and is scanned with the old bounded fail cap +
+        rotation, now scoped to the small shard that actually needs it.
+        Shards are visited in rotating order so one deep shape cannot starve
+        the rest of a tick's capacity."""
         self._pick_cache = {}
-        # per-resource-class attempt cap within one scan (the raylet's
-        # blocked-classes rule, relaxed to 4 so _acquire_worker's
-        # demand-driven spawn widening still ramps): a homogeneous
-        # 100-deep queue behind one freed worker costs ~4 placement
-        # attempts instead of fail_cap of them
-        class_fails: Dict[Tuple, int] = {}
+        self._pass_now = time.time()
         try:
-            while self._pending:
-                task_id = self._pending.popleft()
-                rec = self.tasks.get(task_id)
-                if rec is None or rec.state not in ("PENDING",):
-                    task_id = None
+            keys = list(self._ready_shards.keys())
+            if not keys:
+                return
+            n = len(keys)
+            start = self._ready_rr % n
+            self._ready_rr += 1
+            for i in range(n):
+                key = keys[(start + i) % n]
+                shard = self._ready_shards.get(key)
+                if shard is None:
                     continue
-                strat = rec.spec.scheduling_strategy
-                klass = None
-                if strat.kind in ("DEFAULT", "SPREAD"):
-                    # task_type is part of the class: actor creations are
-                    # not leasable, so their failures must not block NORMAL
-                    # tasks of the same shape from the lease-overflow path
-                    klass = (
-                        strat.kind,
-                        rec.spec.task_type,
-                        tuple(sorted(rec.spec.resources.items())),
-                    )
-                    if class_fails.get(klass, 0) >= 4:
-                        deferred.append(task_id)
-                        consecutive_fails += 1
-                        task_id = None
-                        if consecutive_fails >= fail_cap:
-                            break
-                        continue
-                placed = self._try_dispatch(rec)
-                if not placed:
-                    if klass is not None:
-                        class_fails[klass] = class_fails.get(klass, 0) + 1
-                    deferred.append(task_id)
-                    consecutive_fails += 1
-                    if consecutive_fails >= fail_cap:
-                        break
+                if not shard.queue:
+                    # empty shards are GC'd here (not on pop) so one-shot
+                    # shapes don't accumulate dict entries forever
+                    del self._ready_shards[key]
+                    continue
+                if shard.demand is None:
+                    self._drain_other_shard(shard, periodic)
                 else:
-                    if klass is not None:
-                        class_fails[klass] = 0
-                    consecutive_fails = 0
-                task_id = None
+                    self._drain_shape_shard(shard)
         finally:
             self._pick_cache = None
-            # an exception from _try_dispatch must not orphan the popped
-            # task or the deferred scan — losing them wedges the drain
-            if task_id is not None and task_id not in deferred:
-                deferred.append(task_id)
-            self._pending.extendleft(reversed(deferred))
-        if periodic and consecutive_fails >= fail_cap and len(self._pending) > fail_cap:
-            # start the next periodic scan deeper in: a straggler whose
-            # demand only SOME node satisfies is found within
-            # O(pending / fail_cap) periods instead of never
-            self._pending.rotate(-fail_cap)
+            self._pass_now = None
         self._flush_lease_batches()
+
+    def _drain_shape_shard(self, shard: _ReadyShard) -> None:
+        demand = shard.demand
+        cache = self._pick_cache
+        feas_key = ("__feas__",) + tuple(sorted(demand.items()))
+        feasible = cache.get(feas_key) if cache is not None else None
+        if feasible is None:
+            feasible = any(
+                n.alive and n.feasible(demand) for n in self.nodes.values()
+            )
+            if cache is not None:
+                cache[feas_key] = feasible
+        if not feasible:
+            # no node of this shape exists at ALL: zero placement probes;
+            # the shard waits for the fleet to change (autoscaler input)
+            return
+        while shard.queue:
+            rec = self._ready_pop_valid(shard)
+            if rec is None:
+                return
+            placed = False
+            try:
+                placed = self._try_dispatch(rec)
+            finally:
+                if not placed:
+                    # a dispatch exception must not orphan the popped task
+                    self._ready_push(rec, front=True)
+            if not placed:
+                # same demand, same fleet: every deeper entry fails too
+                return
+
+    def _drain_other_shard(self, shard: _ReadyShard, periodic: bool) -> None:
+        """Per-task placement work (node affinity, PG bundles): bounded scan
+        with rotation — the flat-queue discipline, confined to this shard."""
+        q = shard.queue
+        fail_cap = 256 if periodic else 32
+        fails = 0
+        scanned = 0
+        max_scan = len(q)
+        deferred: List[TaskID] = []
+        while q and scanned < max_scan and fails < fail_cap:
+            scanned += 1
+            rec = self._ready_pop_valid(shard)
+            if rec is None:
+                break
+            placed = False
+            try:
+                placed = self._try_dispatch(rec)
+            finally:
+                if not placed:
+                    deferred.append(rec.spec.task_id)
+            if not placed:
+                fails += 1
+            else:
+                fails = 0
+        if deferred:
+            q.extendleft(reversed(deferred))
+            self._ready_count += len(deferred)
+        if periodic and fails >= fail_cap and len(q) > fail_cap:
+            # start the next periodic scan deeper in: a straggler whose
+            # node-affinity target frees later is found within
+            # O(len/fail_cap) periods instead of never
+            q.rotate(-fail_cap)
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
         """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
@@ -1837,16 +2040,92 @@ class Scheduler:
                     # can contain a node that died mid-pass
                     if n.alive and n.can_run(demand):
                         return n
-                    return None if not strat.soft else self._pick_node_default(demand, alive)
-            return None if not strat.soft else self._pick_node_default(demand, alive)
+                    return None if not strat.soft else self._pick_node_default(demand, alive, spec)
+            return None if not strat.soft else self._pick_node_default(demand, alive, spec)
         if strat.kind == "SPREAD":
             runnable = [n for n in alive if n.alive and n.can_run(demand)]
             if not runnable:
                 return None
             return min(runnable, key=lambda n: n.utilization())
-        return self._pick_node_default(demand, alive)
+        return self._pick_node_default(demand, alive, spec)
 
-    def _pick_node_default(self, demand, alive) -> Optional[NodeState]:
+    def _locality_args(self, spec: TaskSpec) -> Optional[List[Tuple[int, Set[NodeID]]]]:
+        """[(size_bytes, holder node-id set)] for this task's stored args at
+        or above the locality threshold; None when locality dispatch is off
+        or nothing qualifies. Sizes come from the head's put-time records;
+        a stored arg of unknown size is weighted at the object-store inline
+        threshold (anything in the store is at least that big)."""
+        if not spec.args and not spec.kwargs:
+            return None  # arg-less fast path: zero allocations per dispatch
+        if not getattr(self.config, "locality_aware_dispatch", True):
+            return None
+        out = None
+        floor = getattr(
+            self.config, "locality_min_arg_bytes", 100 * 1024
+        )
+        args = (
+            spec.args
+            if not spec.kwargs
+            else itertools.chain(spec.args, spec.kwargs.values())
+        )
+        for a in args:
+            if not a.is_ref or a.object_id is None:
+                continue
+            oid = a.object_id
+            locs = self._object_locations.get(oid)
+            if not locs:
+                continue
+            size = self._object_sizes.get(oid)
+            if size is None:
+                entry = self.memory_store.get_entry(oid)
+                if entry is None or entry[0] != "stored":
+                    continue
+                size = self.config.max_direct_call_object_size
+            if size < floor:
+                continue
+            if out is None:
+                out = []
+            out.append((size, locs))
+        return out
+
+    def _pick_node_local_args(
+        self, big, demand, alive
+    ) -> Optional[NodeState]:
+        """Runnable candidate holding the most resident argument bytes
+        (ties broken toward lower utilization); None when no runnable node
+        holds any of them."""
+        best = None
+        best_score = (0.0,)
+        for n in alive:
+            if not (n.alive and n.can_run(demand)):
+                continue
+            loc = self._loc_node(n.node_id)
+            resident = 0
+            for size, locs in big:
+                if loc in locs:
+                    resident += size
+            if resident <= 0:
+                continue
+            score = (resident, -n.utilization())
+            if best is None or score > best_score:
+                best, best_score = n, score
+        return best
+
+    def _pick_node_default(self, demand, alive, spec=None) -> Optional[NodeState]:
+        # locality-aware dispatch (parity role: the reference's
+        # locality-aware leasing in cluster_task_manager / the push-pull
+        # object directory, SURVEY L4): a task with large resident args
+        # lands where its inputs live instead of pulling them over the
+        # socket plane. Checked BEFORE the local-node shortcut — a head
+        # that merely has free CPU must not drag remote gigabytes home.
+        if spec is not None:
+            big = self._locality_args(spec)
+            if big:
+                n = self._pick_node_local_args(big, demand, alive)
+                if n is not None:
+                    self._locality_hits += 1
+                    return n
+                self._locality_misses += 1
         local = self._node.head_node_id
         local_node = self.nodes.get(local)
         if (
@@ -1984,7 +2263,7 @@ class Scheduler:
         # pending actor creations prestarts wide so child boots overlap
         # (parity: WorkerPool prestart sized by queued leases,
         # worker_pool.h:83); the floor of 4 keeps small bursts cheap
-        cap = max(4, min(32, len(self._pending)))
+        cap = max(4, min(32, self._ready_count))
         if self._starting_count[node.node_id] < cap:
             self._starting_count[node.node_id] += 1
             self._node.spawn_worker(node.node_id)
@@ -2055,8 +2334,10 @@ class Scheduler:
         self._lease_batch.setdefault(node.node_id, []).append(spec)
         self._lease_last_activity[node.node_id] = time.monotonic()
         # leasing to a node-local dispatcher IS the dispatch decision; the
-        # daemon's lease_started (with its own timestamp) marks RUNNING
-        self._record_event(spec, "DISPATCHED")
+        # daemon's lease_started (with its own timestamp) marks RUNNING.
+        # ts rides the per-pass timestamp so a 1000-grant pass pays one
+        # time.time(), not a thousand
+        self._record_event(spec, "DISPATCHED", ts=self._pass_now)
         return True
 
     def _flush_lease_batches(self) -> None:
@@ -2113,7 +2394,13 @@ class Scheduler:
         (unstarted) tasks back from the deepest node backlog so they can be
         placed where the capacity is — without this, the tail of a big batch
         sits parked behind one slow node."""
-        if self._pending or not self._lease_backlog:
+        if not self._lease_backlog:
+            return
+        if self._ready_count and self._any_ready_dispatchable():
+            # the head can still place queued work itself — stealing is for
+            # when its own queue is empty OR wholly infeasible. (The old
+            # flat-queue gate bailed on ANY pending work, which parked
+            # feasible node backlogs behind an infeasible head queue.)
             return
         victim = None
         victim_len = 0
@@ -2169,7 +2456,7 @@ class Scheduler:
             rec = self.tasks.get(tid)
             if rec is not None and rec.state == "LEASED":
                 rec.state = "PENDING"
-                self._pending.append(tid)
+                self._ready_push(rec)
         self._dispatch_dirty = True
 
     def _lease_release(self, nid: NodeID, demand: Dict[str, float]) -> None:
@@ -2230,94 +2517,160 @@ class Scheduler:
     def _refill_node(self, nid: NodeID) -> None:
         """Targeted refill after a completion freed capacity on ONE node:
         grant pending work straight to it instead of waking the global
-        dispatch pass — which, against an otherwise-full fleet, burns
-        O(fail_cap x nodes) of placement probes per completion (measured:
-        a 50-node drain crawled at ~100 tasks/s on exactly that)."""
+        dispatch pass. With shards this walks only the NORMAL-task shapes
+        the node can serve — O(shards + granted), not a 64-deep scan of a
+        flat queue that may hold none of them."""
+        if not self._ready_count:
+            return
         node = self.nodes.get(nid)
         if node is None or not node.alive or node.daemon_conn is None:
             return
         cap = self._node_backlog_cap(node)
-        deferred = []
-        scanned = 0
-        while self._pending and scanned < 64:
-            tid = self._pending.popleft()
-            scanned += 1
-            rec = self.tasks.get(tid)
-            if rec is None or rec.state != "PENDING":
-                continue  # stale entry: drop
-            spec = rec.spec
-            strat = spec.scheduling_strategy
+        keys = list(self._ready_shards.keys())
+        n = len(keys)
+        if not n:
+            return
+        # one wall timestamp per refill frame (grants record DISPATCHED)
+        outer_ts = self._pass_now
+        if outer_ts is None:
+            self._pass_now = time.time()
+        start = self._refill_rr % n
+        self._refill_rr += 1
+        for i in range(n):
+            shard = self._ready_shards.get(keys[(start + i) % n])
             if (
-                spec.task_type != TaskType.NORMAL_TASK
-                or strat.kind not in ("DEFAULT", "SPREAD")
+                shard is None
+                or not shard.queue
+                or shard.demand is None
+                or shard.task_type != TaskType.NORMAL_TASK
             ):
-                deferred.append(tid)
                 continue
-            if node.can_run(spec.resources):
+            demand = shard.demand
+            # grant into free capacity first, then into the bounded backlog
+            while shard.queue and node.can_run(demand):
+                if self._refill_prefer_elsewhere(shard, nid):
+                    break
+                rec = self._ready_pop_valid(shard)
+                if rec is None:
+                    break
                 self._lease_to(node, rec, acquired=True)
-            elif (
-                len(self._lease_backlog[nid]) < cap
-                and node.feasible(spec.resources)
+            while (
+                shard.queue
+                and len(self._lease_backlog[nid]) < cap
+                and node.feasible(demand)
+                and node.alive
             ):
+                if self._refill_prefer_elsewhere(shard, nid):
+                    break
+                rec = self._ready_pop_valid(shard)
+                if rec is None:
+                    break
                 self._lease_to(node, rec, acquired=False)
-            else:
-                deferred.append(tid)
-                break  # node full (for this demand shape)
-        self._pending.extendleft(reversed(deferred))
+        self._pass_now = outer_ts
         self._flush_lease_batches()
+
+    def _refill_prefer_elsewhere(self, shard: _ReadyShard, nid: NodeID) -> bool:
+        """Locality guard for the refill fast path: when the shard head is a
+        big-arg task whose argument bytes are resident on OTHER nodes that
+        could run it right now, leave it for the locality-aware dispatch
+        pass instead of granting it here (which would trigger a pull). Only
+        the head is checked — FIFO-per-shape is preserved, and a resident
+        node that never frees cannot starve the task (the guard requires
+        can_run NOW; otherwise the refill proceeds)."""
+        q = shard.queue
+        while q:
+            rec = self.tasks.get(q[0])
+            if rec is not None and rec.state == "PENDING":
+                break
+            q.popleft()
+            self._ready_count -= 1
+        if not q or rec.spec.task_type != TaskType.NORMAL_TASK:
+            return False
+        big = self._locality_args(rec.spec)
+        if not big:
+            return False
+        here = self._loc_node(nid)
+        if any(here in locs for _, locs in big):
+            return False  # this node already holds (some of) the bytes
+        demand = rec.spec.resources
+        for _, locs in big:
+            for owner in locs:
+                onode = self.nodes.get(owner)
+                if onode is not None and onode.alive and onode.can_run(demand):
+                    self._dispatch_dirty = True  # let the main pass place it
+                    return True
+        return False
 
     def _on_lease_done(self, nid: NodeID, entries) -> None:
         # deliberately NOT marking dispatch dirty: the freed capacity is
-        # refilled directly below; the periodic full pass covers stragglers
-        self._lease_last_activity[nid] = time.monotonic()
-        for tid_bin, results in entries:
-            tid = TaskID(tid_bin)
-            info = self._leased.get(tid)
-            if info is not None and info[0] != nid:
-                # stale report: this lease was reconciled away and belongs
-                # to ANOTHER node now — popping it here would corrupt the
-                # new node's accounting and discard its execution
-                continue
-            info = self._lease_pop(tid)
-            if info is not None and info[1]:
-                self._lease_release(info[0], info[2])
-            rec = self.tasks.get(tid)
-            if rec is None or info is None or rec.state not in ("LEASED", "RUNNING"):
-                continue  # cancelled / node re-registered meanwhile
-            spec = rec.spec
-            if (
-                spec.retry_exceptions
-                and not spec.is_streaming
-                and rec.retries_left > 0
-                and results
-                and results[0][0] == "error"
-                and self._retryable_app_error(results[0], spec.retry_exceptions)
-            ):
-                rec.retries_left -= 1
-                self._record_event(spec, "RETRY")
-                self._record_task_retry(rec, "application exception matched retry_exceptions")
-                self._make_schedulable(rec)
-                continue
-            rec.state = "FINISHED"
-            rec.end_time = time.monotonic()
-            self._record_event(spec, "FINISHED")
-            if results and results[0][0] == "error":
-                self._note_task_error(
-                    rec,
-                    results[0],
-                    self.workers.get(rec.worker_id),
-                    node_hint=nid.hex(),
-                )
-            else:
-                self._note_task_runtime(rec)
-            for i, entry in enumerate(results):
-                oid = ObjectID.for_return(spec.task_id, i)
-                if entry[0] == "stored":
-                    self._object_locations[oid].add(nid)
-                self._commit_result(oid, entry)
-            self._unpin(spec.arg_ref_ids())
+        # refilled directly below; the periodic full pass covers stragglers.
+        # Per-frame amortization: one wall/monotonic timestamp pair and ONE
+        # memory-store commit round for the whole batch — the remaining
+        # per-task work is pure ledger math.
+        now_m = time.monotonic()
+        self._lease_last_activity[nid] = now_m
+        self._pass_now = time.time()
+        commits: List[Tuple[ObjectID, Tuple]] = []
+        try:
+            for tid_bin, results in entries:
+                tid = TaskID(tid_bin)
+                info = self._leased.get(tid)
+                if info is not None and info[0] != nid:
+                    # stale report: this lease was reconciled away and belongs
+                    # to ANOTHER node now — popping it here would corrupt the
+                    # new node's accounting and discard its execution
+                    continue
+                info = self._lease_pop(tid)
+                if info is not None and info[1]:
+                    self._lease_release(info[0], info[2])
+                rec = self.tasks.get(tid)
+                if rec is None or info is None or rec.state not in ("LEASED", "RUNNING"):
+                    continue  # cancelled / node re-registered meanwhile
+                spec = rec.spec
+                if (
+                    spec.retry_exceptions
+                    and not spec.is_streaming
+                    and rec.retries_left > 0
+                    and results
+                    and results[0][0] == "error"
+                    and self._retryable_app_error(results[0], spec.retry_exceptions)
+                ):
+                    rec.retries_left -= 1
+                    self._record_event(spec, "RETRY", ts=self._pass_now)
+                    self._record_task_retry(rec, "application exception matched retry_exceptions")
+                    self._make_schedulable(rec)
+                    continue
+                rec.state = "FINISHED"
+                rec.end_time = now_m
+                self._record_event(spec, "FINISHED", ts=self._pass_now)
+                if results and results[0][0] == "error":
+                    self._note_task_error(
+                        rec,
+                        results[0],
+                        self.workers.get(rec.worker_id),
+                        node_hint=nid.hex(),
+                    )
+                else:
+                    self._note_task_runtime(rec)
+                for i, entry in enumerate(results):
+                    oid = ObjectID.for_return(spec.task_id, i)
+                    if entry[0] == "stored":
+                        self._object_locations[oid].add(nid)
+                    commits.append((oid, entry))
+                self._unpin(spec.arg_ref_ids())
+        finally:
+            self._pass_now = None
+            if commits:
+                self._commit_results(commits)
         self._promote_lease_backlog(nid)
         self._refill_node(nid)
+
+    def _commit_results(self, items: List[Tuple[ObjectID, Tuple]]) -> None:
+        """Batched commit: one memory-store lock round for a whole frame."""
+        self._commit_count += len(items)
+        self.memory_store.put_many(items)
+        for oid, entry in items:
+            self._wake_waiters(oid, entry)
 
     def _on_lease_worker_gone(self, wid: WorkerID, tid_bin) -> None:
         w = self.workers.get(wid)
@@ -2340,8 +2693,7 @@ class Scheduler:
             rec.retries_left -= 1
             rec.state = "PENDING"
             rec.worker_id = None
-            self._pending.append(tid)
-            self._dispatch_dirty = True
+            self._ready_push(rec)
             self._record_task_retry(rec, "lease worker died")
         else:
             self._fail_task(
@@ -2460,8 +2812,7 @@ class Scheduler:
                     rec.retries_left -= 1
                 rec.state = "PENDING"
                 rec.worker_id = None
-                self._pending.append(tid)
-                self._dispatch_dirty = True
+                self._ready_push(rec)
             else:
                 self._fail_task(
                     rec,
@@ -2848,7 +3199,7 @@ class Scheduler:
                     rec.retries_left -= 1
                     rec.state = "PENDING"
                     rec.worker_id = None
-                    self._pending.append(rec.spec.task_id)
+                    self._ready_push(rec)
                     self._record_task_retry(rec, "worker died")
                 elif not graceful:
                     self._fail_task(
@@ -2893,7 +3244,7 @@ class Scheduler:
                     respec = actor.creation_spec
                     rec = TaskRecord(spec=respec, retries_left=0)
                     self.tasks[respec.task_id] = rec
-                    self._pending.append(respec.task_id)
+                    self._ready_push(rec)
                 else:
                     actor.state = "DEAD"
                     actor.death_cause = "actor worker died"
@@ -2956,10 +3307,7 @@ class Scheduler:
             return
         if rec.state in ("PENDING", "WAITING_DEPS"):
             self._fail_task(rec, exc.RayTpuError("task cancelled"))
-            try:
-                self._pending.remove(task_id)
-            except ValueError:
-                pass
+            self._ready_remove(rec.spec)
         elif rec.state == "RUNNING" and force and rec.worker_id is not None:
             w = self.workers.get(rec.worker_id)
             if w is not None and w.proc is not None:
@@ -3303,16 +3651,77 @@ class Scheduler:
             return out
         if op == "pending_demand":
             # resource shapes the scheduler cannot currently place (autoscaler
-            # input; parity: GcsAutoscalerStateManager cluster_resource_state)
+            # input; parity: GcsAutoscalerStateManager cluster_resource_state).
+            # Built from the shard index — O(shards), not a copy of a
+            # million-deep queue — and capped: the bin-packing consumer
+            # saturates long before 10k entries.
             demand: List[Dict[str, float]] = []
-            for tid in list(self._pending):
-                rec = self.tasks.get(tid)
-                if rec is not None and rec.state == "PENDING":
-                    demand.append(dict(rec.spec.resources))
+            cap = 10_000
+            for shard in self._ready_shards.values():
+                if len(demand) >= cap:
+                    break
+                if not shard.queue:
+                    continue
+                if shard.demand is not None:
+                    k = min(len(shard.queue), cap - len(demand))
+                    demand.extend(dict(shard.demand) for _ in range(k))
+                else:
+                    for tid in list(shard.queue)[: cap - len(demand)]:
+                        rec = self.tasks.get(tid)
+                        if rec is not None and rec.state == "PENDING":
+                            demand.append(dict(rec.spec.resources))
             for pg in self.placement_groups.values():
                 if pg.state == "PENDING":
                     demand.extend(dict(b) for b in pg.bundles)
             return demand
+        if op == "backlog_summary":
+            # per-resource-shape backlog: queued at the head (shards),
+            # leased out, and parked in node-local dispatch backlogs — the
+            # autoscaler's demand signal and `ray_tpu status --backlog`
+            shapes: Dict[Tuple, dict] = {}
+
+            def _row(shape_t: Tuple) -> dict:
+                row = shapes.get(shape_t)
+                if row is None:
+                    row = shapes[shape_t] = {
+                        "shape": dict(shape_t),
+                        "queued": 0,
+                        "leased": 0,
+                        "node_backlog": 0,
+                    }
+                return row
+
+            for shard in self._ready_shards.values():
+                if not shard.queue:
+                    continue
+                if shard.demand is not None:
+                    _row(tuple(sorted(shard.demand.items())))["queued"] += len(
+                        shard.queue
+                    )
+                else:
+                    for tid in shard.queue:
+                        rec = self.tasks.get(tid)
+                        if rec is not None and rec.state == "PENDING":
+                            _row(
+                                tuple(sorted(rec.spec.resources.items()))
+                            )["queued"] += 1
+            backlogged = {
+                tid for q in self._lease_backlog.values() for tid in q
+            }
+            for tid, info in self._leased.items():
+                shape_t = tuple(sorted(info[2].items()))
+                _row(shape_t)["leased"] += 1
+                if tid in backlogged:
+                    _row(shape_t)["node_backlog"] += 1
+            return {
+                "shapes": list(shapes.values()),
+                "pg_pending": [
+                    dict(b)
+                    for pg in self.placement_groups.values()
+                    if pg.state == "PENDING"
+                    for b in pg.bundles
+                ],
+            }
         if op == "summarize_tasks":
             summary: Dict[str, Dict[str, int]] = {}
             for t in list(self.tasks.values()):
@@ -3594,6 +4003,7 @@ class Scheduler:
     def _free_object(self, oid: ObjectID):
         self._cross_channel.discard(oid)
         self._ref_channel.pop(oid, None)
+        self._object_sizes.pop(oid, None)
         self._xfer_waiting.pop(oid, None)
         if self._shm_xfer_failed:
             self._shm_xfer_failed = {
@@ -4242,8 +4652,61 @@ class Scheduler:
         add(
             "ray_tpu_scheduler_queue_depth",
             "gauge",
-            "tasks waiting in the scheduler's pending queue",
-            {lk(): len(self._pending)},
+            "tasks waiting in the scheduler's sharded ready queue",
+            {lk(): self._ready_count},
+        )
+        shard_depth: Dict[str, int] = {}
+        for shard in self._ready_shards.values():
+            if not shard.queue:
+                continue
+            if shard.demand is None:
+                key = lk(kind="OTHER", shape="per-task")
+            else:
+                key = lk(
+                    kind=shard.kind,
+                    shape=json.dumps(shard.demand, sort_keys=True),
+                )
+            shard_depth[key] = shard_depth.get(key, 0) + len(shard.queue)
+        add(
+            "ray_tpu_sched_ready_shard_depth",
+            "gauge",
+            "queued tasks per (strategy, resource shape) ready-queue shard",
+            shard_depth or {lk(): 0},
+        )
+        add(
+            "ray_tpu_sched_tick_seconds",
+            "histogram",
+            "dispatch-pass duration per scheduler tick (flat in queue depth)",
+            {lk(): json.loads(json.dumps(self._tick_hist))},
+        )
+        add(
+            "ray_tpu_object_transfers_total",
+            "counter",
+            "completed inter-node object transfers by path",
+            {
+                lk(path="socket"): self._xfer_done_count[0],
+                lk(path="shm"): self._xfer_done_count[1],
+            },
+        )
+        add(
+            "ray_tpu_object_transfer_bytes_total",
+            "counter",
+            "bytes moved by completed inter-node transfers (sizes where "
+            "known to the head)",
+            {
+                lk(path="socket"): self._xfer_done_bytes[0],
+                lk(path="shm"): self._xfer_done_bytes[1],
+            },
+        )
+        add(
+            "ray_tpu_sched_locality_decisions_total",
+            "counter",
+            "big-arg placement decisions that landed on a node holding the "
+            "argument bytes (hit) vs not (miss)",
+            {
+                lk(outcome="hit"): self._locality_hits,
+                lk(outcome="miss"): self._locality_misses,
+            },
         )
         by_state: Dict[str, int] = {}
         for t in self.tasks.values():

@@ -345,9 +345,9 @@ class DriverRuntime:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed")
         oid = ObjectID.for_put(self.task_id, self._put_counter.next())
-        self.store.put_serialized(oid, self.serde, value)
+        size = self.store.put_serialized(oid, self.serde, value)
         self.scheduler.memory_store.put(oid, ("stored",))
-        self.scheduler.post(("put_done", oid, ("stored",)))
+        self.scheduler.post(("put_done", oid, ("stored",), size))
         return oid
 
     def object_ready(self, oid: ObjectID) -> bool:

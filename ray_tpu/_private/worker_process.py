@@ -315,8 +315,8 @@ class WorkerRuntime:
     def put(self, value) -> ObjectID:
         tid = self.current_task_id or TaskID.nil()
         oid = ObjectID.for_put(tid, self._put_counter.next())
-        self.store.put_serialized(oid, self.serde, value)
-        self._send(("submit_put", oid))
+        size = self.store.put_serialized(oid, self.serde, value)
+        self._send(("submit_put", oid, size))
         return oid
 
     def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:

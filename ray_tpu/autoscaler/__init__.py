@@ -9,7 +9,12 @@ GCE surface of ``autoscaler/gcp/tpu_command_runner.py``); slice-atomicity:
 TPU node types scale in whole slices.
 """
 
-from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeType
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ClusterStateSource,
+    NodeType,
+)
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     LocalDaemonNodeProvider,
@@ -19,6 +24,7 @@ from ray_tpu.autoscaler.node_provider import (
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
+    "ClusterStateSource",
     "NodeType",
     "NodeProvider",
     "FakeNodeProvider",

@@ -2,9 +2,15 @@
 
 Parity: ``StandardAutoscaler.update`` (``autoscaler.py:172,374``) +
 ``resource_demand_scheduler.py`` bin-packing, restructured as the v2
-reconciler: each ``update()`` computes a target node set from (pending
-demand, current nodes, min/max bounds, idle timeout) and drives the provider
-toward it.
+reconciler: each ``update()`` computes a target node set from (per-shape
+scheduler backlog, current nodes, min/max bounds, idle timeout) and drives
+the provider toward it.
+
+Inputs come from the scheduler's sharded ready queue via the
+``backlog_summary`` rpc (shape -> queued/leased/node_backlog counts) — the
+head never has to enumerate a million-deep queue to answer "what can't I
+place". ``ClusterStateSource`` is the seam: unit tests substitute a fake
+that feeds synthetic backlog ramps without a live cluster.
 """
 
 from __future__ import annotations
@@ -29,22 +35,31 @@ class AutoscalerConfig:
     node_types: List[NodeType] = field(default_factory=list)
     idle_timeout_s: float = 60.0
     upscaling_speed: float = 1.0  # max new nodes per update = max(1, speed * current)
+    # a shape's backlog (queued + node-queued) must reach this depth before
+    # it contributes scale-up demand; 1 = any queued task scales
+    scale_up_backlog_threshold: int = 1
+    # scale-down candidates must be at/below this utilization fraction
+    scale_down_util_floor: float = 0.0
+    # no-flap hysteresis: after any launch, terminations are suppressed for
+    # this long so a sawtooth backlog can't thrash nodes up and down
+    scale_down_cooldown_s: float = 30.0
+    # bound on demand entries expanded per shape for the bin-pack pass (a
+    # million-task backlog saturates every max_workers bound long before it)
+    max_demand_per_shape: int = 1024
 
 
-class Autoscaler:
-    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
-        self.config = config
-        self.provider = provider
-        self._idle_since: Dict[str, float] = {}
+class ClusterStateSource:
+    """Live-cluster inputs for the reconciler. Tests fake this seam."""
 
-    # -- inputs ------------------------------------------------------------
-
-    def _pending_demand(self) -> List[Dict[str, float]]:
+    def backlog(self) -> dict:
+        """The scheduler's per-shape backlog summary:
+        ``{"shapes": [{"shape", "queued", "leased", "node_backlog"}],
+        "pg_pending": [bundle, ...]}``."""
         from ray_tpu._private.worker import get_driver
 
-        return get_driver().scheduler_rpc("pending_demand", ())
+        return get_driver().scheduler_rpc("backlog_summary", ())
 
-    def _node_utilization(self) -> Dict[str, float]:
+    def utilization(self) -> Dict[str, float]:
         """node_id -> max resource utilization fraction."""
         import ray_tpu
 
@@ -60,11 +75,63 @@ class Autoscaler:
             out[n["node_id"]] = max(fracs) if fracs else 0.0
         return out
 
+
+def _shape_fits(shape: Dict[str, float], resources: Dict[str, float]) -> bool:
+    return all(resources.get(k, 0.0) >= v for k, v in shape.items())
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        config: AutoscalerConfig,
+        provider: NodeProvider,
+        state: Optional[ClusterStateSource] = None,
+    ):
+        self.config = config
+        self.provider = provider
+        self.state = state if state is not None else ClusterStateSource()
+        self._idle_since: Dict[str, float] = {}
+        self._last_scale_up = float("-inf")
+
+    # -- inputs ------------------------------------------------------------
+
+    def _demand_entries(self, backlog: dict) -> List[Dict[str, float]]:
+        """Expand the per-shape backlog into bin-packable demand entries,
+        thresholded and bounded."""
+        threshold = max(1, int(self.config.scale_up_backlog_threshold))
+        cap = max(1, int(self.config.max_demand_per_shape))
+        demand: List[Dict[str, float]] = []
+        for row in backlog.get("shapes", ()):
+            shape = row.get("shape") or {}
+            if not shape:
+                continue
+            pressure = int(row.get("queued", 0)) + int(row.get("node_backlog", 0))
+            if pressure < threshold:
+                continue
+            demand.extend(dict(shape) for _ in range(min(pressure, cap)))
+        demand.extend(dict(b) for b in backlog.get("pg_pending", ()) if b)
+        return demand
+
+    @staticmethod
+    def _backlogged_shapes(backlog: dict) -> List[Dict[str, float]]:
+        out = [
+            row["shape"]
+            for row in backlog.get("shapes", ())
+            if row.get("shape")
+            and int(row.get("queued", 0)) + int(row.get("node_backlog", 0)) > 0
+        ]
+        out.extend(b for b in backlog.get("pg_pending", ()) if b)
+        return out
+
     # -- reconcile ---------------------------------------------------------
 
     def update(self) -> Dict[str, int]:
         """One reconcile pass; returns {launched: n, terminated: m}."""
-        demand = self._pending_demand()
+        try:
+            backlog = self.state.backlog() or {}
+        except Exception:
+            backlog = {}
+        demand = self._demand_entries(backlog)
         nodes = self.provider.non_terminated_nodes()
         by_type: Dict[str, List[dict]] = {}
         for n in nodes:
@@ -72,6 +139,7 @@ class Autoscaler:
 
         launched = 0
         terminated = 0
+        now = time.monotonic()
 
         # 1. satisfy min_workers
         for nt in self.config.node_types:
@@ -81,7 +149,7 @@ class Autoscaler:
                 have += 1
                 launched += 1
 
-        # 2. bin-pack unplaced demand onto hypothetical new nodes
+        # 2. bin-pack backlog demand onto hypothetical new nodes
         to_launch: Dict[str, int] = {}
         remaining = [dict(d) for d in demand if d]
         for nt in self.config.node_types:
@@ -106,23 +174,39 @@ class Autoscaler:
             for _ in range(min(count, cap)):
                 self.provider.create_node(nt.name, nt.resources)
                 launched += 1
+        if launched:
+            self._last_scale_up = now
 
-        # 3. terminate idle nodes beyond min_workers
-        util = self._node_utilization()
-        now = time.monotonic()
+        # 3. idle-drain scale-down beyond min_workers. Hysteresis: fresh
+        # launches suppress terminations for scale_down_cooldown_s, and a
+        # node whose resources could serve any still-backlogged shape is
+        # never a candidate — queue pressure keeps the fleet up.
+        try:
+            util = self.state.utilization()
+        except Exception:
+            util = {}
+        backlogged = self._backlogged_shapes(backlog)
+        floor = self.config.scale_down_util_floor
+        cooldown_active = now - self._last_scale_up < self.config.scale_down_cooldown_s
         for nt in self.config.node_types:
             current = self.provider.non_terminated_nodes()
             mine = [n for n in current if n["node_type"] == nt.name]
+            serves_backlog = any(
+                _shape_fits(shape, nt.resources) for shape in backlogged
+            )
             for n in mine:
                 nid = n["node_id"]
-                if util.get(nid, 0.0) <= 0.0:
+                if util.get(nid, 0.0) <= floor and not serves_backlog:
                     self._idle_since.setdefault(nid, now)
                 else:
                     self._idle_since.pop(nid, None)
+            if cooldown_active or serves_backlog:
+                continue
             idle_long = [
                 n
                 for n in mine
-                if now - self._idle_since.get(n["node_id"], now)
+                if n["node_id"] in self._idle_since
+                and now - self._idle_since[n["node_id"]]
                 >= self.config.idle_timeout_s
             ]
             removable = len(mine) - nt.min_workers

@@ -90,6 +90,31 @@ def cmd_status(args):
     print(f"== nodes ({len(nodes)}) ==")
     for n in nodes:
         print(f"  {n['node_id'][:12]} alive={n['alive']} total={n['total']}")
+    if getattr(args, "backlog", False):
+        summary = state.backlog_summary()
+        rows = sorted(
+            summary.get("shapes", ()),
+            key=lambda r: -(r.get("queued", 0) + r.get("node_backlog", 0)),
+        )
+        print(f"== scheduler backlog by shape ({len(rows)}) ==")
+        for row in rows:
+            shape_s = (
+                ",".join(
+                    f"{k}:{v:g}" for k, v in sorted(row["shape"].items())
+                )
+                or "<none>"
+            )
+            print(
+                f"  {shape_s:<40} queued={row['queued']:<8} "
+                f"leased={row['leased']:<8} node_backlog={row['node_backlog']}"
+            )
+        if not rows:
+            print("  (empty)")
+        pg = summary.get("pg_pending", ())
+        if pg:
+            print(f"== pending placement-group bundles ({len(pg)}) ==")
+            for b in pg[:20]:
+                print("  " + ",".join(f"{k}:{v:g}" for k, v in sorted(b.items())))
 
 
 def cmd_summary(args):
@@ -349,6 +374,12 @@ def main(argv=None):
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status", help="cluster resources and nodes")
+    p.add_argument(
+        "--backlog",
+        action="store_true",
+        help="also print the scheduler's per-resource-shape backlog "
+        "(queued / leased / node-queued counts)",
+    )
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("summary", help="task state summary")

@@ -7,6 +7,7 @@ backed by the scheduler's task-event buffer and tables (the reference's
 """
 
 from ray_tpu.util.state.api import (
+    backlog_summary,
     get_log,
     list_actors,
     list_checkpoints,
@@ -21,6 +22,7 @@ from ray_tpu.util.state.api import (
 )
 
 __all__ = [
+    "backlog_summary",
     "list_tasks",
     "list_actors",
     "list_checkpoints",

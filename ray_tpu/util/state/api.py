@@ -84,6 +84,16 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return _rpc("summarize_tasks")
 
 
+def backlog_summary() -> dict:
+    """Per-resource-shape scheduler backlog: ``{"shapes": [{"shape",
+    "queued", "leased", "node_backlog"}], "pg_pending": [bundle, ...]}``.
+    ``queued`` counts tasks in the head's sharded ready queue, ``leased``
+    tasks handed to node-local dispatchers, ``node_backlog`` the leased
+    subset still parked in a node's local queue. The autoscaler's demand
+    input; surfaced by ``ray_tpu status --backlog``."""
+    return _rpc("backlog_summary")
+
+
 def list_cluster_events(filters=None, limit: int = 10_000) -> List[dict]:
     """Structured cluster events — WORKER_DIED, NODE_DEAD, TASK_RETRY,
     TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ... — in

@@ -1,0 +1,203 @@
+"""Backlog-driven autoscaler unit tests (no live cluster).
+
+Parity: ``python/ray/tests/test_autoscaler.py`` MockProvider pattern — a
+pure-python NodeProvider plus a fake ClusterStateSource feed the reconciler
+synthetic backlog ramps, so scale-up request counts, the scale-down
+utilization floor / empty-backlog rule, and the no-flap hysteresis are all
+asserted without spawning a cluster.
+"""
+
+import time
+
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ClusterStateSource,
+    NodeProvider,
+    NodeType,
+)
+
+
+class MockProvider(NodeProvider):
+    def __init__(self):
+        self._nodes = {}
+        self._seq = 0
+        self.created = []
+        self.terminated = []
+
+    def create_node(self, node_type, resources):
+        self._seq += 1
+        nid = f"n{self._seq}"
+        self._nodes[nid] = {
+            "node_id": nid,
+            "node_type": node_type,
+            "resources": dict(resources),
+            "launched_at": time.time(),
+        }
+        self.created.append(nid)
+        return nid
+
+    def terminate_node(self, node_id):
+        self._nodes.pop(node_id, None)
+        self.terminated.append(node_id)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes.values())
+
+
+class FakeState(ClusterStateSource):
+    def __init__(self):
+        self.shapes = []  # [{"shape", "queued", "leased", "node_backlog"}]
+        self.pg_pending = []
+        self.util = {}  # node_id -> fraction
+
+    def backlog(self):
+        return {"shapes": self.shapes, "pg_pending": self.pg_pending}
+
+    def utilization(self):
+        return dict(self.util)
+
+
+def _mk(config, provider=None, state=None):
+    provider = provider or MockProvider()
+    state = state or FakeState()
+    return Autoscaler(config, provider, state=state), provider, state
+
+
+def test_scale_up_request_count_matches_binpack():
+    auto, provider, state = _mk(
+        AutoscalerConfig(
+            node_types=[NodeType("cpu2", {"CPU": 2}, max_workers=8)],
+            upscaling_speed=100.0,  # don't throttle: count the bin-pack
+        )
+    )
+    state.shapes = [
+        {"shape": {"CPU": 1.0}, "queued": 5, "leased": 0, "node_backlog": 0}
+    ]
+    report = auto.update()
+    # 5 one-CPU tasks pack 2-per-node onto 2-CPU nodes -> 3 launches
+    assert report["launched"] == 3
+    assert len(provider.non_terminated_nodes()) == 3
+
+
+def test_scale_up_threshold_gates_demand():
+    auto, provider, state = _mk(
+        AutoscalerConfig(
+            node_types=[NodeType("cpu1", {"CPU": 1}, max_workers=8)],
+            scale_up_backlog_threshold=10,
+        )
+    )
+    state.shapes = [
+        {"shape": {"CPU": 1.0}, "queued": 5, "leased": 0, "node_backlog": 0}
+    ]
+    assert auto.update()["launched"] == 0
+    state.shapes[0]["queued"] = 10
+    assert auto.update()["launched"] >= 1
+
+
+def test_node_backlog_counts_as_pressure():
+    auto, provider, state = _mk(
+        AutoscalerConfig(
+            node_types=[NodeType("cpu1", {"CPU": 1}, max_workers=8)],
+            scale_up_backlog_threshold=4,
+        )
+    )
+    # tasks parked in node-local dispatch backlogs are queue pressure too
+    state.shapes = [
+        {"shape": {"CPU": 1.0}, "queued": 1, "leased": 5, "node_backlog": 3}
+    ]
+    assert auto.update()["launched"] >= 1
+
+
+def test_scale_down_requires_util_floor_and_empty_backlog():
+    cfg = AutoscalerConfig(
+        node_types=[NodeType("cpu1", {"CPU": 1}, max_workers=4)],
+        idle_timeout_s=0.0,
+        scale_down_util_floor=0.1,
+        scale_down_cooldown_s=0.0,
+    )
+    auto, provider, state = _mk(cfg)
+    nid = provider.create_node("cpu1", {"CPU": 1})
+
+    # busy node: never terminated
+    state.util = {nid: 0.5}
+    auto.update()
+    assert auto.update()["terminated"] == 0
+
+    # idle node BUT a backlogged shape this node type could serve: kept
+    state.util = {nid: 0.0}
+    state.shapes = [
+        {"shape": {"CPU": 1.0}, "queued": 2, "leased": 0, "node_backlog": 0}
+    ]
+    auto.update()
+    assert nid in [n["node_id"] for n in provider.non_terminated_nodes()]
+
+    # a backlogged shape the node CANNOT serve does not pin it
+    state.shapes = [
+        {"shape": {"TPU": 4.0}, "queued": 2, "leased": 0, "node_backlog": 0}
+    ]
+    auto.update()  # records idle
+    report = auto.update()
+    assert report["terminated"] == 1 or nid in provider.terminated
+
+
+def test_min_workers_respected_on_scale_down():
+    cfg = AutoscalerConfig(
+        node_types=[
+            NodeType("cpu1", {"CPU": 1}, min_workers=1, max_workers=4)
+        ],
+        idle_timeout_s=0.0,
+        scale_down_cooldown_s=0.0,
+    )
+    auto, provider, state = _mk(cfg)
+    auto.update()  # launches min_workers
+    auto.update()
+    auto.update()
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_backlog_ramp_up_and_down_without_flapping():
+    """Synthetic ramp: backlog appears, fleet scales up; backlog drains,
+    the cooldown holds the fleet, then idle-drain shrinks it — with no
+    launch/terminate oscillation in between."""
+    cfg = AutoscalerConfig(
+        node_types=[NodeType("cpu1", {"CPU": 1}, max_workers=4)],
+        idle_timeout_s=0.0,
+        scale_down_cooldown_s=60.0,
+        upscaling_speed=100.0,
+    )
+    auto, provider, state = _mk(cfg)
+
+    # ramp up
+    state.shapes = [
+        {"shape": {"CPU": 1.0}, "queued": 3, "leased": 0, "node_backlog": 0}
+    ]
+    report = auto.update()
+    assert report["launched"] == 3
+    fleet = {n["node_id"] for n in provider.non_terminated_nodes()}
+
+    # backlog drained, nodes idle — cooldown suppresses the down-swing
+    state.shapes = []
+    state.util = {nid: 0.0 for nid in fleet}
+    for _ in range(3):
+        report = auto.update()
+        assert report == {"launched": 0, "terminated": 0}
+    assert {n["node_id"] for n in provider.non_terminated_nodes()} == fleet
+
+    # cooldown expires -> idle-drain scale-down, once, with no relaunch
+    auto._last_scale_up = time.monotonic() - cfg.scale_down_cooldown_s - 1
+    report = auto.update()
+    assert report["launched"] == 0 and report["terminated"] == 3
+    assert provider.non_terminated_nodes() == []
+    assert auto.update() == {"launched": 0, "terminated": 0}
+
+
+def test_pg_pending_bundles_drive_scale_up():
+    auto, provider, state = _mk(
+        AutoscalerConfig(
+            node_types=[NodeType("cpu2", {"CPU": 2}, max_workers=4)],
+            upscaling_speed=100.0,
+        )
+    )
+    state.pg_pending = [{"CPU": 2.0}, {"CPU": 2.0}]
+    assert auto.update()["launched"] == 2
