@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos bench ci clean
+.PHONY: all native cpp sanitize test test-fast chaos bench bench-isolation ci clean
 
 all: native cpp
 
@@ -37,13 +37,20 @@ test-fast: native
 		tests/test_direct_actor.py tests/test_data.py -q
 
 # slow-marked fault-injection suite: worker/node SIGKILLs mid-run, elastic
-# resume convergence. Excluded from tier-1; seeded via CHAOS_SEED.
+# resume convergence, priority-preemption resume. Excluded from tier-1;
+# seeded via CHAOS_SEED.
 chaos:
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py \
-		tests/test_elastic_chaos.py -m slow -q
+		tests/test_elastic_chaos.py tests/test_preempt_chaos.py -m slow -q
 
 bench:
 	$(PY) bench.py
+
+# multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
+# must not degrade a high-priority job's p99 probe latency beyond 2x its
+# calm baseline. Slow; excluded from tier-1.
+bench-isolation:
+	$(PY) bench_isolation.py
 
 ci: native cpp sanitize test
 

@@ -48,6 +48,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "timeline",
+    "job_scope",
     "__version__",
 ]
 
@@ -86,6 +87,27 @@ def method(num_returns: int = 1):
         return m
 
     return decorate
+
+
+def job_scope(
+    *,
+    name: str = "",
+    priority: int = 0,
+    weight: float = 1.0,
+    quota=None,
+    meta=None,
+):
+    """Run a block of submissions as a distinct tenant of the multi-tenant
+    job plane: tasks, actors, and puts created inside the ``with`` block
+    are arbitrated (weighted-fair queueing), quota-capped, and
+    priority-ranked under one job. ``quota`` caps live usage per resource
+    (plus the ``object_store_bytes`` pseudo-resource); ``priority`` feeds
+    preemption and admission ordering. Raises
+    ``exceptions.JobAdmissionError`` if admission control rejects the
+    submission outright."""
+    return get_runtime().job_scope(
+        name=name, priority=priority, weight=weight, quota=quota, meta=meta
+    )
 
 
 def put(value: Any) -> ObjectRef:

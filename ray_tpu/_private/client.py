@@ -86,14 +86,60 @@ class RemoteDriverRuntime(WorkerRuntime):
                 ),
             )
         super().__init__(conn, WorkerID(info["worker_id"]), store, config)
-        # unique put-id namespace per driver (workers get theirs per-task)
+        # unique put-id namespace per driver (workers get theirs per-task);
+        # a driver launched on behalf of a submitted job binds to that
+        # job's arbitration record via the environment (job plane)
         self.job_id = JobID.from_int(int.from_bytes(os.urandom(3), "little"))
+        env_job = os.environ.get("RAY_TPU_JOB_ID")
+        if env_job:
+            try:
+                self.job_id = JobID.from_hex(env_job)
+            except ValueError:
+                pass
         self.current_task_id = TaskID.for_driver(self.job_id)
         self.closed = False
         self._reader = threading.Thread(
             target=self.reader_loop, name="client-reader", daemon=True
         )
         self._reader.start()
+
+    def job_scope(
+        self,
+        *,
+        name: str = "",
+        priority: int = 0,
+        weight: float = 1.0,
+        quota=None,
+        meta=None,
+    ):
+        """Remote-driver half of ``ray_tpu.job_scope`` (same contract as
+        ``DriverRuntime.job_scope``): register a tenant over the head
+        socket, then bind this driver's submissions/puts to it for the
+        duration of the ``with`` block."""
+        import contextlib
+
+        from ray_tpu import exceptions as exc
+
+        info = self.rpc(
+            "submit_job", name, int(priority), float(weight), quota, meta
+        )
+        if info["admission"] == "REJECTED":
+            raise exc.JobAdmissionError(
+                f"job {name or info['job']} rejected by admission control"
+            )
+        job = JobID.from_hex(info["job"])
+
+        @contextlib.contextmanager
+        def _scope():
+            prev_job, prev_task = self.job_id, self.current_task_id
+            self.job_id = job
+            self.current_task_id = TaskID.for_driver(job)
+            try:
+                yield info
+            finally:
+                self.job_id, self.current_task_id = prev_job, prev_task
+
+        return _scope()
 
     # -- cross-machine object plane ---------------------------------------
 

@@ -139,6 +139,27 @@ class Config:
     # prints a digest of the pending task chain (states, workers) and
     # records a HUNG_GET event; 0 disables
     hung_get_warn_s: float = 60.0
+    # --- multi-tenant job plane (scheduler arbitration; see DESIGN_MAP
+    # "Multi-tenant job plane") ---
+    # weighted-fair queueing: tasks a weight-1.0 job may dispatch per
+    # scheduling-pass visit before yielding to the next job (its quantum);
+    # a job's quantum is fair_share_quantum x weight, and jobs are served
+    # in ascending virtual time (dispatches / weight)
+    fair_share_quantum: float = 8.0
+    # admission control: new job submissions are QUEUED (not ADMITTED)
+    # while the cluster backlog (head ready queue + outstanding leases)
+    # exceeds this bound; 0 disables the bound (always admit)
+    job_admission_backlog_max: int = 0
+    # submissions arriving while this many jobs are already waiting in the
+    # admission queue are REJECTED outright
+    job_admission_max_queued: int = 64
+    # priority preemption: when an ADMITTED job's ready task has waited
+    # longer than preemption_wait_s while strictly-lower-priority jobs hold
+    # resources, the scheduler kills one victim worker per scan (lowest
+    # priority first, then highest held usage, never one inside a
+    # checkpoint-commit protect window)
+    preemption_enabled: bool = True
+    preemption_wait_s: float = 3.0
     # --- misc ---
     session_dir_root: str = "/tmp/ray_tpu_sessions"
     log_to_driver: bool = True

@@ -87,26 +87,28 @@ class MemoryMonitor:
 
 
 def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
-    """Retriable-last-started-first kill policy (parity:
-    ``worker_killing_policy_group_by_owner.h:85`` simplified)."""
+    """Job-aware kill policy: lowest-priority job first, then highest held
+    usage, then retriable-last-started-first (parity:
+    ``worker_killing_policy_group_by_owner.h:85`` grown into the
+    multi-tenant plane's shared victim selection —
+    ``Scheduler.pick_oom_victim`` is the same ranking priority preemption
+    uses, so the two kill paths can't diverge). Workers inside a
+    checkpoint-commit protect window are never chosen."""
 
     def kill() -> bool:
-        candidates = []
-        for rec in scheduler.tasks.values():
-            if rec.state == "RUNNING" and rec.worker_id is not None:
-                w = scheduler.workers.get(rec.worker_id)
-                if w is None or w.proc is None:
-                    continue
-                retriable = rec.retries_left > 0
-                candidates.append((not retriable, -(rec.start_time or 0), w))
-        if not candidates:
+        picked = scheduler.pick_oom_victim()
+        if picked is None:
             return False
-        candidates.sort()
-        _, _, victim = candidates[0]
+        victim, job_bin, priority = picked
         try:
             victim.proc.terminate()
         except Exception:
             return False
+        # per-job accounting first (int bump, can't raise past the getattr)
+        try:
+            scheduler.note_oom_kill(job_bin)
+        except Exception:
+            pass
         try:
             # forensics only: must not flip the kill verdict — a False here
             # would make the monitor escalate onto a second worker while
@@ -114,11 +116,15 @@ def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
             scheduler.record_cluster_event(
                 "OOM",
                 f"memory monitor killed worker {victim.worker_id.hex()[:12]} "
-                f"(pid {victim.proc.pid}) to relieve node memory pressure",
+                f"(pid {victim.proc.pid}) to relieve node memory pressure "
+                f"(job {job_bin.hex() if job_bin else '?'}, "
+                f"priority {priority})",
                 severity="ERROR",
                 worker_id=victim.worker_id.hex(),
                 node_id=victim.node_id.hex(),
                 pid=victim.proc.pid,
+                job_id=job_bin.hex() if job_bin else None,
+                priority=priority,
             )
         except Exception:
             pass

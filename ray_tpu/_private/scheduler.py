@@ -38,6 +38,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (
     ActorID,
+    JobID,
     NodeID,
     ObjectID,
     PlacementGroupID,
@@ -294,6 +295,12 @@ class WorkerState:
     # message); resolve_actors hands it to callers so the hot path skips
     # the head (parity: the worker's gRPC endpoint in the actor table)
     direct_addr: Any = None
+    # preemption shield: >0 while the worker is inside a protected window
+    # (mid-commit checkpoint save) — victim selection skips it
+    protect_count: int = 0
+    # actor lifetime resources charged against the owning job's quota
+    # (released on worker death; tasks charge via TaskRecord.charged)
+    job_charged: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -319,6 +326,10 @@ class ActorState:
     # terminates an out-of-scope actor after its submitted tasks finish)
     outstanding: int = 0
     pending_kill: bool = False
+    # set when the actor's worker was killed by priority preemption: the
+    # next death spares the restart budget (preemption is the cluster's
+    # fault, not the actor's)
+    preempted: bool = False
 
 
 @dataclass
@@ -338,12 +349,65 @@ class TaskRecord:
     error_type: Optional[str] = None
     error_pid: Optional[int] = None
     error_node: Optional[str] = None
+    # multi-tenant plane: when this attempt entered the ready queue (the
+    # preemption starvation clock — NOT reset by a failed-placement
+    # front re-queue), resources currently charged against the owning
+    # job's quota (None when not dispatched), and whether the running
+    # attempt was preempted (its requeue then spares the retry budget)
+    ready_since: float = 0.0
+    charged: Optional[Dict[str, float]] = None
+    preempted: bool = False
 
 
-# sentinel shard key for tasks whose placement is per-task, not per-shape
-# (node affinity, placement-group bundles): they keep the old bounded-scan
-# discipline inside one small shard
-_OTHER_SHARD_KEY = ("OTHER",)
+@dataclass
+class JobState:
+    """One tenant's arbitration record (parity role: GcsJobManager's job
+    table, grown into the arbitration layer the reference's job-submission
+    + autoscaler planes assume exists). Owned by the scheduler loop; the
+    memory monitor reads it off-loop (benign: counters and small dicts).
+
+    ``vtime`` is the job's normalized service (dispatches / weight): the
+    DWRR pass serves admitted jobs in ascending vtime, so under scarce
+    capacity every freed slot goes to the least-served job per weight.
+    ``quota`` caps live usage per resource (plus the pseudo-resource
+    ``object_store_bytes``); enforcement happens at dispatch, so an
+    over-quota job degrades to queueing — never the cluster."""
+
+    job_bin: bytes
+    seq: int = 0
+    name: str = ""
+    priority: int = 0
+    weight: float = 1.0
+    quota: Dict[str, float] = field(default_factory=dict)
+    admission: str = "ADMITTED"  # ADMITTED | QUEUED | REJECTED
+    # registered via submit_job (vs minted lazily for an anonymous
+    # driver): registered records persist for the ops surfaces; lazy ones
+    # are GC'd once idle so churning client sessions can't grow _jobs and
+    # the per-job metric label space without bound
+    registered: bool = False
+    submitted_at: float = field(default_factory=time.time)
+    last_active: float = field(default_factory=time.monotonic)
+    # ---- weighted-fair queueing ----
+    vtime: float = 0.0
+    dispatched: int = 0
+    # ---- live usage (quota enforcement + list_jobs/top) ----
+    usage: Dict[str, float] = field(default_factory=dict)
+    running: int = 0
+    object_bytes: int = 0
+    # ---- robustness counters ----
+    preemptions: int = 0
+    oom_kills: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def _job_hex_of(task_hex=None, actor_hex=None) -> Optional[str]:
+    """Job id embedded in a task/actor id hex (ids.py nesting: the trailing
+    4 bytes of an ActorID are its JobID; a TaskID ends in its ActorID)."""
+    if task_hex and len(task_hex) == 48:
+        return task_hex[40:]
+    if actor_hex and len(actor_hex) == 32:
+        return actor_hex[24:]
+    return None
 
 
 @dataclass
@@ -352,13 +416,16 @@ class _ReadyShard:
     class. For DEFAULT/SPREAD work the class is (strategy, task type, job,
     resource shape) and ``demand`` holds the common shape — one placement
     probe per tick answers for every entry, so an infeasible shape costs
-    zero scans regardless of depth. ``demand`` is None only for the OTHER
-    shard (per-task placement state)."""
+    zero scans regardless of depth. ``demand`` is None only for a job's
+    OTHER shard (per-task placement state: node affinity, PG bundles).
+    Every shard belongs to exactly one job (``job``): shards are the
+    per-job sub-queues the DWRR dispatch pass arbitrates between."""
 
     key: Tuple
     kind: str
     task_type: TaskType
     demand: Optional[Dict[str, float]]
+    job: bytes = b""
     queue: Deque[TaskID] = field(default_factory=collections.deque)
 
 
@@ -469,8 +536,23 @@ class Scheduler:
         # deferral pass per tick per queued task)
         self._ready_shards: Dict[Tuple, _ReadyShard] = {}
         self._ready_count = 0  # total queued entries across shards
-        self._ready_rr = 0  # shard rotation cursor (dispatch fairness)
         self._refill_rr = 0  # shard rotation cursor for targeted refills
+        # ---- multi-tenant job plane (see DESIGN_MAP "Multi-tenant job
+        # plane"): per-job arbitration records, the admission queue
+        # (priority-then-FIFO), and the preemption scan clock ----
+        self._jobs: Dict[bytes, JobState] = {}
+        self._job_seq = 0
+        # job ints minted for submissions; 1 is the default driver job
+        self._job_id_counter = 1
+        self._admission_queue: List[bytes] = []
+        self._last_admission_check = 0.0
+        self._last_preempt_scan = 0.0
+        self._last_job_gc = 0.0
+        self._preempt_count = 0
+        # victims SIGTERM'd but not yet dead (worker_id -> kill time):
+        # gates the scan so one starvation costs one victim, not one per
+        # scan period while the first drains
+        self._preempt_inflight: Dict[WorkerID, float] = {}
         # wall-clock timestamp shared by every event recorded within one
         # dispatch pass / completion batch (amortizes time.time() per frame)
         self._pass_now: Optional[float] = None
@@ -958,7 +1040,7 @@ class Scheduler:
             self._on_worker_death(wid, graceful=True)
         elif kind == "submit_put":
             if len(msg) > 2 and msg[2]:
-                self._object_sizes[msg[1]] = int(msg[2])
+                self._note_object_size(msg[1], int(msg[2]))
             self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
         elif kind == "put_object":
@@ -969,7 +1051,7 @@ class Scheduler:
             try:
                 self._node.store_client.put_bytes(oid, blob)
                 self._object_locations[oid].add(self._node.head_node_id)
-                self._object_sizes[oid] = len(blob)
+                self._note_object_size(oid, len(blob))
                 self._commit_result(oid, ("stored",))
             except Exception as e:  # noqa: BLE001
                 logger.exception("client put of %s failed", oid.hex()[:8])
@@ -1388,8 +1470,15 @@ class Scheduler:
             if cmd[2][0] == "stored":
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
                 if len(cmd) > 3 and cmd[3]:
-                    self._object_sizes[cmd[1]] = int(cmd[3])
+                    self._note_object_size(cmd[1], int(cmd[3]))
             self._commit_result(cmd[1], cmd[2])
+        elif kind == "protect":
+            # preemption shield window (mid-commit checkpoint save): victim
+            # selection skips this worker while the count is positive
+            if holder is not None:
+                w = self.workers.get(holder)
+                if w is not None:
+                    w.protect_count = max(0, w.protect_count + int(cmd[1]))
         elif kind == "add_node":
             self._dispatch_dirty = True
             node: NodeState = cmd[1]
@@ -1672,21 +1761,29 @@ class Scheduler:
     # ---- sharded ready queue ---------------------------------------------
 
     def _shard_key(self, spec: TaskSpec) -> Tuple:
+        """Shard key = (job, scheduling class): every shard belongs to one
+        job, so the shard map doubles as the per-job sub-queue index the
+        DWRR pass arbitrates between. Per-task placement work (node
+        affinity, PG bundles) keeps the bounded-scan discipline inside a
+        per-job OTHER shard."""
+        job = spec.task_id.job_id().binary()
         strat = spec.scheduling_strategy
         if strat.kind in ("DEFAULT", "SPREAD"):
             return (
+                job,
                 strat.kind,
                 spec.task_type.value,
-                spec.task_id.job_id().binary(),
                 tuple(sorted(spec.resources.items())),
             )
-        return _OTHER_SHARD_KEY
+        return (job, "OTHER")
 
     def _ready_push(self, rec: TaskRecord, front: bool = False) -> None:
         """Queue a PENDING task in its shard. ``front`` re-queues a popped
         head whose placement just failed — that must NOT re-dirty dispatch
         (the fleet didn't change; a blocked shard would otherwise force a
-        full pass every loop iteration)."""
+        full pass every loop iteration) and must NOT reset the starvation
+        clock (the preemption scan measures time since the attempt first
+        became ready, not since its last failed placement probe)."""
         spec = rec.spec
         key = self._shard_key(spec)
         shard = self._ready_shards.get(key)
@@ -1695,11 +1792,13 @@ class Scheduler:
                 key=key,
                 kind=spec.scheduling_strategy.kind,
                 task_type=spec.task_type,
-                demand=None if key == _OTHER_SHARD_KEY else dict(spec.resources),
+                demand=None if key[1] == "OTHER" else dict(spec.resources),
+                job=key[0],
             )
         if front:
             shard.queue.appendleft(spec.task_id)
         else:
+            rec.ready_since = time.monotonic()
             shard.queue.append(spec.task_id)
             self._dispatch_dirty = True
         self._ready_count += 1
@@ -1734,8 +1833,13 @@ class Scheduler:
         for shard in self._ready_shards.values():
             if not shard.queue:
                 continue
+            js = self._jobs.get(shard.job)
+            if js is not None and js.admission != "ADMITTED":
+                continue  # admission-parked sub-queue: not placeable
             if shard.demand is None:
                 return True  # per-task placement: assume placeable
+            if js is not None and self._quota_blocked(js, shard.demand):
+                continue  # quota-parked shape: not placeable either
             for n in self.nodes.values():
                 if n.alive and n.can_run(shard.demand):
                     return True
@@ -1757,7 +1861,550 @@ class Scheduler:
         else:
             h["buckets"][-1] += 1
 
+    # ---- multi-tenant job plane (arbitration records, quotas, DWRR,
+    # admission, preemption; see DESIGN_MAP "Multi-tenant job plane") -----
+
+    def _job_of(self, job_bin: bytes) -> JobState:
+        """The job's arbitration record, minted lazily: work can arrive for
+        a job the control plane never saw registered (the default driver
+        job, or a restarted head)."""
+        js = self._jobs.get(job_bin)
+        if js is None:
+            self._job_seq += 1
+            try:
+                jid_int = JobID(job_bin).int()
+            except ValueError:
+                jid_int = 0
+            js = self._jobs[job_bin] = JobState(
+                job_bin=job_bin,
+                seq=self._job_seq,
+                name="driver" if jid_int == 1 else f"job-{jid_int}",
+            )
+        return js
+
+    def _quota_blocked(self, js: JobState, demand: Dict[str, float]) -> bool:
+        """True when dispatching ``demand`` would push the job past its
+        quota (or its live object-store bytes already exceed the
+        ``object_store_bytes`` pseudo-resource cap). Enforcement lives at
+        dispatch: an over-quota job degrades to queueing, never fails."""
+        quota = js.quota
+        if not quota:
+            return False
+        cap = quota.get("object_store_bytes")
+        if cap is not None and js.object_bytes > cap:
+            return True
+        usage = js.usage
+        for k, v in demand.items():
+            cap = quota.get(k)
+            if cap is not None and usage.get(k, 0.0) + v > cap + 1e-9:
+                return True
+        return False
+
+    def _job_note_dispatch(
+        self, rec: TaskRecord, demand: Optional[Dict[str, float]], arbitrated: bool = True
+    ) -> None:
+        """One attempt of this task left the queue holding ``demand``
+        (None/{} = no resources held, e.g. actor method calls). Charges the
+        owning job's usage ledger and — for ready-queue (arbitrated) work —
+        its DWRR virtual time."""
+        js = self._job_of(rec.spec.task_id.job_id().binary())
+        rec.charged = dict(demand) if demand else {}
+        for k, v in rec.charged.items():
+            js.usage[k] = quantize(js.usage.get(k, 0.0) + v)
+        js.running += 1
+        js.dispatched += 1
+        js.last_active = time.monotonic()
+        if arbitrated:
+            js.vtime += 1.0 / max(js.weight, 1e-3)
+
+    def _job_upgrade_charge(self, rec: TaskRecord, demand: Dict[str, float]) -> None:
+        """A backlogged lease was promoted into real node capacity: start
+        charging its resources (dispatch was already counted)."""
+        if rec.charged is None or rec.charged:
+            return  # not live, or already holding its resources
+        js = self._jobs.get(rec.spec.task_id.job_id().binary())
+        if js is None:
+            return
+        rec.charged = dict(demand)
+        for k, v in demand.items():
+            js.usage[k] = quantize(js.usage.get(k, 0.0) + v)
+
+    @staticmethod
+    def _release_usage(js: JobState, charged: Dict[str, float]) -> None:
+        """Subtract a released charge from the job's usage ledger (the one
+        place the quantize-subtract/pop discipline lives — task settle and
+        actor-lifetime release must not diverge)."""
+        for k, v in charged.items():
+            left = quantize(js.usage.get(k, 0.0) - v)
+            if left <= 0:
+                js.usage.pop(k, None)
+            else:
+                js.usage[k] = left
+
+    def _job_settle(self, rec: TaskRecord) -> None:
+        """The live attempt finished / failed / was requeued: release its
+        quota charge and running count. Idempotent per dispatch cycle
+        (rec.charged is the one-shot guard) so overlapping settle paths
+        (fail + actor bookkeeping, death + requeue) can both call it."""
+        charged = rec.charged
+        if charged is None:
+            return
+        rec.charged = None
+        js = self._jobs.get(rec.spec.task_id.job_id().binary())
+        if js is None:
+            return
+        self._release_usage(js, charged)
+        js.running = max(0, js.running - 1)
+
+    def _worker_job(self, w: WorkerState) -> Optional[JobState]:
+        """The job a worker's live work belongs to (running task first,
+        else the actor it hosts)."""
+        if w.current_task is not None:
+            rec = self.tasks.get(w.current_task)
+            if rec is not None:
+                return self._jobs.get(rec.spec.task_id.job_id().binary())
+        if w.actor_id is not None:
+            return self._jobs.get(w.actor_id.binary()[-4:])
+        return None
+
+    def note_oom_kill(self, job_bin: Optional[bytes]) -> None:
+        """Memory-monitor callback (off-loop; int bump under the GIL)."""
+        if job_bin is None:
+            return
+        js = self._jobs.get(job_bin)
+        if js is not None:
+            js.oom_kills += 1
+
+    def _note_object_size(self, oid: ObjectID, size: int) -> None:
+        """Record an object's size and charge it to the owning job (the
+        oid embeds its creating task's job id) — the object_store_bytes
+        half of quota enforcement. Idempotent per oid: re-registration
+        adjusts by the delta."""
+        size = int(size)
+        old = self._object_sizes.get(oid)
+        self._object_sizes[oid] = size
+        js = self._job_of(oid.binary()[20:24])
+        js.object_bytes = max(0, js.object_bytes + size - (old or 0))
+        js.last_active = time.monotonic()
+
+    def _job_ready_counts(self) -> Dict[bytes, int]:
+        """Queued entries per job, straight off the shard index."""
+        out: Dict[bytes, int] = {}
+        for shard in self._ready_shards.values():
+            if shard.queue:
+                out[shard.job] = out.get(shard.job, 0) + len(shard.queue)
+        return out
+
+    def _admission_backlog(self) -> int:
+        """Cluster backlog for admission decisions: ready entries of
+        ADMITTED jobs + outstanding leases. Parked (QUEUED/REJECTED) jobs'
+        own pre-submitted work must not count — otherwise a queued job
+        that submitted tasks holds the backlog above the bound forever
+        and can never be admitted (live-lock)."""
+        parked = 0
+        for jb, n in self._job_ready_counts().items():
+            js = self._jobs.get(jb)
+            if js is not None and js.admission != "ADMITTED":
+                parked += n
+        return self._ready_count - parked + len(self._leased)
+
+    def _admission_order(self) -> List[bytes]:
+        """The admission queue in service order: priority desc, then FIFO."""
+        return sorted(
+            (jb for jb in self._admission_queue if jb in self._jobs),
+            key=lambda jb: (-self._jobs[jb].priority, self._jobs[jb].seq),
+        )
+
+    def _submit_job(
+        self,
+        name: str,
+        priority: int,
+        weight: float,
+        quota: Optional[Dict[str, float]],
+        meta: Optional[dict],
+    ) -> dict:
+        """Admission control (runs on the loop): mint a job id and decide
+        ADMITTED / QUEUED / REJECTED. QUEUED jobs keep their sub-queues
+        parked until the cluster backlog drains below the bound; REJECTED
+        jobs never dispatch anything."""
+        self._job_id_counter += 1
+        job_bin = JobID.from_int(self._job_id_counter).binary()
+        self._job_seq += 1
+        js = JobState(
+            job_bin=job_bin,
+            seq=self._job_seq,
+            name=name or f"job-{self._job_id_counter}",
+            priority=int(priority),
+            weight=max(float(weight), 1e-3),
+            quota={k: float(v) for k, v in (quota or {}).items()},
+            meta=dict(meta or {}),
+            registered=True,
+        )
+        self._jobs[job_bin] = js
+        bound = int(getattr(self.config, "job_admission_backlog_max", 0) or 0)
+        backlog = self._admission_backlog()
+        over = bound and (backlog > bound or self._admission_queue)
+        if over and len(self._admission_queue) >= int(
+            getattr(self.config, "job_admission_max_queued", 64)
+        ):
+            js.admission = "REJECTED"
+            self.record_cluster_event(
+                "JOB_REJECTED",
+                f"job {js.name} rejected: admission queue full "
+                f"({len(self._admission_queue)} jobs waiting, backlog {backlog})",
+                severity="WARNING",
+                job_id=job_bin.hex(),
+                name=js.name,
+                priority=js.priority,
+            )
+        elif over:
+            js.admission = "QUEUED"
+            self._admission_queue.append(job_bin)
+            self.record_cluster_event(
+                "JOB_QUEUED",
+                f"job {js.name} queued for admission (cluster backlog "
+                f"{backlog} > bound {bound})",
+                job_id=job_bin.hex(),
+                name=js.name,
+                priority=js.priority,
+                backlog=backlog,
+            )
+        else:
+            self._record_job_admitted(js)
+        order = self._admission_order()
+        return {
+            "job_id": self._job_id_counter,
+            "job": job_bin.hex(),
+            "admission": js.admission,
+            "queue_position": (
+                order.index(job_bin) + 1 if job_bin in order else None
+            ),
+        }
+
+    def _record_job_admitted(self, js: JobState) -> None:
+        js.admission = "ADMITTED"
+        # start fair-queueing from the pack, not from zero accumulated
+        # service: a freshly-admitted job must not monopolize dispatch to
+        # "catch up" on time it never contended for
+        live = [
+            j.vtime
+            for j in self._jobs.values()
+            if j.admission == "ADMITTED" and j is not js
+        ]
+        if live:
+            js.vtime = max(js.vtime, min(live))
+        self._dispatch_dirty = True
+        self.record_cluster_event(
+            "JOB_ADMITTED",
+            f"job {js.name} admitted (priority {js.priority}, "
+            f"weight {js.weight:g})",
+            job_id=js.job_bin.hex(),
+            name=js.name,
+            priority=js.priority,
+        )
+
+    def _maybe_admit_jobs(self) -> None:
+        """Admission-queue drain (rate-limited off the loop tick): admit
+        waiting jobs — priority first, FIFO within a priority — while the
+        cluster backlog sits below the bound."""
+        if not self._admission_queue:
+            return
+        now = time.monotonic()
+        if now - self._last_admission_check < 0.25:
+            return
+        self._last_admission_check = now
+        bound = int(getattr(self.config, "job_admission_backlog_max", 0) or 0)
+        while self._admission_queue:
+            backlog = self._admission_backlog()
+            if bound and backlog > bound:
+                return
+            order = self._admission_order()
+            if not order:
+                self._admission_queue = []
+                return
+            job_bin = order[0]
+            self._admission_queue.remove(job_bin)
+            self._record_job_admitted(self._jobs[job_bin])
+
+    def _maybe_gc_jobs(self) -> None:
+        """Drop lazily-minted (never-registered) job records that have
+        been idle past a grace period with nothing live — no running
+        attempts, usage, object bytes, or ready entries. Without this,
+        every short-lived anonymous client session (random 3-byte driver
+        job id) leaves a permanent JobState and a permanent label on each
+        per-job metric series. Registered jobs persist: their quota/
+        priority config and counters are the ops surface."""
+        now = time.monotonic()
+        if now - self._last_job_gc < 30.0:
+            return
+        self._last_job_gc = now
+        ready = None
+        for job_bin, js in list(self._jobs.items()):
+            if js.registered or js.running or js.usage or js.object_bytes:
+                continue
+            if now - js.last_active < 300.0:
+                continue
+            try:
+                if JobID(job_bin).int() == 1:
+                    continue  # the head's own default driver job
+            except ValueError:
+                pass
+            if ready is None:
+                ready = self._job_ready_counts()
+            if ready.get(job_bin):
+                continue
+            del self._jobs[job_bin]
+
+    def _find_starved_demand(
+        self, now: float, wait_s: float
+    ) -> Optional[Tuple[JobState, Dict[str, float]]]:
+        """The highest-priority ADMITTED job whose oldest ready task has
+        waited past ``wait_s`` for capacity the fleet COULD provide (shape
+        feasible on some node's totals) but currently doesn't — the
+        preemption trigger. Quota-blocked shards don't count (waiting on
+        your own cap is not starvation), nor do fleet-infeasible shapes
+        (killing victims can't mint a TPU)."""
+        best: Optional[Tuple[JobState, Dict[str, float]]] = None
+        best_rank = None
+        for shard in self._ready_shards.values():
+            if not shard.queue:
+                continue
+            js = self._jobs.get(shard.job)
+            if js is None or js.admission != "ADMITTED":
+                continue
+            # peek the oldest live entry without popping
+            rec = None
+            for tid in shard.queue:
+                cand = self.tasks.get(tid)
+                if cand is not None and cand.state == "PENDING":
+                    rec = cand
+                    break
+            if rec is None or not rec.ready_since:
+                continue
+            waited = now - rec.ready_since
+            if waited < wait_s:
+                continue
+            demand = shard.demand if shard.demand is not None else dict(
+                rec.spec.resources
+            )
+            if not demand:
+                continue
+            if self._quota_blocked(js, demand):
+                continue
+            if not any(
+                n.alive and n.feasible(demand) for n in self.nodes.values()
+            ):
+                continue
+            rank = (js.priority, waited)
+            if best_rank is None or rank > best_rank:
+                best_rank = rank
+                best = (js, dict(demand))
+        return best
+
+    def _victim_candidates(
+        self, below_priority: int
+    ) -> List[Tuple[Tuple, WorkerState, JobState]]:
+        """Workers holding resources for strictly-lower-priority jobs,
+        ranked worst-victim-first: lowest job priority, then highest held
+        usage, then most recently started (least sunk work). Shared by the
+        priority-preemption scan and the memory monitor's OOM policy so
+        victim selection can't diverge between the two kill paths. Workers
+        inside a protect window (mid-commit checkpoint save) are excluded
+        outright — never preempt a rank racing its shard to the barrier."""
+        out = []
+        for w in self.workers.values():
+            if w.state in ("dead", "starting"):
+                continue
+            if w.proc is None and not isinstance(w.conn, DaemonWorkerChannel):
+                continue
+            if w.protect_count > 0:
+                continue
+            js = self._worker_job(w)
+            if js is None or js.priority >= below_priority:
+                continue
+            held = sum((w.acquired or {}).values()) + sum(
+                (w.job_charged or {}).values()
+            )
+            if w.current_task is None and w.actor_id is None:
+                continue  # plain idle pool worker: nothing to free
+            started = 0.0
+            if w.current_task is not None:
+                rec = self.tasks.get(w.current_task)
+                if rec is not None and rec.start_time:
+                    started = rec.start_time
+            out.append(((js.priority, -held, -started), w, js))
+        out.sort(key=lambda e: e[0])
+        return out
+
+    def _maybe_preempt(self) -> None:
+        """Priority preemption (1 Hz): when a high-priority job's ready
+        task has starved past ``preemption_wait_s`` while lower-priority
+        jobs hold the capacity, kill ONE victim worker per scan — the
+        gentlest intervention that makes progress; the next scan fires
+        again if the starvation persists. Victims die over the normal
+        worker-death path, so their tasks re-queue (retry budget spared —
+        ``TaskRecord.preempted``), preempted actors restart without
+        spending ``max_restarts``, and preempted trainers resume from
+        their latest committed checkpoint via the elastic-training plane."""
+        cfg = self.config
+        if not getattr(cfg, "preemption_enabled", True):
+            return
+        wait_s = float(getattr(cfg, "preemption_wait_s", 3.0))
+        if wait_s <= 0 or len(self._jobs) < 2:
+            return
+        now = time.monotonic()
+        if now - self._last_preempt_scan < max(0.5, wait_s / 4):
+            return
+        self._last_preempt_scan = now
+        # one kill in flight at a time: a SIGTERM'd victim drains its
+        # checkpoint hooks before the pipe EOF frees its resources, and
+        # re-scanning during that window would kill a second victim for
+        # the same starvation
+        for wid in list(self._preempt_inflight):
+            w = self.workers.get(wid)
+            if w is None or w.state == "dead":
+                self._preempt_inflight.pop(wid, None)
+            elif now - self._preempt_inflight[wid] > 10.0:
+                # drain wedged past the worker's own SIGTERM backstop:
+                # stop waiting on it
+                self._preempt_inflight.pop(wid, None)
+        if self._preempt_inflight:
+            return
+        starved = self._find_starved_demand(now, wait_s)
+        if starved is None:
+            return
+        js, demand = starved
+        candidates = self._victim_candidates(js.priority)
+        if not candidates:
+            return
+        # prefer a victim whose node could then actually fit the starved
+        # shape (freed + available >= demand on flat resources); fall back
+        # to the global worst victim — freeing capacity still unblocks the
+        # lease/backlog paths even when no single node fits
+        victim = None
+        for _, w, vjob in candidates:
+            node = self.nodes.get(w.node_id)
+            if node is None:
+                continue
+            freed = dict(w.acquired or {})
+            for k, v in (w.job_charged or {}).items():
+                freed[k] = freed.get(k, 0.0) + v
+            if all(
+                node.available.get(k, 0.0) + freed.get(k, 0.0) >= v - 1e-9
+                for k, v in demand.items()
+            ):
+                victim = (w, vjob)
+                break
+        if victim is None:
+            victim = (candidates[0][1], candidates[0][2])
+        self._preempt_worker(victim[0], victim[1], js, wait_s)
+
+    def _preempt_worker(
+        self, w: WorkerState, vjob: JobState, for_job: JobState, waited_s: float
+    ) -> None:
+        """Kill one worker to free capacity for a starved higher-priority
+        job. SIGTERM (not exit-message) so the worker's drain hooks run —
+        a trainer rank flushes telemetry and its checkpoint hooks exactly
+        like an externally-preempted node — while the pipe EOF keeps the
+        death non-graceful (retries/restarts fire)."""
+        vjob.preemptions += 1
+        self._preempt_count += 1
+        self._preempt_inflight[w.worker_id] = time.monotonic()
+        rec = self.tasks.get(w.current_task) if w.current_task else None
+        if rec is not None and rec.state == "RUNNING":
+            rec.preempted = True
+        if w.actor_id is not None:
+            st = self.actors.get(w.actor_id)
+            if st is not None:
+                st.preempted = True
+        self.record_cluster_event(
+            "PREEMPTED",
+            f"preempted worker {w.worker_id.hex()[:12]} of job {vjob.name} "
+            f"(priority {vjob.priority}) for job {for_job.name} "
+            f"(priority {for_job.priority}, starved {waited_s:.1f}s)",
+            severity="WARNING",
+            worker_id=w.worker_id.hex(),
+            node_id=w.node_id.hex(),
+            pid=w.proc.pid if w.proc is not None else None,
+            task_id=w.current_task.hex() if w.current_task else None,
+            actor_id=w.actor_id.hex() if w.actor_id else None,
+            job_id=vjob.job_bin.hex(),
+            victim_priority=vjob.priority,
+            for_job_id=for_job.job_bin.hex(),
+            for_priority=for_job.priority,
+        )
+        self._terminate_worker(w)
+
+    def pick_oom_victim(self):
+        """Job-aware OOM victim for the memory monitor (off-loop read of
+        loop-owned dicts: candidate staleness is benign, the monitor
+        re-checks usage next period). Order: lowest job priority first,
+        then highest held usage — the same ranking as priority preemption
+        — with retriable-before-non-retriable and last-started-first as
+        tiebreaks inherited from the classic policy. Returns
+        ``(worker, job_bin, priority)`` or None."""
+        ranked = []
+        for w in list(self.workers.values()):
+            if w.current_task is None or w.state == "dead":
+                continue
+            rec = self.tasks.get(w.current_task)
+            if rec is None or rec.state != "RUNNING" or w.proc is None:
+                continue
+            if w.protect_count > 0:
+                continue
+            js = self._worker_job(w)
+            prio = js.priority if js is not None else 0
+            # held = acquired + actor-lifetime charges: the same usage
+            # definition _victim_candidates ranks by, so the two kill
+            # paths agree on who the heavyweight is
+            held = sum((w.acquired or {}).values()) + sum(
+                (w.job_charged or {}).values()
+            )
+            retriable = rec.retries_left > 0
+            ranked.append(
+                (
+                    (prio, not retriable, -held, -(rec.start_time or 0)),
+                    w,
+                    js.job_bin if js is not None else None,
+                    prio,
+                )
+            )
+        if not ranked:
+            return None
+        ranked.sort(key=lambda e: e[0])
+        _, w, job_bin, prio = ranked[0]
+        return w, job_bin, prio
+
+    def _job_row(self, js: JobState, ready: int, order: List[bytes]) -> dict:
+        try:
+            jid_int = JobID(js.job_bin).int()
+        except ValueError:
+            jid_int = 0
+        return {
+            "job_id": jid_int,
+            "job": js.job_bin.hex(),
+            "name": js.name,
+            "priority": js.priority,
+            "weight": js.weight,
+            "quota": dict(js.quota),
+            "usage": {k: v for k, v in js.usage.items() if v},
+            "object_store_bytes": js.object_bytes,
+            "running": js.running,
+            "ready": ready,
+            "dispatched_total": js.dispatched,
+            "admission": js.admission,
+            "queue_position": (
+                order.index(js.job_bin) + 1 if js.job_bin in order else None
+            ),
+            "preemptions": js.preemptions,
+            "oom_kills": js.oom_kills,
+            "vtime": round(js.vtime, 4),
+            "submitted_at": js.submitted_at,
+            "meta": dict(js.meta),
+        }
+
     def _make_schedulable(self, rec: TaskRecord):
+        self._job_settle(rec)
         rec.state = "PENDING"
         # deps resolved, entering the dispatch queue: the QUEUED->DISPATCHED
         # gap in the timeline is pure scheduler queueing delay
@@ -1822,6 +2469,22 @@ class Scheduler:
             self._maybe_detect_stragglers()
         except Exception:
             logger.exception("straggler scan failed")
+        # multi-tenant job plane: drain the admission queue while backlog
+        # allows, then scan for starved high-priority work to preempt for
+        # (both rate-limit themselves; see DESIGN_MAP "Multi-tenant job
+        # plane")
+        try:
+            self._maybe_admit_jobs()
+        except Exception:
+            logger.exception("admission drain failed")
+        try:
+            self._maybe_preempt()
+        except Exception:
+            logger.exception("preemption scan failed")
+        try:
+            self._maybe_gc_jobs()
+        except Exception:
+            logger.exception("job-record gc failed")
         if self._daemon_conns and now0 - self._last_budget_sync > 0.5:
             self._last_budget_sync = now0
             self._sync_lease_budgets()
@@ -1919,45 +2582,116 @@ class Scheduler:
         self._observe_tick(time.perf_counter() - t0)
 
     def _dispatch_pass(self, periodic: bool) -> None:
-        """One placement sweep over the sharded ready queue.
+        """One placement sweep over the per-job sharded ready queue.
 
-        Shape shards (DEFAULT/SPREAD) stop at their FIRST placement failure:
-        same demand + same fleet means every deeper entry fails identically,
-        and a shape with no feasible node is skipped without popping a single
-        entry. The OTHER shard (node affinity, placement groups) keeps
-        per-task placement and is scanned with the old bounded fail cap +
-        rotation, now scoped to the small shard that actually needs it.
-        Shards are visited in rotating order so one deep shape cannot starve
-        the rest of a tick's capacity."""
+        Jobs are served by weighted-fair queueing: ascending virtual time
+        (``vtime`` = dispatches / weight), a ``fair_share_quantum x
+        weight`` dispatch budget per visit. Serving the least-served job
+        first (rather than rotating) keeps weights honored even when
+        capacity frees one slot per pass — the common steady state — so
+        one noisy tenant can saturate at most its share, never the tick.
+
+        Within a job the shard discipline is unchanged: shape shards
+        (DEFAULT/SPREAD) stop at their FIRST placement failure (same
+        demand + same fleet means every deeper entry fails identically,
+        and an infeasible shape costs zero probes); the job's OTHER shard
+        (node affinity, PG bundles) keeps per-task placement under the
+        bounded fail cap + rotation. Quota-blocked shapes and
+        admission-QUEUED jobs are skipped without popping an entry."""
         self._pick_cache = {}
         self._pass_now = time.time()
         try:
-            keys = list(self._ready_shards.keys())
-            if not keys:
-                return
-            n = len(keys)
-            start = self._ready_rr % n
-            self._ready_rr += 1
-            for i in range(n):
-                key = keys[(start + i) % n]
-                shard = self._ready_shards.get(key)
-                if shard is None:
-                    continue
+            by_job: Dict[bytes, List[_ReadyShard]] = {}
+            for key in list(self._ready_shards.keys()):
+                shard = self._ready_shards[key]
                 if not shard.queue:
                     # empty shards are GC'd here (not on pop) so one-shot
                     # shapes don't accumulate dict entries forever
                     del self._ready_shards[key]
                     continue
-                if shard.demand is None:
-                    self._drain_other_shard(shard, periodic)
-                else:
-                    self._drain_shape_shard(shard)
+                by_job.setdefault(shard.job, []).append(shard)
+            if not by_job:
+                return
+            jobs: List[Tuple[JobState, List[_ReadyShard]]] = []
+            for job_bin, shards in by_job.items():
+                js = self._job_of(job_bin)
+                if js.admission != "ADMITTED":
+                    continue  # parked at admission control
+                jobs.append((js, shards))
+            if not jobs:
+                # every live shard belongs to a parked (QUEUED/REJECTED)
+                # job: nothing to arbitrate this pass
+                return
+            if len(jobs) == 1:
+                # single-tenant fast path: no arbitration to do — drain
+                # with an unbounded budget exactly like the pre-DWRR core
+                js, shards = jobs[0]
+                self._drain_job_shards(js, shards, periodic, None)
+                return
+            quantum = max(
+                1.0, float(getattr(self.config, "fair_share_quantum", 8.0))
+            )
+            # a job re-entering contention with a stale (low) vtime may
+            # catch up by at most two quanta of lag — it was underserved,
+            # but an unbounded burst would starve everyone else for as
+            # long as it had been idle
+            floor = max(js.vtime for js, _ in jobs) - 2.0 * quantum
+            for js, _ in jobs:
+                if js.vtime < floor:
+                    js.vtime = floor
+            active = jobs
+            while active:
+                # strict priority first (a freed slot must reach the
+                # high-priority job preemption freed it FOR, not race back
+                # to the victim), then ascending vtime (service/weight)
+                # within a priority level: every slot goes to the
+                # least-served equal-priority job per its weight — this,
+                # not per-pass rotation, is what keeps weights honored
+                # when capacity frees one slot at a time
+                active.sort(
+                    key=lambda e: (-e[0].priority, e[0].vtime, e[0].seq)
+                )
+                js, shards = active[0]
+                budget = max(1, int(round(quantum * js.weight)))
+                got = self._drain_job_shards(js, shards, periodic, budget)
+                if got < budget or not any(s.queue for s in shards):
+                    # blocked on placement/quota, or drained: out of this
+                    # pass (a full quantum with work left re-sorts and may
+                    # win again — its vtime advanced by got/weight)
+                    active.pop(0)
         finally:
             self._pick_cache = None
             self._pass_now = None
-        self._flush_lease_batches()
+            # in the finally: BOTH the single-tenant fast path and the
+            # DWRR loop return/raise through here, and a pass that batched
+            # lease grants but never flushed them would wedge every daemon
+            self._flush_lease_batches()
 
-    def _drain_shape_shard(self, shard: _ReadyShard) -> None:
+    def _drain_job_shards(
+        self,
+        js: JobState,
+        shards: List[_ReadyShard],
+        periodic: bool,
+        budget: Optional[int],
+    ) -> int:
+        """Dispatch up to ``budget`` tasks (None = unbounded) from one
+        job's shards; returns the dispatched count."""
+        dispatched = 0
+        for shard in shards:
+            left = None if budget is None else budget - dispatched
+            if left is not None and left <= 0:
+                break
+            if not shard.queue:
+                continue
+            if shard.demand is None:
+                dispatched += self._drain_other_shard(shard, periodic, js, left)
+            else:
+                dispatched += self._drain_shape_shard(shard, js, left)
+        return dispatched
+
+    def _drain_shape_shard(
+        self, shard: _ReadyShard, js: JobState, budget: Optional[int]
+    ) -> int:
         demand = shard.demand
         cache = self._pick_cache
         feas_key = ("__feas__",) + tuple(sorted(demand.items()))
@@ -1971,11 +2705,17 @@ class Scheduler:
         if not feasible:
             # no node of this shape exists at ALL: zero placement probes;
             # the shard waits for the fleet to change (autoscaler input)
-            return
-        while shard.queue:
+            return 0
+        dispatched = 0
+        while shard.queue and (budget is None or dispatched < budget):
+            if self._quota_blocked(js, demand):
+                # same demand for the whole shard: once the job's quota is
+                # saturated every deeper entry is blocked identically —
+                # the shard parks until a completion releases usage
+                return dispatched
             rec = self._ready_pop_valid(shard)
             if rec is None:
-                return
+                return dispatched
             placed = False
             try:
                 placed = self._try_dispatch(rec)
@@ -1985,25 +2725,43 @@ class Scheduler:
                     self._ready_push(rec, front=True)
             if not placed:
                 # same demand, same fleet: every deeper entry fails too
-                return
+                return dispatched
+            dispatched += 1
+        return dispatched
 
-    def _drain_other_shard(self, shard: _ReadyShard, periodic: bool) -> None:
+    def _drain_other_shard(
+        self,
+        shard: _ReadyShard,
+        periodic: bool,
+        js: JobState,
+        budget: Optional[int],
+    ) -> int:
         """Per-task placement work (node affinity, PG bundles): bounded scan
-        with rotation — the flat-queue discipline, confined to this shard."""
+        with rotation — the flat-queue discipline, confined to this shard.
+        Quota-blocked entries count as placement failures (deferred, not
+        popped for good), so a quota-saturated job spins the fail cap, not
+        the whole queue."""
         q = shard.queue
         fail_cap = 256 if periodic else 32
         fails = 0
         scanned = 0
+        dispatched = 0
         max_scan = len(q)
         deferred: List[TaskID] = []
-        while q and scanned < max_scan and fails < fail_cap:
+        while (
+            q
+            and scanned < max_scan
+            and fails < fail_cap
+            and (budget is None or dispatched < budget)
+        ):
             scanned += 1
             rec = self._ready_pop_valid(shard)
             if rec is None:
                 break
             placed = False
             try:
-                placed = self._try_dispatch(rec)
+                if not self._quota_blocked(js, rec.spec.resources):
+                    placed = self._try_dispatch(rec)
             finally:
                 if not placed:
                     deferred.append(rec.spec.task_id)
@@ -2011,6 +2769,7 @@ class Scheduler:
                 fails += 1
             else:
                 fails = 0
+                dispatched += 1
         if deferred:
             q.extendleft(reversed(deferred))
             self._ready_count += len(deferred)
@@ -2019,6 +2778,7 @@ class Scheduler:
             # node-affinity target frees later is found within
             # O(len/fail_cap) periods instead of never
             q.rotate(-fail_cap)
+        return dispatched
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
         """Hybrid policy (``hybrid_scheduling_policy.cc:99``)."""
@@ -2275,6 +3035,7 @@ class Scheduler:
         rec.worker_id = wid
         rec.start_time = time.monotonic()
         rec.attempt += 1
+        self._job_note_dispatch(rec, rec.spec.resources)
         self._running_watch.add(rec.spec.task_id)
         w.current_task = rec.spec.task_id
         if rec.spec.task_type == TaskType.ACTOR_CREATION:
@@ -2329,6 +3090,7 @@ class Scheduler:
         rec.state = "LEASED"
         rec.worker_id = None
         rec.attempt += 1
+        self._job_note_dispatch(rec, spec.resources if acquired else None)
         self._leased[spec.task_id] = (node.node_id, acquired, dict(spec.resources))
         self._lease_count_by_node[node.node_id] += 1
         self._lease_batch.setdefault(node.node_id, []).append(spec)
@@ -2511,6 +3273,7 @@ class Scheduler:
             for k, v in info[2].items():
                 node.lease_acquired[k] = node.lease_acquired.get(k, 0.0) + v
             self._leased[tid] = (nid, True, info[2])
+            self._job_upgrade_charge(rec, info[2])
         while skipped:
             q.appendleft(skipped.pop())
 
@@ -2642,6 +3405,7 @@ class Scheduler:
                     continue
                 rec.state = "FINISHED"
                 rec.end_time = now_m
+                self._job_settle(rec)
                 self._record_event(spec, "FINISHED", ts=self._pass_now)
                 if results and results[0][0] == "error":
                     self._note_task_error(
@@ -2854,6 +3618,10 @@ class Scheduler:
                 rec.worker_id = actor.worker_id
                 rec.start_time = time.monotonic()
                 rec.attempt += 1
+                # method calls hold no extra resources (the actor's
+                # lifetime charge covers them) and bypass the ready-queue
+                # arbitration: count running, skip vtime
+                self._job_note_dispatch(rec, None, arbitrated=False)
                 self._running_watch.add(rec.spec.task_id)
                 self._record_event(rec.spec, "DISPATCHED")
                 self._record_event(rec.spec, "RUNNING")
@@ -2901,6 +3669,7 @@ class Scheduler:
         if rec is not None:
             rec.state = "FINISHED"
             rec.end_time = time.monotonic()
+            self._job_settle(rec)
             self._record_event(rec.spec, "FINISHED")
             if results and results[0][0] == "error":
                 self._note_task_error(rec, results[0], w)
@@ -2986,6 +3755,16 @@ class Scheduler:
     def _downgrade_to_lifetime(self, w: WorkerState, spec: TaskSpec):
         self._dispatch_dirty = True
         lifetime = spec.lifetime_resources or {}
+        # the creation charge was settled when __init__ FINISHED; the
+        # actor's lifetime resources are re-charged against the owning
+        # job's quota ledger for as long as the worker lives (released in
+        # _on_worker_death — WorkerState.job_charged is the receipt)
+        if lifetime:
+            js = self._jobs.get(spec.task_id.job_id().binary())
+            if js is not None:
+                w.job_charged = dict(lifetime)
+                for k, v in lifetime.items():
+                    js.usage[k] = quantize(js.usage.get(k, 0.0) + v)
         if w.pg_reservation is not None:
             pg_id, i = w.pg_reservation
             pg = self.placement_groups.get(pg_id)
@@ -3083,6 +3862,7 @@ class Scheduler:
     def _fail_task(self, rec: TaskRecord, error: Exception):
         rec.state = "FAILED"
         rec.end_time = time.monotonic()
+        self._job_settle(rec)
         self._record_event(rec.spec, "FAILED")
         rec.error_type = type(error).__name__
         if rec.error_node is None and rec.worker_id is not None:
@@ -3195,12 +3975,24 @@ class Scheduler:
                 # provenance: where the attempt died, whatever happens next
                 rec.error_node = w.node_id.hex()
                 rec.error_pid = dead_pid
-                if not graceful and rec.retries_left > 0 and rec.spec.task_type == TaskType.NORMAL_TASK:
-                    rec.retries_left -= 1
+                preempted = rec.preempted
+                if (
+                    not graceful
+                    and (preempted or rec.retries_left > 0)
+                    and rec.spec.task_type == TaskType.NORMAL_TASK
+                ):
+                    # preemption spares the retry budget: the kill is the
+                    # cluster's arbitration decision, not the task's fault
+                    rec.preempted = False
+                    if not preempted:
+                        rec.retries_left -= 1
+                    self._job_settle(rec)
                     rec.state = "PENDING"
                     rec.worker_id = None
                     self._ready_push(rec)
-                    self._record_task_retry(rec, "worker died")
+                    self._record_task_retry(
+                        rec, "preempted" if preempted else "worker died"
+                    )
                 elif not graceful:
                     self._fail_task(
                         rec,
@@ -3208,11 +4000,30 @@ class Scheduler:
                             f"worker died executing {rec.spec.name or rec.spec.task_id.hex()}"
                         ),
                     )
+        # actor lifetime resources charged to the owning job die with the
+        # worker (the creation charge was transferred here when __init__
+        # finished)
+        if w.job_charged:
+            charged, w.job_charged = w.job_charged, None
+            js = self._jobs.get(
+                w.actor_id.binary()[-4:] if w.actor_id is not None else b""
+            )
+            if js is not None:
+                self._release_usage(js, charged)
         # actor death & restart (parity: GcsActorManager max_restarts,
         # gcs_actor_manager.h:278)
         if w.actor_id is not None:
             actor = self.actors.get(w.actor_id)
             if actor is not None and actor.state != "DEAD":
+                # a preemption kill is the cluster's arbitration decision:
+                # restart and re-queue without spending the actor's
+                # max_restarts or its calls' retry budgets. Eligibility is
+                # NOT widened — a max_restarts=0 actor stays dead (its
+                # owner chose at-most-once; the elastic-training executor
+                # replaces its own ranks), preemption just doesn't bill
+                # the budget of actors that do restart.
+                spared = actor.preempted
+                actor.preempted = False
                 will_restart = not graceful and actor.restarts_left != 0
                 # in-flight calls: requeue onto the restarted actor when a
                 # max_task_retries budget remains, else fail
@@ -3222,9 +4033,12 @@ class Scheduler:
                         and rec.spec.actor_id == w.actor_id
                         and rec.state == "RUNNING"
                     ):
-                        if will_restart and rec.retries_left != 0:
-                            if rec.retries_left > 0:
+                        call_spared = rec.preempted
+                        rec.preempted = False
+                        if will_restart and (call_spared or rec.retries_left != 0):
+                            if rec.retries_left > 0 and not call_spared:
                                 rec.retries_left -= 1
+                            self._job_settle(rec)
                             rec.state = "PENDING"
                             rec.worker_id = None
                             actor.pending_calls.append(rec.spec)
@@ -3236,8 +4050,8 @@ class Scheduler:
                     actor.state = "DEAD"
                     actor.death_cause = "actor exited"
                     self._drain_actor_queue(actor)
-                elif actor.restarts_left != 0:
-                    if actor.restarts_left > 0:
+                elif will_restart:
+                    if actor.restarts_left > 0 and not spared:
                         actor.restarts_left -= 1
                     actor.state = "RESTARTING"
                     actor.worker_id = None
@@ -3854,9 +4668,69 @@ class Scheduler:
         if op == "list_cluster_events":
             rows = list(self._cluster_events)
             limit = args[0] if args and isinstance(args[0], int) else None
+            job_hex = args[1] if len(args) > 1 else None
+            if job_hex:
+                # job attribution filter: explicit job_id field, or the
+                # job nested in the event's task/actor id (ids.py layout)
+                def _ev_job(ev: dict) -> Optional[str]:
+                    j = ev.get("job_id")
+                    if j:
+                        return j
+                    return _job_hex_of(
+                        task_hex=ev.get("task_id"),
+                        actor_hex=ev.get("actor_id"),
+                    )
+
+                rows = [ev for ev in rows if _ev_job(ev) == job_hex]
             # newest events are the forensically interesting ones: truncate
             # from the front, keep chronological order
             return rows[-limit:] if limit is not None else rows
+        if op == "submit_job":
+            name, priority, weight, quota, meta = args
+            return self._submit_job(name, priority, weight, quota, meta)
+        if op == "job_info":
+            raw = args[0]
+            job_bin = raw if isinstance(raw, bytes) else bytes.fromhex(raw)
+            js = self._jobs.get(job_bin)
+            if js is None:
+                return None
+            return self._job_row(
+                js,
+                self._job_ready_counts().get(job_bin, 0),
+                self._admission_order(),
+            )
+        if op == "list_jobs":
+            ready = self._job_ready_counts()
+            order = self._admission_order()
+            rows = [
+                self._job_row(js, ready.get(js.job_bin, 0), order)
+                for js in sorted(self._jobs.values(), key=lambda j: j.seq)
+            ]
+            return self._apply_limit(rows, args)
+        if op == "update_job":
+            # live arbitration-knob update (ops surface: throttle a noisy
+            # tenant's quota / demote its priority / retune its weight
+            # WITHOUT killing it; enforcement applies from the next
+            # dispatch pass)
+            raw, changes = args
+            job_bin = raw if isinstance(raw, bytes) else bytes.fromhex(raw)
+            js = self._jobs.get(job_bin)
+            if js is None:
+                return None
+            if "priority" in changes:
+                js.priority = int(changes["priority"])
+            if "weight" in changes:
+                js.weight = max(float(changes["weight"]), 1e-3)
+            if "quota" in changes:
+                js.quota = {
+                    k: float(v) for k, v in (changes["quota"] or {}).items()
+                }
+            self._dispatch_dirty = True
+            return self._job_row(
+                js,
+                self._job_ready_counts().get(job_bin, 0),
+                self._admission_order(),
+            )
         if op == "hung_get_digest":
             return self.hung_get_digest(list(args[0]))
         raise ValueError(f"unknown rpc {op}")
@@ -4003,7 +4877,12 @@ class Scheduler:
     def _free_object(self, oid: ObjectID):
         self._cross_channel.discard(oid)
         self._ref_channel.pop(oid, None)
-        self._object_sizes.pop(oid, None)
+        freed = self._object_sizes.pop(oid, None)
+        if freed:
+            # uncharge the owning job's object-store-bytes ledger
+            js = self._jobs.get(oid.binary()[20:24])
+            if js is not None:
+                js.object_bytes = max(0, js.object_bytes - freed)
         self._xfer_waiting.pop(oid, None)
         if self._shm_xfer_failed:
             self._shm_xfer_failed = {
@@ -4725,6 +5604,45 @@ class Scheduler:
             "gauge",
             "worker processes by state",
             {lk(state=s): n for s, n in sorted(by_wstate.items())},
+        )
+        # multi-tenant job plane: per-job arbitration series
+        jobs_sorted = sorted(self._jobs.values(), key=lambda j: j.seq)
+        ready_by_job = self._job_ready_counts()
+        add(
+            "ray_tpu_job_ready_tasks",
+            "gauge",
+            "tasks waiting in each job's ready sub-queues",
+            {
+                lk(job=js.name): ready_by_job.get(js.job_bin, 0)
+                for js in jobs_sorted
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_job_running_tasks",
+            "gauge",
+            "live dispatched attempts per job",
+            {lk(job=js.name): js.running for js in jobs_sorted} or {lk(): 0},
+        )
+        add(
+            "ray_tpu_preemptions_total",
+            "counter",
+            "workers killed by priority preemption, labeled by victim job",
+            {lk(job=js.name): js.preemptions for js in jobs_sorted}
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_oom_kills_total",
+            "counter",
+            "memory-monitor kills labeled by the victim's job",
+            {lk(job=js.name): js.oom_kills for js in jobs_sorted}
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_jobs_admission_queued",
+            "gauge",
+            "jobs parked in the admission queue",
+            {lk(): len(self._admission_queue)},
         )
         calls = {}
         secs = {}

@@ -226,7 +226,17 @@ class DriverRuntime:
         self.store = node.store_client
         self.config = node.config
         self.serde = serialization.get_context()
+        # multi-tenant job plane: a driver launched on behalf of a
+        # submitted job (JobSupervisor entrypoints) binds its work to that
+        # job's arbitration record via the environment; the interactive
+        # default stays job 1
         self.job_id = JobID.from_int(1)
+        env_job = os.environ.get("RAY_TPU_JOB_ID")
+        if env_job:
+            try:
+                self.job_id = JobID.from_hex(env_job)
+            except ValueError:
+                pass
         self.task_id = TaskID.for_driver(self.job_id)
         self._put_counter = _Counter()
         self.closed = False
@@ -561,6 +571,46 @@ class DriverRuntime:
 
     def new_task_id(self) -> TaskID:
         return TaskID.for_task(self.task_id.actor_id())
+
+    def job_scope(
+        self,
+        *,
+        name: str = "",
+        priority: int = 0,
+        weight: float = 1.0,
+        quota: Optional[Dict[str, float]] = None,
+        meta: Optional[dict] = None,
+    ):
+        """Submit work as a distinct tenant: registers a job with the
+        scheduler's arbitration plane (admission control applies) and,
+        within the ``with`` block, binds every task / actor / put this
+        driver creates to that job — its DWRR weight, quota, and priority
+        govern dispatch. Raises ``JobAdmissionError`` when the submission
+        is rejected outright; a QUEUED job's work parks in its sub-queues
+        until admission."""
+        import contextlib
+
+        info = self.scheduler_rpc(
+            "submit_job",
+            (name, int(priority), float(weight), quota, meta),
+        )
+        if info["admission"] == "REJECTED":
+            raise exc.JobAdmissionError(
+                f"job {name or info['job']} rejected by admission control"
+            )
+        job = JobID.from_hex(info["job"])
+
+        @contextlib.contextmanager
+        def _scope():
+            prev_job, prev_task = self.job_id, self.task_id
+            self.job_id = job
+            self.task_id = TaskID.for_driver(job)
+            try:
+                yield info
+            finally:
+                self.job_id, self.task_id = prev_job, prev_task
+
+        return _scope()
 
     def shutdown(self):
         self.closed = True

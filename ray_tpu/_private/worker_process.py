@@ -190,6 +190,16 @@ class WorkerRuntime:
     def handle_count_external(self, actor_id, delta: int):
         self._send(("cmd", ("handle_count", actor_id, delta)))
 
+    def protect_from_preemption(self, delta: int) -> None:
+        """Shield this worker from preemption/OOM victim selection while
+        the count is positive (mid-commit checkpoint saves). Fire-and-
+        forget: the window is advisory — a lost message degrades to the
+        pre-shield behavior, never to a hang."""
+        try:
+            self._send(("cmd", ("protect", int(delta))))
+        except (OSError, EOFError):
+            pass
+
     def legacy_submit(self, spec: TaskSpec):
         arg_refs = spec.arg_ref_ids()
         if arg_refs:

@@ -72,14 +72,25 @@ def start_dashboard(port: int = 8765) -> int:
                     body = state.list_logs()
                 elif urlparse(self.path).path == "/api/events":
                     # structured cluster events (failure forensics plane):
-                    # WORKER_DIED, TASK_FAILED, STRAGGLER, OOM, ...
+                    # WORKER_DIED, TASK_FAILED, STRAGGLER, OOM,
+                    # PREEMPTED, JOB_QUEUED/ADMITTED/REJECTED, ...
                     q = parse_qs(urlparse(self.path).query)
                     limit = int(q.get("limit", ["500"])[0])
-                    body = state.list_cluster_events(limit=limit)
-                elif self.path == "/api/jobs":
+                    job_id = q.get("job_id", [None])[0]
+                    body = state.list_cluster_events(
+                        limit=limit, job_id=job_id
+                    )
+                elif urlparse(self.path).path == "/api/jobs":
+                    # multi-tenant job plane: every arbitration row
+                    # (priority / quota / usage / admission / queue
+                    # position), plus submission records for jobs that
+                    # came in through the JobSubmissionClient
                     from ray_tpu.job_submission import JobSubmissionClient
 
-                    body = JobSubmissionClient().list_jobs()
+                    body = {
+                        "jobs": state.list_jobs(),
+                        "submissions": JobSubmissionClient().list_jobs(),
+                    }
                 elif self.path == "/api/event_stats":
                     from ray_tpu._private.worker import get_driver
 

@@ -126,7 +126,26 @@ const RENDER = {
     const s = await j("/api/serve");
     return "<pre>" + esc(JSON.stringify(s, null, 2)) + "</pre>";
   },
-  async jobs() { return table(await j("/api/jobs")); },
+  async jobs() {
+    // multi-tenant job plane: arbitration rows (priority / quota / live
+    // usage / admission + queue position) over every job the scheduler
+    // has seen, then the JobSubmissionClient's submission records
+    const s = await j("/api/jobs");
+    const jobs = (s.jobs || []).map(r => ({
+      name: r.name, status: r.admission,
+      "q#": r.queue_position || "",
+      prio: r.priority, weight: r.weight,
+      running: r.running, ready: r.ready,
+      usage: r.usage, quota: r.quota,
+      "obj MB": ((r.object_store_bytes||0)/1e6).toFixed(1),
+      preempt: r.preemptions, oom: r.oom_kills,
+    }));
+    const subs = s.submissions || [];
+    return `<h2>arbitration (${jobs.length})</h2>` +
+      table(jobs, ["name","status","q#","prio","weight","running","ready",
+                   "usage","quota","obj MB","preempt","oom"]) +
+      `<h2>submissions (${subs.length})</h2>` + table(subs);
+  },
   async logs() { return table(await j("/api/logs")); },
   async events() {
     // cluster event log (failure forensics): newest first, severity colored

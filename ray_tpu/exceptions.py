@@ -149,3 +149,13 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class CrossSliceTransferError(RayTpuError):
     """A device-to-device transfer across TPU slices failed (DCN path)."""
+
+
+class JobAdmissionError(RayTpuError):
+    """Admission control rejected the job submission (quota exceeded or
+    admission queue full). The cluster never saw the job's tasks."""
+
+
+class PreemptedError(RayTpuError):
+    """The task's worker was killed by priority preemption; the attempt
+    re-queued without spending the retry budget."""

@@ -31,40 +31,93 @@ class JobStatus(str, enum.Enum):
 
 @ray_tpu.remote(max_concurrency=4)
 class JobSupervisor:
-    """Runs one entrypoint subprocess; parity: job_supervisor.py:51."""
+    """Runs one entrypoint subprocess; parity: job_supervisor.py:51.
 
-    def __init__(self, job_id: str, entrypoint: str, log_path: str, env: Optional[dict]):
-        import subprocess
+    Multi-tenant plane: when the submission registered an arbitration
+    job (``arb_job`` hex), the supervisor holds the entrypoint until the
+    scheduler ADMITS it — a QUEUED job's process never starts burning
+    resources — and exports ``RAY_TPU_JOB_ID`` so the entrypoint's driver
+    binds its tasks/puts to the job's quota, weight, and priority."""
+
+    def __init__(
+        self,
+        job_id: str,
+        entrypoint: str,
+        log_path: str,
+        env: Optional[dict],
+        arb_job: Optional[str] = None,
+    ):
         import threading
 
         self.job_id = job_id
         self.entrypoint = entrypoint
         self.log_path = log_path
         self.returncode: Optional[int] = None
+        self.proc = None
+        self._arb_job = arb_job
+        self._stopped = False
+        self._lock = threading.Lock()
         full_env = dict(os.environ)
         full_env.update(env or {})
+        if arb_job:
+            full_env["RAY_TPU_JOB_ID"] = arb_job
         self._logf = open(log_path, "wb")
-        self.proc = subprocess.Popen(
-            entrypoint,
-            shell=True,
-            stdout=self._logf,
-            stderr=subprocess.STDOUT,
-            env=full_env,
+        self._waiter = threading.Thread(
+            target=self._run, args=(full_env,), daemon=True
         )
-        self._waiter = threading.Thread(target=self._wait, daemon=True)
         self._waiter.start()
 
-    def _wait(self):
+    def _admission(self) -> str:
+        try:
+            rt = ray_tpu.get_runtime()
+            row = rt.rpc("job_info", self._arb_job)
+            return (row or {}).get("admission", "ADMITTED")
+        except Exception:
+            return "ADMITTED"
+
+    def _run(self, full_env):
+        import subprocess
+
+        while self._arb_job and not self._stopped:
+            adm = self._admission()
+            if adm == "ADMITTED":
+                break
+            if adm == "REJECTED":
+                self._logf.write(b"job rejected by admission control\n")
+                self._logf.flush()
+                self.returncode = 126
+                return
+            time.sleep(0.25)
+        # stopped-check and launch are one atomic step: a stop() landing
+        # between them would otherwise return with proc still None and the
+        # entrypoint would launch unsupervised right after
+        with self._lock:
+            if self._stopped:
+                self.returncode = 143
+                return
+            self.proc = subprocess.Popen(
+                self.entrypoint,
+                shell=True,
+                stdout=self._logf,
+                stderr=subprocess.STDOUT,
+                env=full_env,
+            )
         self.returncode = self.proc.wait()
         self._logf.flush()
 
     def status(self) -> str:
+        if self.proc is None and self.returncode is None:
+            return JobStatus.PENDING  # waiting for admission
         if self.returncode is None:
             return JobStatus.RUNNING
-        return JobStatus.SUCCEEDED if self.returncode == 0 else JobStatus.FAILED
+        if self.returncode == 0:
+            return JobStatus.SUCCEEDED
+        return JobStatus.STOPPED if self._stopped else JobStatus.FAILED
 
     def stop(self) -> bool:
-        if self.returncode is None:
+        with self._lock:
+            self._stopped = True
+        if self.returncode is None and self.proc is not None:
             self.proc.terminate()
             try:
                 self.proc.wait(timeout=5)
@@ -110,15 +163,40 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
+        priority: int = 0,
+        weight: float = 1.0,
+        quota: Optional[Dict[str, float]] = None,
     ) -> str:
         job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         driver = ray_tpu.get_runtime()
+        # register the tenant with the scheduler's arbitration plane:
+        # admission control decides ADMITTED / QUEUED / REJECTED here,
+        # before any process is spawned
+        rt = ray_tpu.get_runtime()
+        arb_args = (
+            job_id,
+            int(priority),
+            float(weight),
+            quota,
+            {"entrypoint": entrypoint, "submission_id": job_id},
+        )
+        if hasattr(rt, "scheduler_rpc"):
+            arb = rt.scheduler_rpc("submit_job", arb_args)
+        else:
+            arb = rt.rpc("submit_job", *arb_args)
+        if arb["admission"] == "REJECTED":
+            from ray_tpu.exceptions import JobAdmissionError
+
+            raise JobAdmissionError(
+                f"job {job_id} rejected by admission control "
+                f"(queue full or backlog bound exceeded)"
+            )
         log_dir = os.path.join(driver.node.session_dir, "logs") if hasattr(driver, "node") else "/tmp"
         log_path = os.path.join(log_dir, f"job-{job_id}.log")
         env = (runtime_env or {}).get("env_vars")
         supervisor = JobSupervisor.options(
             name=f"_job_supervisor:{job_id}", num_cpus=0
-        ).remote(job_id, entrypoint, log_path, env)
+        ).remote(job_id, entrypoint, log_path, env, arb["job"])
         self._kv_put(
             job_id,
             {
@@ -127,6 +205,11 @@ class JobSubmissionClient:
                 "submitted_at": time.time(),
                 "metadata": metadata or {},
                 "log_path": log_path,
+                "job": arb["job"],
+                "priority": int(priority),
+                "weight": float(weight),
+                "quota": dict(quota or {}),
+                "admission": arb["admission"],
             },
         )
         # surface immediate spawn failures
@@ -160,6 +243,14 @@ class JobSubmissionClient:
             keys = rt.scheduler_rpc("kv_keys", (_NS, b""))
         else:
             keys = rt.rpc("kv_keys", _NS, b"")
+        # join each submission record with its live arbitration row
+        # (admission state, usage, queue position) by job hex
+        from ray_tpu.util import state as _state
+
+        try:
+            arb_rows = {row["job"]: row for row in _state.list_jobs()}
+        except Exception:
+            arb_rows = {}
         out = []
         for k in keys:
             rec = self._kv_get(k.decode())
@@ -168,6 +259,19 @@ class JobSubmissionClient:
                     rec["status"] = self.get_job_status(rec["job_id"]).value
                 except Exception:
                     rec["status"] = "UNKNOWN"
+                arb = arb_rows.get(rec.get("job"))
+                if arb:
+                    for col in (
+                        "admission",
+                        "usage",
+                        "object_store_bytes",
+                        "running",
+                        "ready",
+                        "queue_position",
+                        "preemptions",
+                        "oom_kills",
+                    ):
+                        rec[col] = arb.get(col)
                 out.append(rec)
         return out
 

@@ -149,7 +149,11 @@ def cmd_events(args):
         filters.append(("severity", "=", args.severity.upper()))
     if args.type:
         filters.append(("type", "=", args.type.upper()))
-    rows = state.list_cluster_events(filters=filters or None, limit=args.limit)
+    rows = state.list_cluster_events(
+        filters=filters or None,
+        limit=args.limit,
+        job_id=args.job_id or None,
+    )
     if args.json:
         print(json.dumps(rows, indent=2, default=str))
         return
@@ -269,13 +273,33 @@ def cmd_timeline(args):
             print(f"  {name}: {states}")
 
 
+def _parse_quota(spec):
+    """``CPU=4,memory=2e9,object_store_bytes=1e9`` → {resource: cap}."""
+    if not spec:
+        return None
+    quota = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        key, _, value = part.partition("=")
+        if not value:
+            raise SystemExit(f"bad --quota entry {part!r} (want resource=cap)")
+        quota[key.strip()] = float(value)
+    return quota
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
     _init(args)
     client = JobSubmissionClient()
     if args.job_cmd == "submit":
-        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        job_id = client.submit_job(
+            entrypoint=" ".join(args.entrypoint),
+            priority=args.priority,
+            weight=args.weight,
+            quota=_parse_quota(args.quota),
+        )
         print(f"submitted: {job_id}")
         if args.wait:
             status = client.wait_until_finished(job_id)
@@ -290,7 +314,54 @@ def cmd_job(args):
         print("stopped")
     elif args.job_cmd == "list":
         for rec in client.list_jobs():
-            print(f"{rec['job_id']}  {rec.get('status')}  {rec['entrypoint'][:60]}")
+            extra = ""
+            if rec.get("admission"):
+                extra = f"  [{rec['admission']}"
+                if rec.get("queue_position"):
+                    extra += f" #{rec['queue_position']}"
+                extra += f" prio={rec.get('priority', 0)}]"
+            print(
+                f"{rec['job_id']}  {rec.get('status')}  "
+                f"{rec['entrypoint'][:60]}{extra}"
+            )
+    elif args.job_cmd == "top":
+        # `top`-style live usage across EVERY job the scheduler has seen
+        # (driver included), heaviest first
+        from ray_tpu.util import state
+
+        rows = state.list_jobs()
+        rows.sort(
+            key=lambda r: -(
+                sum((r.get("usage") or {}).values())
+                + r.get("object_store_bytes", 0) / 2**30
+            )
+        )
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        print(
+            f"{'JOB':<18} {'PRIO':>4} {'WT':>5} {'ADMISSION':<10} "
+            f"{'RUN':>5} {'READY':>7} {'PREEMPT':>7} {'OOM':>4} "
+            f"{'OBJ_MB':>9}  USAGE / QUOTA"
+        )
+        for r in rows:
+            usage = r.get("usage") or {}
+            quota = r.get("quota") or {}
+            pairs = sorted(set(usage) | set(quota))
+            usage_s = " ".join(
+                f"{k}:{usage.get(k, 0):g}"
+                + (f"/{quota[k]:g}" if k in quota else "")
+                for k in pairs
+            )
+            pos = f" #{r['queue_position']}" if r.get("queue_position") else ""
+            print(
+                f"{r['name']:<18} {r['priority']:>4} {r['weight']:>5g} "
+                f"{r['admission'] + pos:<10} {r['running']:>5} "
+                f"{r['ready']:>7} {r['preemptions']:>7} {r['oom_kills']:>4} "
+                f"{r.get('object_store_bytes', 0) / 1e6:>9.1f}  {usage_s}"
+            )
+        if not rows:
+            print("no jobs registered")
 
 
 def cmd_serve(args):
@@ -397,6 +468,12 @@ def main(argv=None):
     )
     p.add_argument("--severity", help="filter: INFO | WARNING | ERROR")
     p.add_argument("--type", help="filter: WORKER_DIED, TASK_FAILED, ...")
+    p.add_argument(
+        "--job-id",
+        dest="job_id",
+        help="keep only events attributed to this job (job hex, "
+        "explicit or embedded in the event's task/actor id)",
+    )
     p.add_argument("--limit", type=int, default=200)
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_events)
@@ -424,12 +501,34 @@ def main(argv=None):
     p = sub.add_parser("job", help="job submission")
     jsub = p.add_subparsers(dest="job_cmd", required=True)
     ps = jsub.add_parser("submit")
-    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
     ps.add_argument("--wait", action="store_true")
+    ps.add_argument(
+        "--priority",
+        type=int,
+        default=0,
+        help="job priority: ranks admission order and preemption "
+        "(higher preempts lower)",
+    )
+    ps.add_argument(
+        "--weight",
+        type=float,
+        default=1.0,
+        help="weighted-fair-queueing share (dispatch quantum multiplier)",
+    )
+    ps.add_argument(
+        "--quota",
+        help="per-resource live-usage caps, e.g. "
+        "CPU=4,memory=2e9,object_store_bytes=1e9",
+    )
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
     jsub.add_parser("status").add_argument("job_id")
     jsub.add_parser("logs").add_argument("job_id")
     jsub.add_parser("stop").add_argument("job_id")
     jsub.add_parser("list")
+    ps = jsub.add_parser(
+        "top", help="live per-job usage vs quota, heaviest first"
+    )
+    ps.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_job)
 
     p = sub.add_parser("serve", help="model serving")

@@ -146,6 +146,18 @@ class TrainContext:
         return self.trial_dir
 
 
+def _preempt_shield():
+    """The active runtime's preemption-shield toggle, or a no-op when the
+    session runs somewhere without one (driver-local trainers, tests)."""
+    try:
+        from ray_tpu._private.worker import get_runtime
+
+        fn = getattr(get_runtime(), "protect_from_preemption", None)
+    except Exception:
+        fn = None
+    return fn if fn is not None else (lambda delta: None)
+
+
 class _Session:
     def __init__(self, context: TrainContext, collector, latest_checkpoint: Optional[Checkpoint]):
         self.context = context
@@ -183,6 +195,20 @@ class _Session:
         self.latest_checkpoint = latest_checkpoint
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        if checkpoint is None:
+            return self._report(metrics, None)
+        # preemption shield: the window from snapshot start to the shard's
+        # arrival at the head barrier must not be a preemption/OOM-kill
+        # target — victim selection skips shielded workers, so an
+        # arbitration kill never tears a shard racing toward its commit
+        shield = _preempt_shield()
+        shield(+1)
+        try:
+            return self._report(metrics, checkpoint)
+        finally:
+            shield(-1)
+
+    def _report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
         self.iteration += 1
         ckpt_path = None
         if checkpoint is not None:

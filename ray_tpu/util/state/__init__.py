@@ -12,6 +12,7 @@ from ray_tpu.util.state.api import (
     list_actors,
     list_checkpoints,
     list_cluster_events,
+    list_jobs,
     list_logs,
     list_nodes,
     list_objects,
@@ -31,6 +32,7 @@ __all__ = [
     "list_workers",
     "list_placement_groups",
     "list_cluster_events",
+    "list_jobs",
     "list_logs",
     "get_log",
     "summarize_tasks",

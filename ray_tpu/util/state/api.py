@@ -94,12 +94,17 @@ def backlog_summary() -> dict:
     return _rpc("backlog_summary")
 
 
-def list_cluster_events(filters=None, limit: int = 10_000) -> List[dict]:
+def list_cluster_events(
+    filters=None, limit: int = 10_000, job_id: Optional[str] = None
+) -> List[dict]:
     """Structured cluster events — WORKER_DIED, NODE_DEAD, TASK_RETRY,
-    TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ... — in
-    chronological order (parity: ``ray.util.state.list_cluster_events``).
-    Flushes the telemetry plane first so worker/serve-recorded events are
-    read-your-writes."""
+    TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, PREEMPTED, STRAGGLER,
+    JOB_QUEUED/ADMITTED/REJECTED, ... — in chronological order (parity:
+    ``ray.util.state.list_cluster_events``). ``job_id=`` (job hex) keeps
+    only events attributed to that job — matching an explicit ``job_id``
+    field or the job embedded in the event's task/actor id; the filter
+    runs server-side, so the cap applies after it. Flushes the telemetry
+    plane first so worker/serve-recorded events are read-your-writes."""
     rt = get_runtime()
     if hasattr(rt, "scheduler"):
         from ray_tpu._private import telemetry
@@ -109,7 +114,20 @@ def list_cluster_events(filters=None, limit: int = 10_000) -> List[dict]:
             rt.scheduler.request_telemetry_flush()
         except Exception:
             pass
-    return _list("list_cluster_events", filters, limit)
+    return _filtered(_rpc("list_cluster_events", limit, job_id), filters)[
+        :limit
+    ]
+
+
+def list_jobs(filters=None, limit: int = 10_000) -> List[dict]:
+    """The multi-tenant job plane's arbitration rows: one per job the
+    scheduler has seen, with ``priority`` / ``weight`` / ``quota`` /
+    live ``usage`` (+ ``object_store_bytes``) / ``running`` / ``ready`` /
+    ``admission`` (ADMITTED | QUEUED | REJECTED) / ``queue_position`` in
+    the admission queue / ``preemptions`` / ``oom_kills``. Submission
+    metadata (entrypoint etc.) rides in ``meta`` for jobs registered via
+    ``JobSubmissionClient``."""
+    return _list("list_jobs", filters, limit)
 
 
 def list_checkpoints(filters=None, limit: int = 10_000) -> List[dict]:

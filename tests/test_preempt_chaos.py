@@ -1,0 +1,116 @@
+"""Priority preemption vs the training plane (slow chaos test).
+
+A low-priority ``JaxTrainer`` run saturating the cluster is preempted by a
+high-priority tenant's starved task. The scheduler kills one trainer rank
+(SIGTERM → checkpoint drain hooks), the urgent task runs, and the elastic
+executor replaces the rank — which must resume from the latest COMMITTED
+step with ``steps_redone == 0`` (the async local commit keeps the redo
+window empty) and land on the exact loss of a calm run.
+
+Slow-marked (tier-1 budget); run via ``make chaos`` or ``-m slow``.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import checkpointing
+
+# pytest's prepend import mode puts tests/ on sys.path (no tests/__init__)
+from chaos import elastic_sgd_loop
+
+pytestmark = pytest.mark.slow
+
+
+def _fit(tmp_path, name, total_steps, *, step_sleep=0.0):
+    from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    return JaxTrainer(
+        elastic_sgd_loop(total_steps, step_sleep),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            name=name,
+            failure_config=FailureConfig(
+                max_failures=8,
+                retry_backoff_s=0.2,
+                retry_backoff_jitter=0.0,
+                replacement_timeout_s=60.0,
+                abort_drain_timeout_s=60.0,
+            ),
+        ),
+    ).fit()
+
+
+def test_preempted_trainer_resumes_from_committed_zero_redone(tmp_path):
+    rt = ray_tpu.init(
+        num_cpus=2, _system_config={"preemption_wait_s": 0.8}
+    )
+    try:
+        total = 26
+        calm = _fit(tmp_path, "calm", total)
+        assert calm.error is None, calm.error
+
+        trial = str(tmp_path / "victim")
+        aggressor_out = {}
+
+        def aggressor():
+            # arm only once a committed step exists: the preemption then
+            # provably forces a resume-from-committed, never a
+            # restart-from-scratch
+            deadline = time.monotonic() + 180
+            while (checkpointing.latest_step(trial) or 0) < 2:
+                if time.monotonic() > deadline:
+                    aggressor_out["error"] = "no committed step appeared"
+                    return
+                time.sleep(0.2)
+
+            @ray_tpu.remote
+            def urgent():
+                return "served"
+
+            with ray_tpu.job_scope(name="urgent", priority=10):
+                ref = urgent.remote()
+            # both CPUs are held by priority-0 trainer ranks: this get only
+            # returns because the scheduler preempts one of them
+            aggressor_out["result"] = ray_tpu.get(ref, timeout=120)
+
+        t = threading.Thread(target=aggressor, daemon=True)
+        t.start()
+        with ray_tpu.job_scope(name="train-lo", priority=0):
+            churned = _fit(tmp_path, "victim", total, step_sleep=0.15)
+        t.join(timeout=120)
+
+        assert aggressor_out.get("result") == "served", aggressor_out
+        assert churned.error is None, churned.error
+        assert churned.metrics["training_iteration"] == total
+        # resumed from a committed step, bitwise-identically
+        assert churned.metrics["resumed_at"] > 0
+        assert churned.metrics["loss"] == calm.metrics["loss"]
+        # the acceptance bar: zero redone steps — every step the preempted
+        # run reported after recovery continued from the committed frontier
+        assert churned.goodput is not None
+        assert churned.goodput["steps_redone"] == 0, churned.goodput
+
+        from ray_tpu.util import state
+
+        preempts = state.list_cluster_events(
+            filters=[("type", "=", "PREEMPTED")]
+        )
+        assert preempts, "scheduler never preempted a trainer rank"
+        rows = {r["name"]: r for r in state.list_jobs()}
+        assert rows["train-lo"]["preemptions"] >= 1
+        assert rows["urgent"]["priority"] == 10
+        # the preemption rode the worker-death plane the elastic executor
+        # watches: the rank was replaced, not the whole run restarted
+        types = {e["type"] for e in state.list_cluster_events()}
+        assert "TRAIN_WORKER_DIED" in types, sorted(types)
+        # the final step is committed and digest-valid
+        assert checkpointing.latest_step(trial) == total
+        checkpointing.verify_checkpoint(
+            checkpointing.discover_steps(trial)[total]
+        )
+    finally:
+        ray_tpu.shutdown()
