@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos bench bench-isolation ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation ci clean
 
 all: native cpp
 
@@ -41,7 +41,15 @@ test-fast: native
 # seeded via CHAOS_SEED.
 chaos:
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py \
-		tests/test_elastic_chaos.py tests/test_preempt_chaos.py -m slow -q
+		tests/test_elastic_chaos.py tests/test_preempt_chaos.py \
+		tests/test_serve_chaos.py -m slow -q
+
+# serve-plane churn suite: replica + controller SIGKILLs under sustained
+# mixed unary/streaming load, graceful-redeploy zero-drop proof. Seeded via
+# CHAOS_SEED like the rest of the chaos group; on-demand for CI.
+chaos-serve:
+	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_serve_chaos.py \
+		-m slow -q
 
 bench:
 	$(PY) bench.py

@@ -129,7 +129,87 @@ def main() -> None:
     emit("serve_http_keepalive_rps", rps_ka, "req/s")
 
     serve.delete("bench")
+
+    bench_shed_vs_hang(args)
     ray_tpu.shutdown()
+
+
+def bench_shed_vs_hang(args) -> None:
+    """Saturation A/B: per-attempt p99 with load shedding ON (fast typed
+    503-equivalent) vs OFF (requests queue behind a saturated replica).
+    The shed p99 is the resilience-plane acceptance metric: a saturated
+    deployment answers in milliseconds instead of queueing toward a
+    timeout."""
+
+    @serve.deployment(
+        num_replicas=1,
+        max_ongoing_requests=2,
+        shed_queue_factor=2.0,
+        shed_retry_after_s=0.2,
+        health_check_period_s=30.0,
+    )
+    class Saturated:
+        def __call__(self, x=None):
+            time.sleep(0.05)
+            return "ok"
+
+    serve.run(Saturated.bind(), name="satbench")
+    base = serve.get_app_handle("satbench")
+    from ray_tpu.serve.exceptions import DeploymentOverloadedError
+
+    def run_case(handle, seconds: float, clients: int):
+        lats, sheds = [], [0]
+        stop = time.monotonic() + seconds
+        lock = threading.Lock()
+
+        def loop():
+            while time.monotonic() < stop:
+                t0 = time.monotonic()
+                try:
+                    handle.remote().result(timeout_s=60)
+                except DeploymentOverloadedError:
+                    with lock:
+                        sheds[0] += 1
+                    time.sleep(0.02)  # client honors the fast-fail
+                except Exception:
+                    pass
+                with lock:
+                    lats.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=loop) for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lats.sort()
+        p99 = lats[int(len(lats) * 0.99) - 1] if lats else float("nan")
+        return p99 * 1e3, sheds[0], len(lats)
+
+    clients = 32
+    base.remote().result(timeout_s=60)  # warm
+    hang_p99, _, hang_n = run_case(
+        base.options(shed_enabled=False), 6.0, clients
+    )
+    shed_p99, shed_n, total_n = run_case(base, 6.0, clients)
+    emit("serve_saturation_hang_p99_ms", hang_p99, "ms")
+    emit("serve_saturation_shed_p99_ms", shed_p99, "ms")
+    print(
+        json.dumps(
+            {
+                "metric": "serve_shed_vs_hang",
+                "shed_p99_ms": round(shed_p99, 1),
+                "hang_p99_ms": round(hang_p99, 1),
+                "speedup": round(hang_p99 / max(shed_p99, 1e-9), 1),
+                "clients": clients,
+                "capacity": 4,
+                "shed_attempts": shed_n,
+                "attempts": total_n,
+                "hang_attempts": hang_n,
+            }
+        ),
+        flush=True,
+    )
+    serve.delete("satbench")
 
 
 if __name__ == "__main__":

@@ -315,7 +315,9 @@ class DirectActorClient:
             self._need_resolve.discard(aid.binary())
             ch.mode = "dead"
             ch.death_cause = cause
-            err = exc.ActorDiedError(aid, cause)
+            # queued calls were never sent to the worker: started-marker
+            # False (safe for serve's transparent failover)
+            err = exc.ActorDiedError(aid, cause, task_started=False)
             while ch.queued:
                 self._fail_call_locked(ch, ch.queued.popleft(), err)
             self._flush_releases_locked(ch)
@@ -371,7 +373,13 @@ class DirectActorClient:
             if ch.mode == "dead":
                 rec.arg_refs = None  # nothing pinned yet — fail must not unpin
                 self._fail_call_locked(
-                    ch, rec, exc.ActorDiedError(spec.actor_id, ch.death_cause or "actor died")
+                    ch,
+                    rec,
+                    exc.ActorDiedError(
+                        spec.actor_id,
+                        ch.death_cause or "actor died",
+                        task_started=False,
+                    ),
                 )
                 return True
             if ch.mode == "direct":  # budget known only after resolution
@@ -389,7 +397,13 @@ class DirectActorClient:
                 self._relay_one_locked(rec)
             elif ch.mode == "dead":
                 self._fail_call_locked(
-                    ch, rec, exc.ActorDiedError(spec.actor_id, ch.death_cause or "actor died")
+                    ch,
+                    rec,
+                    exc.ActorDiedError(
+                        spec.actor_id,
+                        ch.death_cause or "actor died",
+                        task_started=False,
+                    ),
                 )
             else:
                 ch.queued.append(rec)
@@ -566,10 +580,14 @@ class DirectActorClient:
                         rec.retries_left -= 1
                     replay.append(rec)
                 else:
+                    # sent but unacked: it may have begun executing on the
+                    # dead worker (started-marker True — torn work)
                     self._fail_call_locked(
                         ch,
                         rec,
-                        exc.ActorDiedError(ch.aid, "actor worker died"),
+                        exc.ActorDiedError(
+                            ch.aid, "actor worker died", task_started=True
+                        ),
                     )
             ch.inflight.clear()
             for rec in reversed(replay):
@@ -737,7 +755,10 @@ class DirectActorClient:
                 self._need_resolve.discard(aid_bin)
                 ch.mode = "dead"
                 ch.death_cause = rep[1]
-                err = exc.ActorDiedError(aid, rep[1] or "actor died")
+                # queued here = never sent: provably unstarted
+                err = exc.ActorDiedError(
+                    aid, rep[1] or "actor died", task_started=False
+                )
                 while ch.queued:
                     self._fail_call_locked(ch, ch.queued.popleft(), err)
                 self._flush_releases_locked(ch)

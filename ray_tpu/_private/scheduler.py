@@ -1722,7 +1722,10 @@ class Scheduler:
             if actor is None or actor.state == "DEAD":
                 reason = actor.death_cause if actor else "actor not found"
                 self._fail_task(
-                    rec, exc.ActorDiedError(spec.actor_id, reason or "actor died")
+                    rec,
+                    exc.ActorDiedError(
+                        spec.actor_id, reason or "actor died", task_started=False
+                    ),
                 )
                 return
             # method calls inherit the actor's per-task retry budget
@@ -3631,7 +3634,14 @@ class Scheduler:
                     self._on_worker_death(actor.worker_id)
                 return
         if actor.state == "DEAD":
-            self._fail_task(rec, exc.ActorDiedError(actor.actor_id, actor.death_cause or "actor died"))
+            self._fail_task(
+                rec,
+                exc.ActorDiedError(
+                    actor.actor_id,
+                    actor.death_cause or "actor died",
+                    task_started=False,
+                ),
+            )
         else:
             actor.pending_calls.append(rec.spec)
 
@@ -4043,8 +4053,16 @@ class Scheduler:
                             rec.worker_id = None
                             actor.pending_calls.append(rec.spec)
                         else:
+                            # this call was dispatched to the worker: it may
+                            # have begun executing (started-marker for serve
+                            # failover — torn work must not be auto-retried)
                             self._fail_task(
-                                rec, exc.ActorDiedError(w.actor_id, "actor worker died")
+                                rec,
+                                exc.ActorDiedError(
+                                    w.actor_id,
+                                    "actor worker died",
+                                    task_started=True,
+                                ),
                             )
                 if graceful:
                     actor.state = "DEAD"
@@ -4074,8 +4092,14 @@ class Scheduler:
             spec = actor.pending_calls.popleft()
             rec = self.tasks.get(spec.task_id)
             if rec is not None:
+                # still in the actor mailbox: provably never started
                 self._fail_task(
-                    rec, exc.ActorDiedError(actor.actor_id, actor.death_cause or "actor died")
+                    rec,
+                    exc.ActorDiedError(
+                        actor.actor_id,
+                        actor.death_cause or "actor died",
+                        task_started=False,
+                    ),
                 )
 
     def _kill_actor(self, actor_id: ActorID, no_restart: bool):

@@ -181,21 +181,35 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        return self.next_ref(None)
+
+    def next_ref(self, timeout_s: "Optional[float]" = None) -> ObjectRef:
+        """The next item's ref, optionally bounded: raises GetTimeoutError
+        once ``timeout_s`` elapses without the producer committing an item
+        (serve's per-item stream timeout rides this — a hung generator task
+        must not park its consumer forever). ``None`` blocks indefinitely.
+        """
         # push-based: block on the runtime's wait plane (pull registration in
         # workers, memory-store condition vars in the driver) instead of
         # spinning on object_ready (round-1 polled at 1 ms here)
+        import time as _time
+
         rt = get_runtime()
+        deadline = None if timeout_s is None else _time.monotonic() + timeout_s
         next_oid = ObjectID.for_return(self._task_id, self._index + 1)
         count_oid = self._count_ref.id()
         while True:
+            slice_s = 30.0
+            if deadline is not None:
+                slice_s = min(slice_s, max(0.0, deadline - _time.monotonic()))
             if self._total is None:
-                ready, _ = rt.wait([next_oid, count_oid], 1, timeout=30.0)
+                ready, _ = rt.wait([next_oid, count_oid], 1, timeout=slice_s)
                 if count_oid in ready and not rt.object_ready(next_oid):
                     self._total = rt.get_objects([count_oid])[0]
             else:
                 if self._index >= self._total:
                     raise StopIteration
-                rt.wait([next_oid], 1, timeout=30.0)
+                rt.wait([next_oid], 1, timeout=slice_s)
             if rt.object_ready(next_oid):
                 self._index += 1
                 # owned: the consumer's ref holds the item alive (direct
@@ -204,6 +218,13 @@ class ObjectRefGenerator:
                 return ObjectRef(next_oid, _owned=True)
             if self._total is not None and self._index >= self._total:
                 raise StopIteration
+            if deadline is not None and _time.monotonic() >= deadline:
+                from ray_tpu import exceptions as exc
+
+                raise exc.GetTimeoutError(
+                    f"stream item {self._index + 1} not produced within "
+                    f"{timeout_s:g}s"
+                )
 
     def __del__(self):
         # abandoned mid-stream (or fully drained): let the runtime drop

@@ -228,6 +228,13 @@ class _DynamicActorHandle(ActorHandle):
             raise AttributeError(name)
         return ActorMethod(self, name, 1)
 
+    def __reduce__(self):
+        # the base reduce would rebuild a plain ActorHandle whose EMPTY
+        # method table can't resolve any method — a dynamic handle must
+        # stay dynamic across pickling (serve ships re-adopted replica
+        # handles through the controller this way)
+        return (_DynamicActorHandle, (self._actor_id,))
+
 
 def kill(actor_or_ref, no_restart: bool = True) -> None:
     """Parity: ``ray.kill`` / ``ray.cancel``."""

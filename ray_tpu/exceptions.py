@@ -111,12 +111,30 @@ class WorkerCrashedError(RayTpuError):
 
 
 class ActorDiedError(RayTpuError):
-    """The actor is dead; pending and future calls fail with this."""
+    """The actor is dead; pending and future calls fail with this.
 
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+    ``task_started`` is the scheduler's started-marker for the failed call:
+    ``False`` means the call provably never reached a worker (still queued
+    in the actor mailbox, or submitted after death) and is safe to retry;
+    ``True`` means it had been dispatched for execution; ``None`` means the
+    scheduler could not tell. Serve's replica failover keys off this.
+    """
+
+    def __init__(
+        self,
+        actor_id=None,
+        reason: str = "actor died",
+        task_started: bool | None = None,
+    ):
         self.actor_id = actor_id
         self.reason = reason
+        self.task_started = task_started
         super().__init__(reason)
+
+    def __reduce__(self):
+        # default Exception pickling would rebuild from args=(reason,),
+        # shifting reason into actor_id and dropping the started-marker
+        return (ActorDiedError, (self.actor_id, self.reason, self.task_started))
 
 
 class ActorUnavailableError(RayTpuError):

@@ -406,9 +406,22 @@ def cmd_serve(args):
             print(f"wrote {args.output}")
     elif args.serve_cmd == "status":
         try:
-            print(json.dumps(serve.status(), indent=2))
+            st = serve.status()
         except ValueError:
-            print("{}")  # no controller -> nothing deployed
+            st = {}  # no controller -> nothing deployed
+        print(json.dumps(st, indent=2))
+        if not getattr(args, "json", False):
+            # one-line health digest per deployment for quick triage
+            for app, deps in st.items():
+                for dep, row in deps.items():
+                    health = row.get("health", "?")
+                    drain = row.get("draining", 0)
+                    extra = f" draining={drain}" if drain else ""
+                    print(
+                        f"{app}/{dep}: {health} "
+                        f"{row.get('num_replicas', '?')}/"
+                        f"{row.get('target', '?')} replicas{extra}"
+                    )
     elif args.serve_cmd == "shutdown":
         serve.shutdown()
         print("serve shut down")
@@ -543,7 +556,8 @@ def main(argv=None):
     ps.add_argument("--name", default="default")
     ps.add_argument("--route-prefix", dest="route_prefix")
     ps.add_argument("--output", "-o")
-    ssub.add_parser("status")
+    ps = ssub.add_parser("status")
+    ps.add_argument("--json", action="store_true")
     ssub.add_parser("shutdown")
     p.set_defaults(fn=cmd_serve)
 

@@ -34,6 +34,13 @@ from ray_tpu.serve.handle import (
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.exceptions import (
+    DeploymentOverloadedError,
+    ReplicaDiedError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+    ServeError,
+)
 
 __all__ = [
     "deployment",
@@ -58,6 +65,11 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "ServeError",
+    "ReplicaDiedError",
+    "ReplicaDrainingError",
+    "DeploymentOverloadedError",
+    "RequestTimeoutError",
 ]
 
 from ray_tpu._private import usage as _usage

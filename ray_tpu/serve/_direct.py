@@ -63,17 +63,40 @@ class DirectReplicaServer:
                 if method == "__ws__":
                     # the connection becomes a dedicated bidirectional
                     # websocket session channel; it never returns to
-                    # request/response framing
-                    self._replica.handle_websocket(conn, args[0])
+                    # request/response framing. A drain rejection (or any
+                    # pre-session failure) goes back as a typed error frame
+                    # so the proxy answers the upgrade cleanly instead of
+                    # dropping the socket.
+                    try:
+                        self._replica.handle_websocket(conn, args[0])
+                    except Exception as e:  # noqa: BLE001
+                        try:
+                            blob = cloudpickle.dumps(e)
+                        except Exception:
+                            blob = pickle.dumps(RuntimeError(str(e)))
+                        try:
+                            conn.send(("err", blob))
+                        except (OSError, BrokenPipeError):
+                            pass
                     return
                 try:
+                    # the ("started", None) frame is the replica-side
+                    # started-marker: a channel that breaks BEFORE the proxy
+                    # saw it provably never executed this request (safe to
+                    # retry elsewhere); a break after it is torn work.
+                    # Draining rejections are checked first so they are
+                    # never marked started.
+                    if getattr(self._replica, "_draining", False):
+                        self._replica._reject_if_draining()
                     if stream:
+                        conn.send(("started", None))
                         for item in self._replica.handle_request_streaming(
                             method, args, kwargs, model_id
                         ):
                             conn.send(("item", item))
                         conn.send(("end", None))
                     else:
+                        conn.send(("started", None))
                         result = self._replica.handle_request(
                             method, args, kwargs, model_id
                         )
@@ -131,9 +154,14 @@ class DirectChannel:
         if not ready:
             self.broken = True
             self.close()
-            raise _ChannelBroken(
+            # the reply may still arrive later, so this socket's framing can
+            # no longer be trusted (channel dies), but the REPLICA is not
+            # dead — tag it so the pool raises a timeout, not replica-death
+            err = _ChannelBroken(
                 f"direct replica call timed out after {timeout}s"
             )
+            err.timed_out = True
+            raise err
         try:
             return self._conn.recv()
         except (OSError, EOFError) as e:
@@ -149,10 +177,21 @@ class DirectChannel:
             self.close()
             raise _ChannelBroken(str(e)) from e
 
-    def call(self, method: str, args, kwargs, model_id: str = ""):
+    def call(self, method: str, args, kwargs, model_id: str = "", timeout=None):
+        timeout = timeout or self.CALL_TIMEOUT_S
+        started = False
         with self._lock:
-            self._send((method, list(args), dict(kwargs), model_id, False))
-            kind, payload = self._recv(self.CALL_TIMEOUT_S)
+            try:
+                self._send((method, list(args), dict(kwargs), model_id, False))
+                kind, payload = self._recv(timeout)
+                if kind == "started":
+                    started = True
+                    kind, payload = self._recv(timeout)
+            except _ChannelBroken as e:
+                # started-marker: a break before the replica's "started"
+                # frame means this request never executed — safe to retry
+                e.started = started
+                raise
         if kind == "ok":
             return payload
         # an APPLICATION exception (may subclass OSError!) — it must reach
@@ -161,12 +200,22 @@ class DirectChannel:
 
     def call_streaming(self, method: str, args, kwargs, model_id: str = ""):
         completed = False
+        started = False
+        items_sent = 0
         with self._lock:
             try:
                 self._send((method, list(args), dict(kwargs), model_id, True))
                 while True:
-                    kind, payload = self._recv(self.STREAM_FRAME_TIMEOUT_S)
-                    if kind == "item":
+                    try:
+                        kind, payload = self._recv(self.STREAM_FRAME_TIMEOUT_S)
+                    except _ChannelBroken as e:
+                        e.started = started
+                        e.items_sent = items_sent
+                        raise
+                    if kind == "started":
+                        started = True
+                    elif kind == "item":
+                        items_sent += 1
                         yield payload
                     elif kind == "end":
                         completed = True
@@ -198,6 +247,7 @@ class DirectPool:
 
     REFRESH_PERIOD_S = 5.0
     CHANNELS_PER_REPLICA = 4
+    DRAINING_TTL_S = 30.0
 
     def __init__(self, handle, auth_key: bytes):
         self._handle = handle
@@ -206,6 +256,10 @@ class DirectPool:
         # actor_id hex -> {"addr", "channels": [DirectChannel], "rr": int}
         self._replicas: Dict[str, dict] = {}
         self._outstanding: Dict[str, int] = {}
+        # rid -> monotonic timestamp of the drain rejection: the replica is
+        # alive but refusing work; skip it until the handle-info refresh
+        # drops it (TTL-bounded so a cancelled drain re-enters the pool)
+        self._draining: Dict[str, float] = {}
         self._last_refresh = 0.0
         self.refresh()
 
@@ -255,12 +309,31 @@ class DirectPool:
                     c.close()
                 del self._replicas[rid]
                 self._outstanding.pop(rid, None)
+            now = time.monotonic()
+            for rid in [
+                r
+                for r, ts in self._draining.items()
+                if r not in self._replicas or now - ts > self.DRAINING_TTL_S
+            ]:
+                del self._draining[rid]
+
+    def _mark_draining(self, rid: str) -> None:
+        import time
+
+        with self._lock:
+            if rid in self._replicas:
+                self._draining[rid] = time.monotonic()
+
+    def total_outstanding(self) -> int:
+        """In-flight direct-path requests (admission-control input)."""
+        with self._lock:
+            return sum(self._outstanding.values())
 
     def _pick(self) -> Optional[Tuple[str, DirectChannel]]:
         import random
 
         with self._lock:
-            rids = list(self._replicas)
+            rids = [r for r in self._replicas if r not in self._draining]
             if not rids:
                 return None
             if len(rids) == 1:
@@ -294,40 +367,95 @@ class DirectPool:
             for c in entry["channels"]:
                 c.close()
 
-    def call(self, method: str, args, kwargs, model_id: str = ""):
+    def call(self, method: str, args, kwargs, model_id: str = "", timeout=None):
         """Direct call; raises _DirectUnavailable when no channel works (the
-        caller falls back to the handle path)."""
+        caller falls back to the handle path). A channel that breaks AFTER
+        the replica's started-marker is torn work: surfaced as a typed
+        ReplicaDiedError, never silently re-executed."""
         import time
+
+        from ray_tpu.serve.exceptions import ReplicaDiedError, ReplicaDrainingError
 
         if time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S:
             self.refresh()
-        for _ in range(2):
+        for _ in range(3):
             picked = self._pick()
             if picked is None:
                 break
             rid, chan = picked
             try:
                 try:
-                    return chan.call(method, args, kwargs, model_id)
+                    return chan.call(method, args, kwargs, model_id, timeout=timeout)
                 finally:
                     self._done(rid)
-            except _ChannelBroken:
+            except ReplicaDrainingError:
+                # replica alive but refusing new work: request never started,
+                # retry on another replica immediately
+                self._mark_draining(rid)
+            except _ChannelBroken as e:
                 self._evict(rid)
+                if getattr(e, "timed_out", False):
+                    # slow request, not a dead replica: typed timeout (the
+                    # proxy maps it to 504). The channel itself is gone —
+                    # its framing can't be trusted — but the replica
+                    # re-enters the pool on the next refresh.
+                    from ray_tpu.serve.exceptions import RequestTimeoutError
+
+                    raise RequestTimeoutError(
+                        getattr(self._handle, "deployment_name", ""),
+                        method,
+                        timeout or DirectChannel.CALL_TIMEOUT_S,
+                    ) from e
+                if getattr(e, "started", False):
+                    raise ReplicaDiedError(
+                        deployment=getattr(self._handle, "deployment_name", ""),
+                        app=getattr(self._handle, "app_name", ""),
+                        method=method,
+                        replica_id=rid,
+                        started=True,
+                        reason=str(e),
+                    ) from e
         raise _DirectUnavailable()
 
     def call_streaming(self, method: str, args, kwargs, model_id: str = ""):
-        picked = self._pick()
-        if picked is None:
-            raise _DirectUnavailable()
-        rid, chan = picked
-        try:
+        from ray_tpu.serve.exceptions import ReplicaDiedError, ReplicaDrainingError
+
+        for _ in range(3):
+            picked = self._pick()
+            if picked is None:
+                raise _DirectUnavailable()
+            rid, chan = picked
             try:
-                yield from chan.call_streaming(method, args, kwargs, model_id)
-            finally:
-                self._done(rid)
-        except _ChannelBroken:
-            self._evict(rid)
-            raise _DirectUnavailable()
+                try:
+                    yield from chan.call_streaming(method, args, kwargs, model_id)
+                    return
+                finally:
+                    self._done(rid)
+            except ReplicaDrainingError:
+                self._mark_draining(rid)  # nothing sent: pick another replica
+            except _ChannelBroken as e:
+                self._evict(rid)
+                if getattr(e, "timed_out", False):
+                    from ray_tpu.serve.exceptions import RequestTimeoutError
+
+                    raise RequestTimeoutError(
+                        getattr(self._handle, "deployment_name", ""),
+                        method,
+                        DirectChannel.STREAM_FRAME_TIMEOUT_S,
+                    ) from e
+                if getattr(e, "started", False) or getattr(e, "items_sent", 0):
+                    # the stream had begun (possibly with chunks already
+                    # relayed to the client): typed torn-stream error
+                    raise ReplicaDiedError(
+                        deployment=getattr(self._handle, "deployment_name", ""),
+                        app=getattr(self._handle, "app_name", ""),
+                        method=method,
+                        replica_id=rid,
+                        started=True,
+                        reason=str(e),
+                    ) from e
+                raise _DirectUnavailable()
+        raise _DirectUnavailable()
 
     def open_dedicated(self):
         """Dial a FRESH connection to one replica for a long-lived
@@ -340,7 +468,11 @@ class DirectPool:
         if time.monotonic() - self._last_refresh > self.REFRESH_PERIOD_S:
             self.refresh()
         with self._lock:
-            addrs = [e["addr"] for e in self._replicas.values()]
+            addrs = [
+                e["addr"]
+                for rid, e in self._replicas.items()
+                if rid not in self._draining
+            ]
         random.shuffle(addrs)
         from ray_tpu._private.object_transfer import _dial
 
@@ -362,7 +494,11 @@ class DirectPool:
 
 class _ChannelBroken(Exception):
     """Transport-level failure on a direct channel (distinct from user
-    exceptions, which may themselves subclass OSError)."""
+    exceptions, which may themselves subclass OSError). ``started`` /
+    ``items_sent`` carry the replica's started-marker state at the break."""
+
+    started: bool = False
+    items_sent: int = 0
 
 
 class _DirectUnavailable(Exception):

@@ -27,6 +27,12 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import unquote, urlsplit
 
 import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.serve.exceptions import (
+    DeploymentOverloadedError,
+    ReplicaDiedError,
+    RequestTimeoutError,
+)
 
 _PROXY_NAME = "SERVE_PROXY"
 DEFAULT_PORT = 8700
@@ -53,6 +59,12 @@ class _NoRouteError(Exception):
 
 def _error_body(status: int, message: str) -> Tuple[int, bytes, str]:
     return status, json.dumps({"error": message}).encode(), "application/json"
+
+
+def _retry_after_headers(e: DeploymentOverloadedError) -> Dict[str, str]:
+    import math
+
+    return {"Retry-After": str(max(1, int(math.ceil(e.retry_after_s))))}
 
 
 @ray_tpu.remote(max_concurrency=16)
@@ -217,13 +229,21 @@ class HTTPProxy:
                 writer, app, method, path, split.query, headers, body, keep
             )
         loop = asyncio.get_running_loop()
+        extra_headers = None
         try:
             status, blob, ctype = await loop.run_in_executor(
                 self._pool, self._call_plain, app, headers, body
             )
+        except DeploymentOverloadedError as e:
+            # load shedding: fast 503 + Retry-After instead of queueing the
+            # request into a guaranteed timeout
+            status, blob, ctype = _error_body(503, str(e))
+            extra_headers = _retry_after_headers(e)
+        except (RequestTimeoutError, GetTimeoutError) as e:
+            status, blob, ctype = _error_body(504, str(e))
         except Exception as e:  # noqa: BLE001
             status, blob, ctype = _error_body(500, str(e))
-        await self._write_simple(writer, status, blob, ctype, keep)
+        await self._write_simple(writer, status, blob, ctype, keep, extra_headers)
         return True
 
     def _match(self, path: str) -> Optional[str]:
@@ -255,22 +275,33 @@ class HTTPProxy:
     def _dispatch(self, app, method, args):
         from ray_tpu.serve._direct import _DirectUnavailable
 
+        handle = self._handles[app]
+        timeout_s = float(handle._cfg.get("request_timeout_s") or 120.0)
         pool = self._direct.get(app)
         if pool is not None:
+            # admission control covers the direct path too: the handle only
+            # sees its own in-flight count, so fold in the pool's
+            handle._check_admission(extra_load=pool.total_outstanding())
             try:
-                return pool.call(method, args, {})
+                return pool.call(method, args, {}, timeout=timeout_s)
             except _DirectUnavailable:
                 pass
-        handle = self._handles[app]
-        return handle._call(method, args, {}).result(timeout_s=120)
+            # ReplicaDiedError propagates: torn work must NOT silently
+            # re-execute through the handle path
+        return handle._call(method, args, {}).result(timeout_s=timeout_s)
 
-    async def _write_simple(self, writer, status, blob, ctype, keep):
+    async def _write_simple(self, writer, status, blob, ctype, keep,
+                            extra_headers=None):
+        extra = "".join(
+            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+        )
         writer.write(
             (
                 f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
                 f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(blob)}\r\n"
-                f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+                + extra
+                + f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
             ).encode("latin1")
         )
         writer.write(blob)
@@ -278,9 +309,27 @@ class HTTPProxy:
 
     # -- ASGI deployments --------------------------------------------------
 
+    def _check_admission(self, app):
+        """Per-deployment admission bound, shared by every ingress path;
+        raises DeploymentOverloadedError when the deployment should shed."""
+        handle = self._handles.get(app)
+        if handle is None:
+            return
+        pool = self._direct.get(app)
+        handle._check_admission(
+            extra_load=pool.total_outstanding() if pool is not None else 0
+        )
+
     async def _respond_asgi(self, writer, app, method, path, query, headers, body, keep):
         """Returns False when the connection is no longer reusable (client
         vanished or the chunked stream was truncated by a replica error)."""
+        try:
+            self._check_admission(app)
+        except DeploymentOverloadedError as e:
+            await self._write_simple(
+                writer, *_error_body(503, str(e)), keep, _retry_after_headers(e)
+            )
+            return True
         scope = {
             "type": "http",
             "http_version": "1.1",
@@ -425,6 +474,15 @@ class HTTPProxy:
         if not self._is_asgi.get(app):
             await self._write_simple(
                 writer, *_error_body(400, "route does not mount an ASGI app"), keep
+            )
+            return True
+        try:
+            # new sessions are load too: shed before dedicating a replica
+            # serving thread to the socket
+            self._check_admission(app)
+        except DeploymentOverloadedError as e:
+            await self._write_simple(
+                writer, *_error_body(503, str(e)), keep, _retry_after_headers(e)
             )
             return True
         pool = self._direct.get(app)
@@ -736,6 +794,7 @@ _REASONS = {
     404: "Not Found",
     500: "Internal Server Error",
     503: "Service Unavailable",
+    504: "Gateway Timeout",
 }
 
 

@@ -131,8 +131,51 @@ class Replica:
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
         self._direct_lock = threading.Lock()
+        # DRAINING: set once by prepare_drain(); new dispatches are rejected
+        # with ReplicaDrainingError BEFORE entering the gate (so they never
+        # count as accepted work), while in-flight requests — including open
+        # streams and websocket sessions — run to completion
+        self._draining = False
+        self._replica_id_hex = ""
         if user_config is not None:
             self.reconfigure(user_config)
+
+    def _replica_id(self) -> str:
+        if not self._replica_id_hex:
+            try:
+                from ray_tpu._private.worker import get_runtime
+
+                rid = getattr(get_runtime(), "_actor_id", None)
+                self._replica_id_hex = rid.hex() if rid else ""
+            except Exception:
+                pass
+        return self._replica_id_hex
+
+    def _reject_if_draining(self):
+        if self._draining:
+            from ray_tpu.serve.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(self._deployment, self._replica_id())
+
+    def prepare_drain(self) -> int:
+        """Enter DRAINING: reject new dispatches, finish in-flight work.
+        Returns the current ongoing count so the controller can log how
+        much work the drain is waiting on. Idempotent. The flag flips under
+        the ongoing lock: after this returns, every dispatch either already
+        counts in ``num_ongoing`` or will be rejected — the controller's
+        (draining AND idle) check is race-free."""
+        with self._ongoing_lock:
+            self._draining = True
+            return self._ongoing
+
+    def is_draining(self) -> bool:
+        return self._draining
+
+    def drain_status(self):
+        """(draining, ongoing) read atomically — the drain loop's idle-kill
+        predicate."""
+        with self._ongoing_lock:
+            return (self._draining, self._ongoing)
 
     def reconfigure(self, user_config) -> bool:
         """Apply a user_config without restarting the replica (parity: the
@@ -145,6 +188,14 @@ class Replica:
 
     def _enter(self, model_id: str):
         with self._ongoing_lock:
+            # checked under the SAME lock prepare_drain flips the flag
+            # under: a request either counts in num_ongoing before the
+            # drain begins, or is rejected — never a silent in-between the
+            # drain loop's idle-kill could tear
+            if self._draining:
+                from ray_tpu.serve.exceptions import ReplicaDrainingError
+
+                raise ReplicaDrainingError(self._deployment, self._replica_id_hex)
             self._ongoing += 1
             depth = self._ongoing
         self._record_depth(depth)
@@ -226,6 +277,7 @@ class Replica:
     def handle_request(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
         import time as _time
 
+        self._reject_if_draining()
         self._enter(model_id)
         t0 = _time.perf_counter()
         try:
@@ -246,6 +298,7 @@ class Replica:
         streams its response events."""
         import time as _time
 
+        self._reject_if_draining()
         self._enter(model_id)
         t0 = _time.perf_counter()
         try:
@@ -286,6 +339,7 @@ class Replica:
         app = getattr(self._callable, "__serve_asgi_app__", None)
         if app is None:
             raise TypeError("deployment does not mount an ASGI app")
+        self._reject_if_draining()
         from ray_tpu.serve._ws import run_asgi_websocket
 
         self._enter("")

@@ -19,6 +19,12 @@ _DEPLOYMENT_OVERRIDE_KEYS = (
     "autoscaling_config",
     "health_check_period_s",
     "user_config",
+    # resilience knobs (see Deployment docstring)
+    "graceful_shutdown_timeout_s",
+    "request_timeout_s",
+    "request_retries",
+    "shed_queue_factor",
+    "shed_retry_after_s",
 )
 
 
@@ -47,6 +53,16 @@ def build(app, *, import_path: str, name: str = "default",
             d["autoscaling_config"] = spec["autoscaling_config"]
         if spec.get("user_config") is not None:
             d["user_config"] = spec["user_config"]
+        for knob, default in (
+            ("graceful_shutdown_timeout_s", 20.0),
+            ("request_timeout_s", 120.0),
+            ("request_retries", 3),
+            ("shed_queue_factor", 6.0),
+            ("shed_retry_after_s", 1.0),
+            ("health_check_period_s", 5.0),
+        ):
+            if spec.get(knob) is not None and spec[knob] != default:
+                d[knob] = spec[knob]
         deployments.append(d)
     app_schema: Dict[str, Any] = {
         "name": name,

@@ -10,5 +10,7 @@ from .harness import (  # noqa: F401
     KillSchedule,
     chaos_seed,
     elastic_sgd_loop,
+    serve_controller_pids,
+    serve_replica_pids,
     train_worker_pids,
 )

@@ -82,6 +82,16 @@ def train_worker_pids() -> List[int]:
     return actor_pids("_TrainWorker")
 
 
+def serve_replica_pids() -> List[int]:
+    """PIDs of live serve replica actors (serve chaos victims)."""
+    return actor_pids("Replica")
+
+
+def serve_controller_pids() -> List[int]:
+    """PID (singleton list) of the live serve controller actor."""
+    return actor_pids("ServeController")
+
+
 def elastic_sgd_loop(total_steps: int, step_sleep: float = 0.0):
     """Deterministic full-batch linear-regression SGD, world-size
     invariant: every rank computes the identical replicated update, saves

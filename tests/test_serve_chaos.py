@@ -1,0 +1,214 @@
+"""Serve-plane chaos: seeded replica + controller kills under sustained
+mixed unary/streaming load (`make chaos-serve`, seeded via CHAOS_SEED).
+
+Acceptance (ISSUE 9): across replica churn >= 99% of requests succeed and
+every failure is a typed ReplicaDiedError on work that had already started;
+after the fleet heals, a graceful redeploy under load completes with ZERO
+failed requests.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+# pytest's prepend import mode puts tests/ on sys.path (no tests/__init__),
+# so the chaos harness package imports as a top-level name
+from chaos import ChaosMonkey, chaos_seed, serve_controller_pids, serve_replica_pids
+
+pytestmark = pytest.mark.slow
+
+
+def test_serve_churn_mixed_load_and_graceful_redeploy():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @serve.deployment(
+            num_replicas=3,
+            health_check_period_s=0.5,
+            graceful_shutdown_timeout_s=5.0,
+        )
+        class Mixed:
+            def __init__(self, version=1):
+                self.version = version
+
+            def __call__(self, x):
+                time.sleep(0.01)
+                return x
+
+            def stream(self, n):
+                for i in range(n):
+                    time.sleep(0.01)
+                    yield i
+
+        serve.run(Mixed.bind(1), name="churn")
+
+        counts = {"ok": 0, "typed": 0, "other": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+        other_errors = []
+
+        def note(kind, err=None):
+            with lock:
+                counts[kind] += 1
+                if kind == "other" and len(other_errors) < 5:
+                    other_errors.append(repr(err))
+
+        def unary_client(i):
+            h = serve.get_app_handle("churn")
+            n = 0
+            while not stop.is_set():
+                try:
+                    assert h.remote(n).result(timeout_s=60) == n
+                    note("ok")
+                except serve.ReplicaDiedError:
+                    note("typed")
+                except Exception as e:  # noqa: BLE001
+                    note("other", e)
+                n += 1
+
+        def stream_client(i):
+            h = serve.get_app_handle("churn").options(stream=True)
+            while not stop.is_set():
+                try:
+                    out = list(h.stream.remote(5))
+                    if out == list(range(5)):
+                        note("ok")
+                    else:
+                        note("other", RuntimeError(f"partial stream {out}"))
+                except serve.ReplicaDiedError:
+                    note("typed")  # already-started stream torn by a kill
+                except Exception as e:  # noqa: BLE001
+                    note("other", e)
+
+        threads = [
+            threading.Thread(target=unary_client, args=(i,)) for i in range(6)
+        ] + [threading.Thread(target=stream_client, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+
+        # ---- phase 1: replica churn + one controller kill ----------------
+        monkey = ChaosMonkey(
+            seed=chaos_seed(),
+            interval_s=(1.0, 2.0),
+            victims=serve_replica_pids,
+            max_kills=3,
+            arm_when=lambda: counts["ok"] > 50,
+        )
+        monkey.start()
+        deadline = time.monotonic() + 15.0
+        controller_killed = False
+        while time.monotonic() < deadline:
+            if not controller_killed and len(monkey.kills) >= 1:
+                cpids = serve_controller_pids()
+                if cpids:
+                    os.kill(cpids[0], signal.SIGKILL)
+                    controller_killed = True
+            time.sleep(0.2)
+        kills = monkey.stop()
+        assert kills >= 2, f"chaos monkey landed only {kills} kills"
+        assert controller_killed, "controller was never killed"
+        # keep load running while the fleet heals
+        heal_deadline = time.monotonic() + 30.0
+        while time.monotonic() < heal_deadline:
+            try:
+                st = serve.status()
+                row = st.get("churn", {}).get("Mixed", {})
+                if row.get("num_replicas") == 3 and row.get("health") == "HEALTHY":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+        with lock:
+            churn_counts = dict(counts)
+        total = sum(churn_counts.values())
+        assert total > 200, f"not enough load generated: {churn_counts}"
+        assert churn_counts["other"] == 0, (
+            f"untyped failures under churn (seed={chaos_seed()}): "
+            f"{churn_counts} {other_errors}"
+        )
+        success = churn_counts["ok"] / total
+        assert success >= 0.99, (
+            f"success rate {success:.4f} < 0.99 under churn "
+            f"(seed={chaos_seed()}, counts={churn_counts}, kills={monkey.kills})"
+        )
+
+        # ---- phase 2: graceful redeploy under load = zero drops ----------
+        with lock:
+            for k in counts:
+                counts[k] = 0
+            other_errors.clear()
+        serve.run(Mixed.bind(2), name="churn")  # full replica restart
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        with lock:
+            redeploy_counts = dict(counts)
+        assert redeploy_counts["typed"] == 0 and redeploy_counts["other"] == 0, (
+            f"graceful redeploy dropped requests (seed={chaos_seed()}): "
+            f"{redeploy_counts} {other_errors}"
+        )
+        assert redeploy_counts["ok"] > 50
+
+        print(
+            f"serve chaos (seed={chaos_seed()}): churn={churn_counts} "
+            f"success={success:.4f} kills={monkey.kills} "
+            f"redeploy={redeploy_counts}"
+        )
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_serve_drain_under_chaos_preserves_streams():
+    """Heavier drain variant: long streams crossing several redeploys all
+    complete (drain keeps old replicas alive until their streams finish)."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @serve.deployment(
+            num_replicas=2,
+            health_check_period_s=0.5,
+            graceful_shutdown_timeout_s=30.0,
+        )
+        class Long:
+            def __init__(self, version=1):
+                self.version = version
+
+            def stream(self, n):
+                for i in range(n):
+                    time.sleep(0.05)
+                    yield i
+
+        serve.run(Long.bind(1), name="drainchaos")
+        results = []
+        errors = []
+
+        def consumer(i):
+            h = serve.get_app_handle("drainchaos").options(stream=True)
+            try:
+                results.append(list(h.stream.remote(40)))  # ~2s per stream
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=consumer, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        # two back-to-back full redeploys while every stream is open
+        serve.run(Long.bind(2), name="drainchaos")
+        serve.run(Long.bind(3), name="drainchaos")
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, f"drain tore open streams: {errors[:3]}"
+        assert len(results) == 4
+        for out in results:
+            assert out == list(range(40))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

@@ -1,0 +1,461 @@
+"""Serve-plane resilience: graceful drain, replica failover, load shedding,
+controller fault tolerance (DESIGN_MAP "Serve resilience").
+
+Fast tier-1 slice — the heavy churn variants live in tests/test_serve_chaos.py
+(slow-marked, `make chaos-serve`).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_redeploy_under_load_drops_zero_requests(serve_cluster):
+    """A graceful redeploy (full replica restart) under sustained load
+    completes with ZERO failed requests: old replicas drain in-flight work,
+    new dispatches fail over to the new replica set transparently."""
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.5)
+    class Versioned:
+        def __init__(self, version):
+            self.version = version
+
+        def __call__(self, x):
+            time.sleep(0.02)
+            return (self.version, x)
+
+    serve.run(Versioned.bind(1), name="redeploy_app")
+    errors = []
+    results = []
+    stop = threading.Event()
+
+    def client(i):
+        h = serve.get_app_handle("redeploy_app")
+        n = 0
+        while not stop.is_set():
+            try:
+                results.append(h.remote((i, n)).result(timeout_s=60))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            n += 1
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    # full redeploy: init arg changed -> every replica restarts
+    serve.run(Versioned.bind(2), name="redeploy_app")
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, f"redeploy dropped {len(errors)} requests: {errors[:3]}"
+    versions = {v for v, _ in results}
+    assert 1 in versions and 2 in versions, versions
+    serve.delete("redeploy_app")
+
+
+def test_dead_replica_failover_retries_unstarted_once(serve_cluster):
+    """A call routed to a dead replica whose work provably never started
+    (scheduler started-marker False) is retried on a live replica exactly
+    once — transparent to the caller."""
+
+    @serve.deployment(num_replicas=2, health_check_period_s=30.0)
+    class Echo:
+        def __call__(self, x):
+            return (os.getpid(), x)
+
+    serve.run(Echo.bind(), name="failover_app")
+    handle = serve.get_app_handle("failover_app")
+    pids = {handle.remote(i).result(timeout_s=60)[0] for i in range(16)}
+    assert len(pids) == 2
+
+    victim = handle._replicas[0]
+    ray_tpu.kill(victim)
+    time.sleep(0.3)
+    # force the next dispatch onto the corpse: it fails with
+    # ActorDiedError(task_started=False) and must fail over exactly once
+    handle._excluded.clear()
+    with handle._lock:
+        handle._model_affinity["corpse"] = 0
+    handle._model_id = "corpse"
+    before = handle._retry_count
+    out = handle.remote(99).result(timeout_s=60)
+    assert out[1] == 99
+    assert handle._retry_count - before == 1, "expected exactly one retry"
+    # the corpse is now excluded: subsequent calls don't touch it
+    before = handle._retry_count
+    handle._model_id = ""
+    for i in range(6):
+        handle.remote(i).result(timeout_s=60)
+    assert handle._retry_count == before
+    serve.delete("failover_app")
+
+
+def test_torn_unary_work_raises_typed_replica_died(serve_cluster):
+    """A replica killed while a request is EXECUTING must not silently
+    retry: the caller gets a typed ReplicaDiedError with started=True."""
+
+    @serve.deployment(num_replicas=1, health_check_period_s=30.0)
+    class Hang:
+        def __call__(self):
+            time.sleep(30)
+            return "done"
+
+    serve.run(Hang.bind(), name="torn_app")
+    handle = serve.get_app_handle("torn_app")
+    resp = handle.remote()
+    time.sleep(0.5)  # let it reach the replica and start
+    ray_tpu.kill(handle._replicas[0])
+    with pytest.raises(serve.ReplicaDiedError) as ei:
+        resp.result(timeout_s=30)
+    assert ei.value.started is True
+    assert ei.value.deployment == "Hang"
+    serve.delete("torn_app")
+
+
+def test_saturated_deployment_sheds_503_with_retry_after(serve_cluster):
+    """Admission control: beyond replicas x max_ongoing x shed_queue_factor
+    the handle raises DeploymentOverloadedError and the HTTP proxy returns a
+    FAST 503 + Retry-After instead of queueing into a timeout."""
+
+    @serve.deployment(
+        num_replicas=1,
+        max_ongoing_requests=1,
+        shed_queue_factor=2.0,
+        shed_retry_after_s=3.0,
+        health_check_period_s=30.0,
+    )
+    class Slow:
+        def __call__(self, p=None):
+            time.sleep(1.0)
+            return "ok"
+
+    serve.run(Slow.bind(), name="shed_app", route_prefix="/shed")
+    handle = serve.get_app_handle("shed_app")
+    # capacity = 1 * 1 * 2 = 2: the 3rd concurrent call sheds
+    ok, shed = [], []
+    for _ in range(6):
+        try:
+            ok.append(handle.remote())
+        except serve.DeploymentOverloadedError as e:
+            shed.append(e)
+    assert len(ok) == 2 and len(shed) == 4, (len(ok), len(shed))
+    assert shed[0].retry_after_s == 3.0
+    assert handle._shed_count >= 4
+    for r in ok:
+        assert r.result(timeout_s=60) == "ok"
+
+    # HTTP path: saturate through the proxy, expect fast 503 + Retry-After
+    statuses = []
+    lock = threading.Lock()
+
+    def post():
+        t0 = time.monotonic()
+        try:
+            resp = urllib.request.urlopen(
+                urllib.request.Request(
+                    "http://127.0.0.1:8700/shed",
+                    data=json.dumps(None).encode(),
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=60,
+            )
+            with lock:
+                statuses.append((resp.status, None, time.monotonic() - t0))
+        except urllib.error.HTTPError as e:
+            with lock:
+                statuses.append(
+                    (e.code, e.headers.get("Retry-After"), time.monotonic() - t0)
+                )
+
+    threads = [threading.Thread(target=post) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    codes = [s for s, _, _ in statuses]
+    assert codes.count(200) >= 1
+    sheds = [(s, ra, dt) for s, ra, dt in statuses if s == 503]
+    assert sheds, f"no 503s under saturation: {statuses}"
+    for s, ra, dt in sheds:
+        assert ra == "3"  # Retry-After from shed_retry_after_s
+        assert dt < 5.0  # fast-fail, not a queued hang
+    serve.delete("shed_app")
+
+
+def test_graceful_drain_finishes_inflight_stream(serve_cluster):
+    """Redeploy mid-stream: the old replica enters DRAINING, the open
+    stream runs to completion before the replica is killed, and a
+    REPLICA_DRAINED event lands in the cluster event log."""
+
+    @serve.deployment(
+        num_replicas=1,
+        graceful_shutdown_timeout_s=30.0,
+        health_check_period_s=0.5,
+    )
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                time.sleep(0.15)
+                yield i
+
+    serve.run(Streamer.bind(), name="drain_app")
+    handle = serve.get_app_handle("drain_app")
+    it = iter(handle.options(stream=True).remote(12))
+    first = next(it)
+    # full redeploy while the stream is open
+    serve.run(
+        Streamer.options(max_ongoing_requests=4).bind(), name="drain_app"
+    )
+    rest = list(it)
+    assert [first] + rest == list(range(12)), "drain tore an open stream"
+    # new replica serves fresh work
+    assert list(handle.options(stream=True).remote(3)) == [0, 1, 2]
+    # the drained replica shows up in forensics
+    from ray_tpu.util import state as state_api
+
+    deadline = time.monotonic() + 30
+    drained = []
+    while time.monotonic() < deadline and not drained:
+        drained = [
+            e
+            for e in state_api.list_cluster_events()
+            if e.get("type") == "REPLICA_DRAINED"
+            and e.get("deployment") == "Streamer"
+        ]
+        time.sleep(0.5)
+    assert drained, "REPLICA_DRAINED event never recorded"
+    serve.delete("drain_app")
+
+
+def test_drain_timeout_kills_hung_replica(serve_cluster):
+    """A replica that cannot finish in-flight work within
+    graceful_shutdown_timeout_s is killed anyway (bounded drain)."""
+
+    @serve.deployment(
+        num_replicas=1,
+        graceful_shutdown_timeout_s=1.0,
+        health_check_period_s=0.5,
+    )
+    class Stuck:
+        def __call__(self):
+            time.sleep(60)
+            return "never"
+
+    serve.run(Stuck.bind(), name="stuck_app")
+    handle = serve.get_app_handle("stuck_app")
+    resp = handle.remote()
+    time.sleep(0.5)  # request is executing
+    old_replica = handle._replicas[0]
+    serve.run(Stuck.options(max_ongoing_requests=4).bind(), name="stuck_app")
+    # the hung request dies with the timed-out drain, typed as torn work
+    with pytest.raises(serve.ReplicaDiedError):
+        resp.result(timeout_s=30)
+    # and the old replica is actually gone
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(old_replica.check_health.remote(), timeout=2)
+            time.sleep(0.5)
+        except Exception:
+            break
+    else:
+        pytest.fail("drain-timeout never killed the hung replica")
+    serve.delete("stuck_app")
+
+
+def test_controller_death_preserves_routes_and_replicas(serve_cluster):
+    """SIGKILL the controller: the detached actor auto-restarts, restores
+    apps/routes from the GCS KV, and RE-ADOPTS the still-alive replicas
+    (same pids — no fleet cold start). Handles and HTTP keep working."""
+    from chaos import serve_controller_pids
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.5)
+    class Echo:
+        def __call__(self, x=None):
+            return os.getpid()
+
+    serve.run(Echo.bind(), name="ft_app", route_prefix="/ft")
+    handle = serve.get_app_handle("ft_app")
+    pids_before = {handle.remote().result(timeout_s=60) for _ in range(16)}
+    assert len(pids_before) == 2
+
+    cpids = serve_controller_pids()
+    assert len(cpids) == 1, cpids
+    os.kill(cpids[0], signal.SIGKILL)
+
+    # the controller auto-restarts and restores state from the KV
+    deadline = time.monotonic() + 40
+    st = {}
+    while time.monotonic() < deadline:
+        try:
+            st = serve.status()
+            if "ft_app" in st:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert "ft_app" in st, f"controller never recovered: {st}"
+    # routes survived
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+    assert routes.get("/ft") == "ft_app"
+    # replicas were re-adopted, not restarted: same pids serve traffic
+    fresh = serve.get_app_handle("ft_app")
+    pids_after = {fresh.remote().result(timeout_s=60) for _ in range(16)}
+    assert pids_after == pids_before, (pids_before, pids_after)
+    # the new controller pid differs (it really did die)
+    new_cpids = serve_controller_pids()
+    assert new_cpids and new_cpids != cpids
+    serve.delete("ft_app")
+
+
+def test_handle_options_warns_once_and_typed_stream_timeout(serve_cluster):
+    """options() warns once per unknown kwarg instead of silently dropping
+    it; the streaming per-item timeout is configurable and typed."""
+
+    @serve.deployment(health_check_period_s=30.0, graceful_shutdown_timeout_s=1.0)
+    class SlowYield:
+        def __call__(self):
+            yield 1
+            time.sleep(20)
+            yield 2
+
+    serve.run(SlowYield.bind(), name="sy_app")
+    handle = serve.get_app_handle("sy_app")
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        handle.options(definitely_not_an_option=1)
+        handle.options(definitely_not_an_option=2)
+    msgs = [str(w.message) for w in caught]
+    assert sum("definitely_not_an_option" in m for m in msgs) == 1, msgs
+
+    it = iter(handle.options(stream=True, stream_item_timeout_s=0.5).remote())
+    assert next(it) == 1
+    t0 = time.monotonic()
+    with pytest.raises(serve.RequestTimeoutError) as ei:
+        next(it)
+    assert time.monotonic() - t0 < 10.0
+    assert ei.value.timeout_s == 0.5
+    serve.delete("sy_app")
+
+
+def test_health_check_period_honored_and_status_health(serve_cluster):
+    """The reconcile loop probes each deployment at ITS
+    health_check_period_s (not a hardcoded 1s), and serve.status() surfaces
+    health + resilience knobs."""
+    import tempfile
+
+    fast_log = tempfile.NamedTemporaryFile(delete=False, suffix=".fast")
+    slow_log = tempfile.NamedTemporaryFile(delete=False, suffix=".slow")
+    fast_log.close()
+    slow_log.close()
+
+    @serve.deployment
+    class Probed:
+        def __init__(self, p):
+            self.p = p
+
+        def check_health(self):
+            with open(self.p, "a") as f:
+                f.write("x")
+
+        def __call__(self):
+            return 1
+
+    serve.run(
+        Probed.options(health_check_period_s=0.4, name="FastP").bind(
+            fast_log.name
+        ),
+        name="probe_fast",
+    )
+    serve.run(
+        Probed.options(health_check_period_s=10.0, name="SlowP").bind(
+            slow_log.name
+        ),
+        name="probe_slow",
+    )
+    base_fast = os.path.getsize(fast_log.name)
+    base_slow = os.path.getsize(slow_log.name)
+    time.sleep(3.0)
+    fast_probes = os.path.getsize(fast_log.name) - base_fast
+    slow_probes = os.path.getsize(slow_log.name) - base_slow
+    assert fast_probes >= 3, f"0.4s period produced {fast_probes} probes in 3s"
+    assert slow_probes <= 1, f"10s period produced {slow_probes} probes in 3s"
+
+    st = serve.status()
+    row = st["probe_fast"]["FastP"]
+    assert row["health"] == "HEALTHY"
+    assert row["config"]["request_retries"] == 3
+    assert row["config"]["graceful_shutdown_timeout_s"] == 20.0
+    assert "draining" in row
+    os.unlink(fast_log.name)
+    os.unlink(slow_log.name)
+    serve.delete("probe_fast")
+    serve.delete("probe_slow")
+
+
+def test_replica_death_emits_events_and_metrics(serve_cluster):
+    """Replica death reaches forensics: REPLICA_DIED + DEPLOYMENT_UNHEALTHY
+    cluster events and the serve resilience counters."""
+    from ray_tpu.util import state as state_api
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.4)
+    class Mortal:
+        def __call__(self):
+            return "alive"
+
+    serve.run(Mortal.bind(), name="mortal_app")
+    handle = serve.get_app_handle("mortal_app")
+    assert handle.remote().result(timeout_s=60) == "alive"
+    ray_tpu.kill(handle._replicas[0])
+
+    deadline = time.monotonic() + 30
+    died, unhealthy = [], []
+    while time.monotonic() < deadline and not (died and unhealthy):
+        evs = state_api.list_cluster_events()
+        died = [
+            e for e in evs
+            if e.get("type") == "REPLICA_DIED"
+            and e.get("deployment") == "Mortal"
+        ]
+        unhealthy = [
+            e for e in evs if e.get("type") == "DEPLOYMENT_UNHEALTHY"
+            and e.get("deployment") == "Mortal"
+        ]
+        time.sleep(0.5)
+    assert died, "REPLICA_DIED never recorded"
+    assert unhealthy, "DEPLOYMENT_UNHEALTHY never recorded"
+    # reconcile heals it back
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if serve.get_app_handle("mortal_app").remote().result(
+                timeout_s=30
+            ) == "alive":
+                break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        pytest.fail("deployment never healed")
+    serve.delete("mortal_app")
