@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo ci clean
 
 all: native cpp
 
@@ -53,6 +53,19 @@ chaos-serve:
 
 bench:
 	$(PY) bench.py
+
+# request-tracing plane smoke: nested task graph + streaming serve request
+# reconstructed via ray_tpu.trace (stage sum within 10% of wall, TTFT span
+# present), plus a profiler flame-graph export. Fails non-zero on any
+# violation.
+trace-demo:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/trace_demo.py
+
+# tracing/profiler overhead: same-box alternating on/off pairs; the
+# recorded acceptance signal is the per-call ratio (budget <= 1.05).
+# --append writes the rows to BENCH_CORE.jsonl
+bench-trace:
+	JAX_PLATFORMS=cpu $(PY) bench_trace.py
 
 # multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
 # must not degrade a high-priority job's p99 probe latency beyond 2x its

@@ -1,0 +1,108 @@
+"""Request-tracing & continuous-profiling demo (the `make trace-demo`
+smoke target).
+
+Runs a nested task graph and a streaming serve request, reconstructs both
+span trees with ``ray_tpu.trace``, checks the acceptance invariants (stage
+sum within 10% of wall; TTFT span present), and exports a speedscope flame
+graph. Exits non-zero on any violation, so CI can smoke the whole plane.
+"""
+
+import sys
+import time
+
+import ray_tpu
+
+
+def main() -> int:
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    failures = []
+
+    # -- nested task graph -------------------------------------------------
+    @ray_tpu.remote
+    def leaf(x):
+        time.sleep(0.03)
+        return x * 2
+
+    @ray_tpu.remote
+    def root(x):
+        return ray_tpu.get(leaf.remote(x)) + 1
+
+    assert ray_tpu.get(root.remote(3)) == 7
+    tid = next(
+        t["trace_id"]
+        for t in ray_tpu.recent_traces(limit=10)
+        if t["root"] == "root"
+    )
+    tr = ray_tpu.trace(tid)
+    print("=== nested task graph ===")
+    print(tr.summary())
+    if tr.span_count() != 2:
+        failures.append(f"expected 2 spans, got {tr.span_count()}")
+    r = tr.roots[0]
+    covered = sum(r.stage_breakdown().values())
+    if r.duration_ms and abs(covered - r.duration_ms) / r.duration_ms > 0.10:
+        failures.append(
+            f"stage sum {covered:.1f}ms vs wall {r.duration_ms:.1f}ms"
+        )
+
+    # -- streaming serve request (TTFT) ------------------------------------
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Tokens:
+        def gen(self, n):
+            for i in range(int(n)):
+                time.sleep(0.01)
+                yield f"tok{i}"
+
+    h = serve.run(Tokens.bind(), name="trace_demo")
+    try:
+        out = list(h.options(stream=True).gen.remote(4))
+        assert len(out) == 4
+        serve_tr = None
+        deadline = time.time() + 15
+        while time.time() < deadline and serve_tr is None:
+            for d in ray_tpu.recent_traces(limit=30):
+                cand = ray_tpu.trace(d["trace_id"])
+                spans = list(cand.spans.values())
+                if any(
+                    (s.name or "").startswith("serve:replica:Tokens")
+                    and s.extra.get("ttft_ms") is not None
+                    for s in spans
+                ):
+                    serve_tr = cand
+                    break
+            time.sleep(0.3)
+        print("=== streaming serve request ===")
+        if serve_tr is None:
+            failures.append("no serve trace with a TTFT span found")
+        else:
+            print(serve_tr.summary())
+    finally:
+        serve.shutdown()
+
+    # -- continuous profiler ------------------------------------------------
+    @ray_tpu.remote
+    def spin(s):
+        t0 = time.time()
+        while time.time() - t0 < s:
+            pass
+
+    ray_tpu.request_profile(hz=150, duration_s=2.0)
+    ray_tpu.get([spin.remote(0.6) for _ in range(2)], timeout=60)
+    time.sleep(1.2)
+    n = ray_tpu.profile_dump("/tmp/ray_tpu_trace_demo_flame.json")
+    print(f"flame graph: {n} profiles -> /tmp/ray_tpu_trace_demo_flame.json")
+    if n < 1:
+        failures.append("profiler produced no samples")
+
+    ray_tpu.shutdown()
+    if failures:
+        print("TRACE-DEMO FAILURES:", *failures, sep="\n  ")
+        return 1
+    print("trace-demo OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,10 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "timeline",
+    "trace",
+    "recent_traces",
+    "request_profile",
+    "profile_dump",
     "job_scope",
     "__version__",
 ]
@@ -220,6 +224,90 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
         with open(filename, "w") as fh:
             _json.dump(trace, fh)
     return trace
+
+
+def _sched_rpc(op: str, *args):
+    """One scheduler rpc, in-process driver or remote-attached alike (the
+    single place the runtime-dispatch fallback lives)."""
+    rt = get_runtime()
+    if hasattr(rt, "scheduler_rpc"):
+        return rt.scheduler_rpc(op, args)
+    return rt.rpc(op, *args)
+
+
+def _traced_rpc(op: str, *args):
+    """Flush telemetry cluster-wide (read-your-writes), then run a
+    scheduler rpc."""
+    rt = get_runtime()
+    from ray_tpu._private import telemetry as _telemetry
+
+    _telemetry.flush()
+    scheduler = getattr(rt, "scheduler", None)
+    if scheduler is not None:
+        scheduler.request_telemetry_flush()
+    return _sched_rpc(op, *args)
+
+
+def trace(trace_id: str):
+    """Reconstruct one request's cross-process span tree and critical-path
+    latency decomposition (submit -> queue_wait -> dispatch -> arg_fetch ->
+    execute -> result_put -> stream_yield; serve spans included).
+
+    ``trace_id`` comes from :func:`recent_traces`, the
+    ``x-raytpu-trace-id`` serve response header,
+    ``ray_tpu.util.tracing.current_trace_id()``, or a latency exemplar.
+    Returns a :class:`ray_tpu._private.trace.Trace`; print
+    ``.summary()`` or inspect ``.to_dict()``.
+    """
+    from ray_tpu._private.trace import build_trace
+
+    trace_id = str(trace_id)
+    events = _traced_rpc("trace_events", trace_id)
+    return build_trace(events, trace_id)
+
+
+def recent_traces(limit: int = 100) -> List[dict]:
+    """Digests of recently-seen traces, newest first: ``{trace_id,
+    first_time, last_time, root, events}``. Reads the scheduler's index
+    directly — no cluster-wide flush fan-out (the dashboard polls this
+    every couple of seconds; only per-trace event reads need
+    read-your-writes)."""
+    from ray_tpu._private import telemetry as _telemetry
+
+    _telemetry.flush()  # local buffer only: direct-call submission anchors
+    return _sched_rpc("list_traces", int(limit))
+
+
+def request_profile(hz: float = 99.0, duration_s: float = 10.0) -> int:
+    """Boost the continuous sampling profiler cluster-wide for a bounded
+    window (on top of the steady-state ``profiler_hz``). Returns the number
+    of workers reached; the calling process is boosted too."""
+    from ray_tpu._private import sampler as _sampler
+
+    _sampler.boost(hz, duration_s)
+    return _sched_rpc("request_profile", hz, duration_s)
+
+
+def profile_dump(
+    filename: str,
+    format: str = "speedscope",
+    task_id: Optional[str] = None,
+    trace_id: Optional[str] = None,
+) -> int:
+    """Export the cluster's aggregated continuous-profiler samples as a
+    flame graph: ``format="speedscope"`` (JSON for speedscope.app, one
+    profile per task) or ``"collapsed"`` (Brendan-Gregg collapsed stacks).
+    Optional ``task_id``/``trace_id`` narrow attribution to one task or one
+    request. Returns profiles/lines written."""
+    from ray_tpu._private import sampler as _sampler
+
+    _sampler.get_sampler().drain()
+    rows = _traced_rpc("profile_samples", task_id, trace_id)
+    if format == "collapsed":
+        return _sampler.write_collapsed(rows, filename)
+    if format == "speedscope":
+        return _sampler.write_speedscope(rows, filename)
+    raise ValueError(f"unknown flame-graph format {format!r}")
 
 
 def __getattr__(name):

@@ -121,6 +121,25 @@ class Config:
     # profile spans, batched metrics, scheduler task-event log); off trades
     # observability for the last few percent of small-task throughput
     telemetry_enabled: bool = True
+    # --- request tracing & continuous profiling (see DESIGN_MAP "Request
+    # tracing & profiling") ---
+    # mint a (trace_id, span_id) at every entry point (driver remote()
+    # calls, serve proxy requests, job submissions) and propagate it through
+    # task specs / lease frames / direct-actor frames / serve handles so
+    # every request yields a cross-process span tree (ray_tpu.trace(id)).
+    # Requires telemetry_enabled; bench-tracked overhead ratio <= 1.05
+    tracing_enabled: bool = True
+    # bound on the scheduler's recent-trace index (trace_id -> root digest)
+    trace_index_max: int = 4096
+    # continuous sampling profiler: steady-state stack-sample rate per
+    # process (Hz). 0 = off; `request_profile` boosts on demand regardless
+    profiler_hz: float = 0.0
+    # distinct (task, stack) aggregation slots kept scheduler-side;
+    # overflow is counted in ray_tpu_profiler_dropped_total
+    profiler_max_stacks: int = 20_000
+    # sliding-window latency series (per-job / per-deployment p50/p95/p99
+    # with exemplar trace ids): window length in seconds
+    latency_window_s: float = 60.0
     # --- failure forensics (cluster event log, watchdogs) ---
     # bound on the scheduler's structured cluster-event log (WORKER_DIED,
     # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest

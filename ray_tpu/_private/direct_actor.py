@@ -342,6 +342,7 @@ class DirectActorClient:
         when the call must use the head relay instead (stable per actor)."""
         if self._closed:
             return False
+        t_submit = time.time()  # submission anchor for the trace event below
         aid_bin = spec.actor_id.binary()
         # thread startup must happen OUTSIDE self._lock: Thread.start()
         # blocks until the new thread signals started, and if a GC cycle
@@ -388,9 +389,11 @@ class DirectActorClient:
         if arg_refs:
             self.ensure_published(arg_refs)
             self._pin(arg_refs)
+        on_plane = False
         with self._lock:
             if ch.mode == "direct":
                 self._send_call_locked(ch, rec)
+                on_plane = True
             elif ch.mode == "relay":
                 # resolution flipped to relay between our two lock windows
                 self._relay_flush_locked(ch)
@@ -407,6 +410,34 @@ class DirectActorClient:
                 )
             else:
                 ch.queued.append(rec)
+                on_plane = True
+        if on_plane and spec.trace_ctx is not None:
+            # caller-side SUBMITTED anchor: a call that STAYS on the direct
+            # plane never touches the head, so this is the span's only
+            # submission-time record (gap to the worker's RUNNING event =
+            # mailbox/queue wait). Relay fallbacks skip it — the head
+            # records SUBMITTED for them and a duplicate would double-count
+            # the span in the trace index. (A queued call whose channel
+            # later resolves to relay can still record twice; the trace
+            # view keys states by span id, so the dup is cosmetic.)
+            from ray_tpu._private import telemetry as _telemetry
+
+            t = spec.trace_ctx
+            _telemetry.record_task_event(
+                {
+                    "task_id": spec.task_id.hex(),
+                    "name": spec.name,
+                    "type": spec.task_type.name,
+                    "state": "SUBMITTED",
+                    "time": t_submit,
+                    "pid": os.getpid(),
+                    "src": "caller",
+                    "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                    "trace_id": t[0],
+                    "span_id": t[1],
+                    **({"parent_id": t[2]} if len(t) > 2 and t[2] else {}),
+                }
+            )
         return True
 
     def _pin(self, arg_refs):

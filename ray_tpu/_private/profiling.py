@@ -33,6 +33,57 @@ def profile(event_name: str, extra_data: dict | None = None):
         _emit(span)
 
 
+@contextlib.contextmanager
+def traced_section(event_name: str, extra_data: dict | None = None):
+    """A profile span with its OWN span id, parented under the calling
+    thread's active trace context and ACTIVE for the duration of the block
+    (nested sections / task submissions become its children).
+
+    The serve plane's span primitive: proxy request, handle dispatch, and
+    replica queue/execute sections each get a distinct node in the
+    ``ray_tpu.trace`` tree instead of annotating the task span. Extras can
+    be added after entry via the yielded dict (e.g. TTFT measured
+    mid-stream). Untraced (no active context, tracing disabled): still
+    yields a dict but records nothing.
+    """
+    from ray_tpu.util import tracing
+
+    cur = tracing.get_current_context()
+    if cur is None and not tracing.tracing_enabled():
+        yield {}
+        return
+    if cur is None:
+        ctx = tracing.new_root()
+    else:
+        ctx = tracing.TraceContext(
+            trace_id=cur.trace_id,
+            span_id=tracing._new_id(8),
+            parent_id=cur.span_id,
+        )
+    extras = dict(extra_data or {})
+    start = time.time()
+    with tracing.scope(ctx):
+        try:
+            yield extras
+        finally:
+            end = time.time()
+            span = {
+                "event": str(event_name),
+                "start": start,
+                "end": end,
+                "duration_ms": (end - start) * 1e3,
+                "pid": os.getpid(),
+                "extra": {**extras, **ctx.to_dict()},
+            }
+            _emit(span)
+
+
+def current_section_trace_id() -> "str | None":
+    from ray_tpu.util import tracing
+
+    return tracing.current_trace_id()
+
+
 def _emit(span: dict) -> None:
     from ray_tpu._private import telemetry
     from ray_tpu._private import worker as worker_mod

@@ -575,6 +575,24 @@ class Scheduler:
         # the TTL is constant, so expiry only ever pops from the left
         self._transit_pins: collections.deque = collections.deque()
         self._task_events: Deque[dict] = collections.deque(maxlen=config.task_event_buffer_max)
+        # ---- request-tracing plane ----
+        # bounded recent-trace index: trace_id -> {first_time, last_time,
+        # root (first-seen span name), spans}; feeds `ray_tpu trace --list`
+        # and the latency exemplars
+        self._trace_index: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        # continuous-profiler aggregation: (task_id, trace_id, stack) ->
+        # sample count, bounded by profiler_max_stacks (overflow counted)
+        self._profile_samples: Dict[Tuple, int] = {}
+        self._profile_samples_dropped = 0
+        # active request_profile boost window: (hz, monotonic deadline)
+        self._profile_boost: Optional[Tuple[float, float]] = None
+        # per-job sliding-window end-to-end task latency (p50/p95/p99 with
+        # exemplar trace ids); job hex -> LatencyWindow
+        from ray_tpu._private.telemetry import LatencyWindow as _LatencyWindow
+
+        self._job_latency: Dict[str, _LatencyWindow] = {}
         # ---- failure-forensics plane ----
         # structured cluster events (WORKER_DIED, NODE_DEAD, TASK_RETRY,
         # TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ...);
@@ -1009,6 +1027,20 @@ class Scheduler:
             self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
             if w.actor_id is None:
                 self._idle_by_node[w.node_id].append(wid)
+            # an active profiler-boost window covers late-spawned workers
+            # too (request_profile during a cold start would otherwise only
+            # reach the workers alive at call time)
+            boost = getattr(self, "_profile_boost", None)
+            if boost is not None:
+                hz, deadline = boost
+                remaining = deadline - time.monotonic()
+                if remaining > 0.05:
+                    try:
+                        w.conn.send(("profile", hz, remaining))
+                    except (OSError, EOFError):
+                        pass
+                else:
+                    self._profile_boost = None
         elif kind == "task_done":
             _, task_id, results = msg
             self._on_task_done(wid, task_id, results)
@@ -2157,6 +2189,9 @@ class Scheduler:
             if ready.get(job_bin):
                 continue
             del self._jobs[job_bin]
+            # the latency window (and its label cardinality) dies with the
+            # GC'd job record
+            self._job_latency.pop(job_bin.hex(), None)
 
     def _find_starved_demand(
         self, now: float, wait_s: float
@@ -3461,6 +3496,7 @@ class Scheduler:
             rec.state = "PENDING"
             rec.worker_id = None
             self._ready_push(rec)
+            self._record_event(rec.spec, "RETRY")  # same-trace attempt link
             self._record_task_retry(rec, "lease worker died")
         else:
             self._fail_task(
@@ -4000,6 +4036,11 @@ class Scheduler:
                     rec.state = "PENDING"
                     rec.worker_id = None
                     self._ready_push(rec)
+                    # tracing: the retried attempt stays linked to the same
+                    # trace — the killed worker's batch (and its RUNNING/
+                    # FAILED events) may have died unflushed, so this head-
+                    # side RETRY record is the durable attempt link
+                    self._record_event(rec.spec, "RETRY")
                     self._record_task_retry(
                         rec, "preempted" if preempted else "worker died"
                     )
@@ -4689,6 +4730,53 @@ class Scheduler:
             return self._runtime_metric_series()
         if op == "task_events":
             return list(self._task_events)
+        if op == "trace_events":
+            # every merged event belonging to one trace (the ray_tpu.trace
+            # span-tree input); a linear scan of the bounded event log is
+            # fine for a read-path query
+            trace_id = args[0]
+            return [
+                ev for ev in self._task_events if ev.get("trace_id") == trace_id
+            ]
+        if op == "list_traces":
+            limit = args[0] if args and isinstance(args[0], int) else 100
+            rows = list(self._trace_index.values())[-limit:]
+            return [dict(r) for r in reversed(rows)]  # newest first
+        if op == "profile_samples":
+            # aggregated continuous-profiler stacks, optionally filtered to
+            # one task or one trace: [(task_id, trace_id, stack, count)]
+            task_id = args[0] if args else None
+            trace_id = args[1] if len(args) > 1 else None
+            out_rows = []
+            for (t_id, tr_id, stack), n in self._profile_samples.items():
+                if task_id and t_id != task_id:
+                    continue
+                if trace_id and tr_id != trace_id:
+                    continue
+                out_rows.append((t_id, tr_id, stack, n))
+            return out_rows
+        if op == "job_latency":
+            # per-job sliding-window quantiles with exemplar trace ids
+            return {
+                job: win.snapshot()
+                for job, win in self._job_latency.items()
+            }
+        if op == "request_profile":
+            # on-demand profiler boost: fan (hz, duration_s) out to every
+            # live worker; the driver process boosts itself caller-side
+            hz, duration_s = float(args[0]), float(args[1])
+            # remembered so workers that come up mid-window get boosted too
+            self._profile_boost = (hz, time.monotonic() + duration_s)
+            sent = 0
+            for w in list(self.workers.values()):
+                if w.state not in ("idle", "busy", "blocked", "leased"):
+                    continue
+                try:
+                    w.conn.send(("profile", hz, duration_s))
+                    sent += 1
+                except (OSError, EOFError):
+                    pass
+            return sent
         if op == "list_cluster_events":
             rows = list(self._cluster_events)
             limit = args[0] if args and isinstance(args[0], int) else None
@@ -5077,16 +5165,74 @@ class Scheduler:
     def _record_event(self, spec: TaskSpec, state: str, ts: float = None):
         if not getattr(self.config, "telemetry_enabled", True):
             return
-        self._task_events.append(
-            {
-                "task_id": spec.task_id.hex(),
-                "name": spec.name,
-                "type": spec.task_type.name,
-                "state": state,
-                "time": ts if ts is not None else time.time(),
-                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "type": spec.task_type.name,
+            "state": state,
+            "time": ts if ts is not None else time.time(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        }
+        t = getattr(spec, "trace_ctx", None)
+        if t is not None:
+            # head-side half of the task's span (the worker records the
+            # execution half under the SAME span id — minted at submission)
+            ev["trace_id"], ev["span_id"] = t[0], t[1]
+            if len(t) > 2 and t[2]:
+                ev["parent_id"] = t[2]
+            if state == "SUBMITTED":
+                # index maintenance only on the submission anchor: this
+                # runs on the scheduler loop for EVERY lifecycle event, and
+                # the small-task overhead budget (ratio <= 1.05) is paid
+                # exactly here
+                self._trace_note(t[0], ev)
+        if state == "FINISHED":
+            # per-job sliding-window latency (p50/p95/p99 + exemplars):
+            # end-to-end submit -> finish, exemplar = the task's trace id
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                job = spec.task_id.job_id().hex()
+                win = self._job_latency.get(job)
+                if win is None:
+                    from ray_tpu._private.telemetry import LatencyWindow
+
+                    win = self._job_latency[job] = LatencyWindow(
+                        window_s=float(
+                            getattr(self.config, "latency_window_s", 60.0)
+                        )
+                    )
+                win.observe(
+                    (time.monotonic() - rec.submit_time) * 1e3,
+                    t[0] if t is not None else None,
+                )
+        self._task_events.append(ev)
+
+    def _trace_note(self, trace_id: str, ev: dict) -> None:
+        """Maintain the bounded recent-trace index: trace_id -> digest with
+        the first-seen (root-most) event name, for `ray_tpu trace --list`
+        and latency exemplar lookups."""
+        idx = self._trace_index
+        entry = idx.get(trace_id)
+        if entry is None:
+            if len(idx) >= int(
+                getattr(self.config, "trace_index_max", 4096) or 4096
+            ):
+                idx.popitem(last=False)  # drop the oldest trace
+            idx[trace_id] = {
+                "trace_id": trace_id,
+                "first_time": ev.get("time"),
+                "last_time": ev.get("time"),
+                "root": ev.get("name"),
+                "events": 1,
             }
-        )
+            return
+        entry["events"] += 1
+        t = ev.get("time") or 0
+        if t > (entry["last_time"] or 0):
+            entry["last_time"] = t
+        if t and t < (entry["first_time"] or t + 1):
+            entry["first_time"] = t
+            entry["root"] = ev.get("name")
 
     def task_events(self) -> List[dict]:
         return list(self._task_events)
@@ -5401,20 +5547,29 @@ class Scheduler:
     # ---- telemetry plane (TelemetryBuffer ingestion + cluster flush) -----
 
     def _append_profile_span(self, span: dict, pid=None) -> None:
-        self._task_events.append(
-            {
-                "task_id": span.get("task_id"),
-                "name": span.get("event", "span"),
-                "type": "PROFILE",
-                "state": "PROFILE",
-                "time": span.get("start", time.time()),
-                "end_time": span.get("end"),
-                "duration_ms": span.get("duration_ms"),
-                "pid": span.get("pid", pid),
-                "extra": span.get("extra", {}),
-                "actor_id": None,
-            }
-        )
+        extra = span.get("extra", {})
+        ev = {
+            "task_id": span.get("task_id"),
+            "name": span.get("event", "span"),
+            "type": "PROFILE",
+            "state": "PROFILE",
+            "time": span.get("start", time.time()),
+            "end_time": span.get("end"),
+            "duration_ms": span.get("duration_ms"),
+            "pid": span.get("pid", pid),
+            "extra": extra,
+            "actor_id": None,
+        }
+        tid = extra.get("trace_id")
+        if tid:
+            # serve proxy/handle spans and user profile() sections join the
+            # trace index alongside task lifecycle events
+            ev["trace_id"] = tid
+            ev["span_id"] = extra.get("span_id")
+            if extra.get("parent_id"):
+                ev["parent_id"] = extra["parent_id"]
+            self._trace_note(tid, ev)
+        self._task_events.append(ev)
 
     def _ingest_telemetry(self, batch: dict, holder=None) -> None:
         """Merge one process's flushed batch: lifecycle events and spans
@@ -5429,9 +5584,25 @@ class Scheduler:
         spans = batch.get("spans") or ()
         self._telemetry_events += len(events) + len(spans)
         for ev in events:
+            tid = ev.get("trace_id")
+            if tid and ev.get("state") == "SUBMITTED":
+                # caller-side submission anchors (the only submission
+                # record for direct actor calls) keep the index current;
+                # per-event noting is skipped — loop budget (see
+                # _record_event)
+                self._trace_note(tid, ev)
             self._task_events.append(ev)
         for span in spans:
             self._append_profile_span(span, pid=pid)
+        for key, n in batch.get("samples") or ():
+            key = tuple(key)
+            cur = self._profile_samples.get(key)
+            if cur is None and len(self._profile_samples) >= int(
+                getattr(self.config, "profiler_max_stacks", 20_000) or 20_000
+            ):
+                self._profile_samples_dropped += n
+                continue
+            self._profile_samples[key] = (cur or 0) + n
         logs = batch.get("logs")
         if logs:
             try:
@@ -5790,6 +5961,61 @@ class Scheduler:
             "(elapsed > factor x p95 of the function's runtimes)",
             {lk(): self._straggler_count},
         )
+        add(
+            "ray_tpu_traces_indexed",
+            "gauge",
+            "traces in the bounded recent-trace index (request tracing)",
+            {lk(): len(self._trace_index)},
+        )
+        add(
+            "ray_tpu_profiler_stacks",
+            "gauge",
+            "distinct (task, stack) aggregation slots held by the "
+            "continuous profiler",
+            {lk(): len(self._profile_samples)},
+        )
+        add(
+            "ray_tpu_profiler_samples_total",
+            "counter",
+            "stack samples aggregated by the continuous profiler",
+            {lk(): sum(self._profile_samples.values())},
+        )
+        add(
+            "ray_tpu_profiler_dropped_total",
+            "counter",
+            "profiler samples dropped at the stack-slot bound",
+            {lk(): self._profile_samples_dropped},
+        )
+        # per-job sliding-window latency quantiles; the slowest samples'
+        # trace ids ride a companion exemplar series so a slow bucket links
+        # straight to `ray_tpu trace <id>`
+        lat_q: Dict[str, float] = {}
+        lat_ex: Dict[str, float] = {}
+        for job, win in self._job_latency.items():
+            snap = win.snapshot()
+            if not snap.get("count"):
+                continue
+            for q in ("p50", "p95", "p99"):
+                if snap.get(q) is not None:
+                    lat_q[lk(job=job, quantile=q)] = snap[q]
+            for ex in snap.get("exemplars") or ():
+                lat_ex[lk(job=job, trace_id=ex["trace_id"])] = ex["latency_ms"]
+        if lat_q:
+            add(
+                "ray_tpu_job_latency_ms",
+                "gauge",
+                "sliding-window end-to-end task latency per job "
+                f"(window {getattr(self.config, 'latency_window_s', 60.0):g}s)",
+                lat_q,
+            )
+        if lat_ex:
+            add(
+                "ray_tpu_job_latency_exemplar_ms",
+                "gauge",
+                "slowest in-window task latencies with their trace ids "
+                "(feed the id to `ray_tpu trace`)",
+                lat_ex,
+            )
         add(
             "ray_tpu_cluster_events_total",
             "counter",

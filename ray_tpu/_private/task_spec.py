@@ -84,6 +84,12 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # streaming generator
     is_streaming: bool = False
+    # tracing plane: the task's own (trace_id, span_id, parent_id), minted
+    # at submission (util/tracing.for_submission) so head-side lifecycle
+    # events and worker-side execution events share one span; None=untraced.
+    # A dedicated field (not the runtime_env side channel) so tracing never
+    # forces the runtime-env apply path in the worker.
+    trace_ctx: Optional[Tuple[str, str, Optional[str]]] = None
 
     # positional state (see Arg): specs are the bulk of control-plane bytes
     _STATE_FIELDS = (
@@ -108,6 +114,9 @@ class TaskSpec:
         "scheduling_strategy",
         "runtime_env",
         "is_streaming",
+        # appended last: blobs pickled by older builds unpickle with
+        # trace_ctx falling back to the class default (None)
+        "trace_ctx",
     )
 
     def __getstate__(self):

@@ -84,6 +84,10 @@ class TelemetryBuffer:
         # name -> (kind, description, data snapshot): last writer wins, so
         # N records within one interval flush as ONE write per metric
         self._metrics: Dict[str, Tuple[str, str, dict]] = {}
+        # continuous-profiler stack samples, pre-aggregated per process:
+        # (task_id, trace_id, stack) -> count. Bounded by the same capacity;
+        # overflow increments the shared dropped counters
+        self._samples: Dict[Tuple, int] = {}
         self._dropped_pending = 0  # reported (and reset) with the next batch
         self._dropped_total = 0  # cumulative, for local inspection/tests
         self._flushes = 0
@@ -140,6 +144,21 @@ class TelemetryBuffer:
         with self._lock:
             self._metrics[name] = (kind, description, data)
 
+    def record_samples(self, counts: Dict[Tuple, int]) -> None:
+        """Merge one sampler sweep's (task, trace, stack) -> count map."""
+        with self._lock:
+            samples = self._samples
+            cap = self._capacity()
+            for key, n in counts.items():
+                cur = samples.get(key)
+                if cur is None and len(samples) >= cap:
+                    # count every dropped SAMPLE, not just the key — matches
+                    # the scheduler-side accounting in _ingest_telemetry
+                    self._dropped_pending += n
+                    self._dropped_total += n
+                    continue
+                samples[key] = (cur or 0) + n
+
     @property
     def dropped_total(self) -> int:
         return self._dropped_total
@@ -158,6 +177,7 @@ class TelemetryBuffer:
                 or self._logs
                 or self._cluster_events
                 or self._metrics
+                or self._samples
                 or self._dropped_pending
             ):
                 return None
@@ -169,6 +189,10 @@ class TelemetryBuffer:
                 collections.deque(),
             )
             metrics, self._metrics = dict(self._metrics), {}
+            samples, self._samples = (
+                [(k, v) for k, v in self._samples.items()],
+                {},
+            )
             dropped, self._dropped_pending = self._dropped_pending, 0
         return {
             "pid": os.getpid(),
@@ -177,6 +201,7 @@ class TelemetryBuffer:
             "logs": logs,
             "cluster_events": cluster_events,
             "metrics": metrics,
+            "samples": samples,
             "dropped": dropped,
         }
 
@@ -196,6 +221,9 @@ class TelemetryBuffer:
             + len(batch["spans"])
             + len(batch["logs"])
             + len(batch["cluster_events"])
+            # per-SAMPLE, not per-stack-key (matches record_samples and the
+            # scheduler-side accounting)
+            + sum(n for _k, n in batch.get("samples") or ())
             + batch["dropped"]
         )
         with self._lock:
@@ -231,6 +259,14 @@ class TelemetryBuffer:
                 self.flush()
             except Exception:
                 pass  # telemetry must never take a process down
+            try:
+                # once user code has imported jax, start recording
+                # jax:<event> compile/execute spans (cheap sys.modules probe)
+                from ray_tpu._private import sampler as _sampler
+
+                _sampler.maybe_install_jax_hooks()
+            except Exception:
+                pass
 
 
 def _send_batch(batch: dict) -> bool:
@@ -332,9 +368,98 @@ def guess_severity(line: str, stream: str) -> str:
     return "ERROR" if stream == "stderr" and "Error" in line else "INFO"
 
 
+def record_samples(counts: Dict[Tuple, int]) -> None:
+    """Merge one profiler sweep's (task, trace, stack) -> count map into the
+    batch pipeline (continuous-profiling plane)."""
+    if not counts or not enabled():
+        return
+    _buffer.record_samples(counts)
+    _buffer.ensure_flusher()
+
+
 def flush() -> bool:
     """Synchronously flush this process's buffer (read paths, shutdown)."""
     return _buffer.flush()
+
+
+# --------------------------------------------------------------------------
+# sliding-window latency quantiles with exemplar trace ids
+# --------------------------------------------------------------------------
+
+
+class LatencyWindow:
+    """Bounded sliding window of (ts, latency_ms, trace_id) samples.
+
+    Backs the per-job and per-deployment p50/p95/p99 series: quantiles are
+    computed at READ time over samples newer than ``window_s``, and the
+    slowest samples keep their trace ids as exemplars — a slow bucket links
+    straight to ``ray_tpu.trace(trace_id)``. Appends are O(1) under a small
+    lock (request/finish hot paths); reads are O(n log n) on n <= max_samples.
+    """
+
+    __slots__ = ("_window_s", "_max", "_samples", "_lock", "count", "sum_ms")
+
+    def __init__(self, window_s: float = 60.0, max_samples: int = 4096):
+        self._window_s = float(window_s)
+        self._max = int(max_samples)
+        self._samples: collections.deque = collections.deque(maxlen=self._max)
+        self._lock = threading.Lock()
+        self.count = 0  # lifetime observations (not just the window)
+        self.sum_ms = 0.0
+
+    def observe(self, latency_ms: float, trace_id: Optional[str] = None,
+                ts: Optional[float] = None) -> None:
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            self._samples.append((ts, float(latency_ms), trace_id))
+            self.count += 1
+            self.sum_ms += float(latency_ms)
+
+    def _live(self) -> List[Tuple[float, float, Optional[str]]]:
+        cutoff = time.time() - self._window_s
+        with self._lock:
+            return [s for s in self._samples if s[0] >= cutoff]
+
+    def snapshot(self, exemplars: int = 3) -> dict:
+        """{count, p50, p95, p99, max, exemplars: [{trace_id, latency_ms}]}
+        over the live window ({} quantiles when empty)."""
+        live = self._live()
+        out = {
+            "window_s": self._window_s,
+            "count": len(live),
+            "total_count": self.count,
+        }
+        if not live:
+            out.update({"p50": None, "p95": None, "p99": None, "max": None,
+                        "exemplars": []})
+            return out
+        vals = sorted(s[1] for s in live)
+
+        def q(p: float) -> float:
+            i = min(len(vals) - 1, max(0, int(round(p * (len(vals) - 1)))))
+            return round(vals[i], 3)
+
+        out.update({"p50": q(0.50), "p95": q(0.95), "p99": q(0.99),
+                    "max": round(vals[-1], 3)})
+        slowest = sorted(live, key=lambda s: s[1], reverse=True)
+        out["exemplars"] = [
+            {"trace_id": s[2], "latency_ms": round(s[1], 3)}
+            for s in slowest[: int(exemplars)]
+            if s[2]
+        ]
+        return out
+
+    def merge_from(self, samples) -> None:
+        """Fold another window's raw (ts, ms, trace_id) samples in
+        (controller-side per-deployment aggregation over replicas)."""
+        with self._lock:
+            for s in samples:
+                self._samples.append(tuple(s))
+                self.count += 1
+                self.sum_ms += float(s[1])
+
+    def raw(self) -> List[Tuple[float, float, Optional[str]]]:
+        return self._live()
 
 
 def dropped_total() -> int:

@@ -275,6 +275,12 @@ class DriverRuntime:
                 self._direct_on_commit,
                 shared_store=True,
             )
+        # continuous sampling profiler (driver half; workers start their
+        # own from the propagated config)
+        if getattr(self.config, "telemetry_enabled", True):
+            from ray_tpu._private import sampler as _sampler
+
+            _sampler.ensure_running(self.config)
 
     # -- refs --------------------------------------------------------------
     # Ref ops post individually (no driver-side batching): a buffer would

@@ -287,15 +287,29 @@ class WorkerRuntime:
                         self._send(("stacks_reply", msg[1], format_thread_stacks()))
                     except (OSError, EOFError):
                         pass
+                elif kind == "profile":
+                    # on-demand continuous-profiler boost (request_profile):
+                    # (hz, duration_s) — applies on top of profiler_hz
+                    from ray_tpu._private import sampler as _sampler
+
+                    try:
+                        _sampler.boost(float(msg[1]), float(msg[2]))
+                    except Exception:
+                        pass
                 elif kind == "flush_telemetry":
                     # cluster-wide read-your-writes flush (timeline /
-                    # prometheus reads): drain the buffer NOW from this
-                    # reader thread — a busy task thread doesn't delay it.
-                    # The batch rides this same pipe before the ack (FIFO),
-                    # so the scheduler has merged it when the ack lands.
+                    # prometheus / profile_dump reads): drain the buffer NOW
+                    # from this reader thread — a busy task thread doesn't
+                    # delay it. The batch rides this same pipe before the
+                    # ack (FIFO), so the scheduler has merged it when the
+                    # ack lands. Pending profiler aggregates go first so
+                    # flame-graph reads see samples newer than the
+                    # sampler's ~1s sweep cadence.
+                    from ray_tpu._private import sampler as _sampler
                     from ray_tpu._private import telemetry
 
                     try:
+                        _sampler.get_sampler().drain()
                         telemetry.flush()
                         self._send(("telemetry_ack", msg[1]))
                     except (OSError, EOFError):
@@ -338,6 +352,7 @@ class WorkerRuntime:
                 continue
             mv = self.store.get(oid, timeout=0)
             if mv is not None:
+                self._acct_fetch("shm", mv.nbytes)
                 out[oid] = self.serde.deserialize_from(mv)
                 errs[oid] = False
                 continue
@@ -428,6 +443,7 @@ class WorkerRuntime:
                     for oid in list(pending):
                         mv = self.store.get(oid, timeout=0)
                         if mv is not None:
+                            self._acct_fetch("shm", mv.nbytes)
                             out[oid] = self.serde.deserialize_from(mv)
                             errs[oid] = False
                             pending.discard(oid)
@@ -471,6 +487,7 @@ class WorkerRuntime:
         """Returns (value, is_error); error-ness from the entry kind only."""
         kind = entry[0]
         if kind == "inline":
+            self._acct_fetch("inline", len(entry[1]))
             return self.serde.deserialize_from(memoryview(entry[1])), False
         if kind == "error":
             err = pickle.loads(entry[1])
@@ -483,6 +500,7 @@ class WorkerRuntime:
             # first, then poll the local store while periodically asking the
             # scheduler to transfer — or lineage-reconstruct — it
             deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
+            path = "shm"
             mv = self.store.get(oid, timeout=0.05)
             if mv is None and len(entry) > 1:
                 # zero-copy dirs rode the pull reply: map the peer store now
@@ -491,9 +509,12 @@ class WorkerRuntime:
                 for d in entry[1]:
                     mv = read_peer_pinned(d, oid)
                     if mv is not None:
+                        path = "shm_peer"
                         break
             if mv is None:
                 mv = self._read_same_host_peer(oid)
+                if mv is not None:
+                    path = "shm_peer"
             while mv is None:
                 if time.monotonic() >= deadline or self._stopped.is_set():
                     return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
@@ -501,9 +522,13 @@ class WorkerRuntime:
                     self.rpc("ensure_local", oid)
                 except Exception:
                     pass
+                # landed via the scheduler's transfer plane: a socket copy
+                # or a spill restore, not a pre-resident shm hit
+                path = "transfer"
                 mv = self.store.get(oid, timeout=2.0)
                 if mv is None:
                     mv = self._read_same_host_peer(oid)
+            self._acct_fetch(path, mv.nbytes)
             return self.serde.deserialize_from(mv), False
         return exc.RayTpuError(f"bad entry {kind}"), True
 
@@ -716,6 +741,15 @@ class WorkerRuntime:
 
     # -- execution ---------------------------------------------------------
 
+    def _acct_fetch(self, path: str, nbytes: int) -> None:
+        """Attribute fetched argument bytes to a transfer path (shm / peer
+        shm / inline / socket-or-spill transfer) for the tracing plane's
+        arg_fetch stage. No-op outside a _resolve_args window."""
+        st = getattr(self._tls, "fetch_acct", None)
+        if st is not None:
+            st["bytes"] += nbytes
+            st["paths"][path] = st["paths"].get(path, 0) + nbytes
+
     def _resolve_args(self, spec: TaskSpec):
         ref_ids = [
             a.object_id
@@ -724,7 +758,18 @@ class WorkerRuntime:
         ]
         values: Dict[ObjectID, Any] = {}
         if ref_ids:
-            resolved = self.get_objects(ref_ids)
+            stages = getattr(self._tls, "stages", None)
+            acct = {"bytes": 0, "paths": {}}
+            self._tls.fetch_acct = acct if stages is not None else None
+            t0 = time.perf_counter()
+            try:
+                resolved = self.get_objects(ref_ids)
+            finally:
+                if stages is not None:
+                    stages["arg_fetch_ms"] = (time.perf_counter() - t0) * 1e3
+                    stages["arg_bytes"] = acct["bytes"]
+                    stages["arg_paths"] = acct["paths"]
+                self._tls.fetch_acct = None
             values = dict(zip(ref_ids, resolved))
 
         def mat(a: Arg):
@@ -736,9 +781,15 @@ class WorkerRuntime:
 
         args = [mat(a) for a in spec.args]
         kwargs = {k: mat(a) for k, a in spec.kwargs.items()}
+        stages = getattr(self._tls, "stages", None)
+        if stages is not None:
+            # user-code execution is measured from here (args materialized)
+            stages["_args_done"] = time.perf_counter()
         return args, kwargs
 
     def _store_results(self, spec: TaskSpec, value: Any) -> List[Tuple]:
+        stages = getattr(self._tls, "stages", None)
+        t_put0 = time.perf_counter()
         if spec.num_returns == 1:
             values = [value]
         elif spec.num_returns == 0:
@@ -751,11 +802,13 @@ class WorkerRuntime:
                     f"but returned {len(values)} values"
                 )
         out = []
+        total_size = 0
         for i, v in enumerate(values):
             # serialize once; large values are written straight into the
             # store buffer (single copy)
             pickled, buffers = self.serde.serialize(v)
             size = self.serde.serialized_size(pickled, buffers)
+            total_size += size
             if size <= self.config.max_direct_call_object_size:
                 buf = bytearray(size)
                 self.serde.write_to(pickled, buffers, memoryview(buf))
@@ -776,6 +829,9 @@ class WorkerRuntime:
                     out.append(
                         ("error", pickle.dumps(exc.ObjectStoreFullError(f"{size} bytes")))
                     )
+        if stages is not None:
+            stages["result_put_ms"] = (time.perf_counter() - t_put0) * 1e3
+            stages["result_bytes"] = total_size
         return out
 
     def _apply_runtime_env(self, spec: TaskSpec):
@@ -798,13 +854,32 @@ class WorkerRuntime:
         span_cm = None
         from ray_tpu.util import tracing as _tracing
 
+        # per-task stage attribution (tracing plane): _resolve_args /
+        # _store_results / the streaming loop fill this in; run_one ships it
+        # on the FINISHED event so ray_tpu.trace() can decompose the span
+        self._tls.stages = {}
         try:
-            # adopt the caller's trace context (span tree across processes;
-            # parity: tracing_helper extract on the execution side). Inside
-            # the try: a malformed user-supplied _trace_ctx must surface as a
-            # TaskError, like any other runtime_env failure.
-            trace_ctx = _tracing.extract_and_activate(spec.runtime_env)
-            if trace_ctx is not None:
+            # adopt the task's submission-minted span as this thread's
+            # context (span tree across processes; parity: tracing_helper
+            # extract on the execution side). Inside the try: a malformed
+            # user-supplied _trace_ctx must surface as a TaskError, like any
+            # other runtime_env failure.
+            trace_ctx = _tracing.activate_from_spec(spec)
+            # profiler attribution: samples taken on this thread while the
+            # task runs land on (task_id, trace_id)
+            from ray_tpu._private import sampler as _sampler
+
+            _sampler.note_thread_task(
+                spec.task_id.hex(),
+                trace_ctx.trace_id if trace_ctx is not None else None,
+            )
+            if trace_ctx is not None and trace_ctx.verbose:
+                # legacy explicit-tracing mode (enable_tracing()): keep the
+                # per-task PROFILE wrapper span the chrome timeline's flow
+                # links anchor on. Default-on tracing skips it — lifecycle
+                # events carry the span ids, and ray_tpu.trace() is the
+                # span-tree view — saving one telemetry span per task on
+                # the small-task hot path (overhead-ratio budget 1.05).
                 from ray_tpu._private import profiling as _prof
 
                 span_cm = _prof.profile(
@@ -827,6 +902,7 @@ class WorkerRuntime:
                 cls = cloudpickle.loads(spec.function)
                 args, kwargs = self._resolve_args(spec)
                 self._actor_instance = cls(*args, **kwargs)
+                self._note_execute_done()
                 self._actor_id = spec.actor_id
                 return [("inline", self.serde.serialize_to_bytes(None))]
             if spec.task_type == TaskType.ACTOR_TASK:
@@ -843,6 +919,7 @@ class WorkerRuntime:
                     return []
                 method = getattr(self._actor_instance, method_name)
                 result = method(*args, **kwargs)
+                self._note_execute_done()
             else:
                 fn = self._fn_cache.get(spec.function)
                 if fn is None:
@@ -852,12 +929,20 @@ class WorkerRuntime:
                     self._fn_cache[spec.function] = fn
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
+                self._note_execute_done()
             if spec.is_streaming:
                 # streaming generator: report items as they are produced
                 # (parity: HandleReportGeneratorItemReturns, task_manager.h:355)
                 reply = getattr(self._tls, "direct_reply", None)
+                stages = getattr(self._tls, "stages", None) or {}
+                t_stream0 = time.perf_counter()
+                yield_ms = 0.0
                 count = 0
                 for item in result:
+                    t_item = time.perf_counter()
+                    if count == 0 and stages is not None:
+                        # TTFT: generator entry -> first item produced
+                        stages["first_yield_ms"] = (t_item - t_stream0) * 1e3
                     blob = self.serde.serialize_to_bytes(item)
                     entry = (
                         ("inline", blob)
@@ -889,6 +974,15 @@ class WorkerRuntime:
                     else:
                         self._send(("generator_item", spec.task_id, count + 1, entry))
                     count += 1
+                    yield_ms += (time.perf_counter() - t_item) * 1e3
+                if stages is not None:
+                    stages["stream_items"] = count
+                    # serialize+commit+send cost of yielded items; the
+                    # remainder of the loop wall time is generator execution
+                    stages["stream_yield_ms"] = yield_ms
+                    stages["execute_ms"] = (
+                        (time.perf_counter() - t_stream0) * 1e3 - yield_ms
+                    )
                 return [("inline", self.serde.serialize_to_bytes(count))]
             return self._store_results(spec, result)
         except SystemExit:
@@ -923,9 +1017,22 @@ class WorkerRuntime:
                 span_cm.__exit__(None, None, None)
             if trace_ctx is not None:
                 _tracing.deactivate()
+            try:
+                from ray_tpu._private import sampler as _sampler
+
+                _sampler.note_thread_task(None, None)
+            except Exception:
+                pass
             if saved_env:
                 self._restore_env(saved_env)
             self.current_task_id = None
+
+    def _note_execute_done(self) -> None:
+        stages = getattr(self._tls, "stages", None)
+        if stages is not None and "_args_done" in stages:
+            stages["execute_ms"] = (
+                time.perf_counter() - stages.pop("_args_done")
+            ) * 1e3
 
 
 class _TeeStream:
@@ -1150,6 +1257,13 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     reader = threading.Thread(target=rt.reader_loop, name="reader", daemon=True)
     reader.start()
 
+    # continuous sampling profiler: steady-state rate from config (0 = off;
+    # the `profile` command boosts on demand either way)
+    if getattr(config, "telemetry_enabled", True):
+        from ray_tpu._private import sampler as _sampler_mod
+
+        _sampler_mod.ensure_running(config)
+
     # direct actor-call listener (this worker as CALLEE); its address rides
     # the ready message into the head's worker table for resolve_actors
     direct_server = None
@@ -1170,24 +1284,33 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
     from ray_tpu._private import telemetry
 
-    def _exec_event(spec, state: str, ts: float, duration_ms=None):
+    def _exec_event(spec, state: str, ts: float, duration_ms=None, stages=None):
         # worker-side lifecycle half of the telemetry plane: real pid +
         # wall-clock execution bounds (the scheduler only knows when it
         # SENT the task), and the only record at all for direct actor
         # calls, which never touch the head. Batched by the buffer.
-        telemetry.record_task_event(
-            {
-                "task_id": spec.task_id.hex(),
-                "name": spec.name,
-                "type": spec.task_type.name,
-                "state": state,
-                "time": ts,
-                "pid": os.getpid(),
-                "src": "worker",
-                "duration_ms": duration_ms,
-                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-            }
-        )
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "type": spec.task_type.name,
+            "state": state,
+            "time": ts,
+            "pid": os.getpid(),
+            "src": "worker",
+            "duration_ms": duration_ms,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        }
+        # tracing plane: worker events join the task's submission-minted
+        # span; the FINISHED event additionally carries the measured stage
+        # decomposition (arg_fetch/execute/result_put/stream)
+        t = spec.trace_ctx
+        if t is not None:
+            ev["trace_id"], ev["span_id"] = t[0], t[1]
+            if len(t) > 2 and t[2]:
+                ev["parent_id"] = t[2]
+        if stages:
+            ev["stages"] = stages
+        telemetry.record_task_event(ev)
 
     def run_one(item, buffer_ok=False):
         if isinstance(item, _DirectCall):
@@ -1212,11 +1335,20 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
             rt._tls.direct_reply = None
         t1 = time.time()
         failed = bool(results) and results[0][0] == "error"
+        stages = getattr(rt._tls, "stages", None)
+        rt._tls.stages = None
+        if stages:
+            stages.pop("_args_done", None)
+            stages = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in stages.items()
+            }
         _exec_event(
             spec,
             "FAILED" if failed else "FINISHED",
             t1,
             duration_ms=(t1 - t0) * 1e3,
+            stages=stages or None,
         )
         if reply is not None:
             # large returns live in this node's store: register the location
@@ -1285,6 +1417,9 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
             except Exception:
                 pass
         try:  # last telemetry batch out before the pipe closes
+            from ray_tpu._private import sampler as _sampler_mod
+
+            _sampler_mod.get_sampler().drain()
             telemetry.flush()
         except Exception:
             pass

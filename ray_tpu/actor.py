@@ -16,7 +16,7 @@ import cloudpickle
 from ray_tpu import exceptions as exc
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private.runtime_env import upload_runtime_env as _upload_runtime_env
-from ray_tpu.util.tracing import inject as _trace_inject
+from ray_tpu.util.tracing import for_submission as _trace_for_submission
 from ray_tpu._private.task_spec import SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu._private.worker import ObjectRef, ObjectRefGenerator, get_runtime, pack_args
 from ray_tpu.remote_function import resolve_resources, resolve_strategy
@@ -107,7 +107,7 @@ class ActorHandle:
             name=f"{method_name}",
             actor_id=self._actor_id,
             is_streaming=streaming,
-            runtime_env=_trace_inject(None),
+            trace_ctx=_trace_for_submission(),
         )
         rt.submit(spec)
         if streaming:
@@ -197,7 +197,8 @@ class ActorClass:
             actor_name=name,
             namespace=namespace,
             scheduling_strategy=resolve_strategy(opts),
-            runtime_env=_trace_inject(_upload_runtime_env(rt, opts.get("runtime_env"))),
+            runtime_env=_upload_runtime_env(rt, opts.get("runtime_env")),
+            trace_ctx=_trace_for_submission(),
         )
         rt.submit(spec)
         return ActorHandle(actor_id, self._method_meta(), owned=True)

@@ -103,6 +103,48 @@ def start_dashboard(port: int = 8765) -> int:
                     body = get_driver().rpc("runtime_metrics")
                 elif self.path == "/api/timeline":
                     body = ray_tpu.timeline()
+                elif urlparse(self.path).path == "/api/traces":
+                    # request-tracing plane: recent trace digests
+                    q = parse_qs(urlparse(self.path).query)
+                    body = ray_tpu.recent_traces(
+                        limit=int(q.get("limit", ["100"])[0])
+                    )
+                elif urlparse(self.path).path == "/api/trace":
+                    # one request's span tree + critical-path decomposition.
+                    # Served from already-ingested events (local flush only):
+                    # the UI re-polls this every 2s, and a cluster-wide
+                    # flush fan-out per tick would hammer every worker —
+                    # worker-side stages lag at most one telemetry interval
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = q.get("id", [""])[0]
+                    if tid:
+                        from ray_tpu._private import telemetry as _tele
+                        from ray_tpu._private.trace import build_trace
+                        from ray_tpu._private.worker import get_driver
+
+                        _tele.flush()
+                        events = get_driver().rpc("trace_events", tid)
+                        body = build_trace(events, tid).to_dict()
+                    else:
+                        body = {}
+                elif self.path == "/api/job_latency":
+                    # per-job sliding-window p50/p95/p99 + exemplar traces
+                    from ray_tpu._private.worker import get_driver
+
+                    body = get_driver().rpc("job_latency")
+                elif urlparse(self.path).path == "/api/flamegraph":
+                    # aggregated profiler samples as a speedscope document
+                    from ray_tpu._private import sampler as _sampler
+                    from ray_tpu._private.worker import get_driver
+
+                    q = parse_qs(urlparse(self.path).query)
+                    _sampler.get_sampler().drain()
+                    rows = get_driver().rpc(
+                        "profile_samples",
+                        q.get("task_id", [None])[0],
+                        q.get("trace_id", [None])[0],
+                    )
+                    body = _sampler.speedscope_document(rows)
                 elif self.path.startswith("/api/profiler/start"):
                     # device-trace capture (parity role: the reporter agent's
                     # py-spy/memray profiling endpoints; on TPU the profile of

@@ -57,8 +57,13 @@ PAGE = """<!DOCTYPE html>
 <script>
 const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
               "placement_groups","serve","jobs","logs","events","event_stats",
-              "stacks","profile"];
-let tab = location.hash.slice(1) || "overview";
+              "traces","latency","stacks","profile"];
+// hash may carry a selection suffix, e.g. "#traces:<trace_id>"
+let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
+window.addEventListener("hashchange", () => {
+  tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
+  nav();
+});
 const $ = (id) => document.getElementById(id);
 
 function nav() {
@@ -183,6 +188,52 @@ const RENDER = {
     const s = await j("/api/stacks");
     return Object.entries(s).map(([proc, txt]) =>
       `<h2>${esc(proc)}</h2><pre>${esc(txt)}</pre>`).join("");
+  },
+  async traces() {
+    // request-tracing plane: recent traces; ?id= drills into one span tree
+    const sel = location.hash.split(":")[1];
+    if (sel) {
+      const t = await j("/api/trace?id=" + sel);
+      const render = (s, depth) => {
+        const bd = Object.entries(s.breakdown||{})
+          .map(([k,v]) => `${k.replace("_ms","")}=${v}ms`).join(" ");
+        return `<div style="margin-left:${depth*18}px">` +
+          `<b>${esc(s.name||s.span_id.slice(0,8))}</b> ` +
+          `${(s.duration_ms||0).toFixed(1)}ms ` +
+          `<span class="meta">${esc(bd)}</span></div>` +
+          (s.children||[]).map(c => render(c, depth+1)).join("");
+      };
+      return `<h2>trace ${esc(t.trace_id)} — ` +
+        `${(t.duration_ms||0).toFixed(1)}ms, ${t.spans} spans</h2>` +
+        (t.tree||[]).map(r => render(r, 0)).join("") +
+        "<h2>critical path</h2>" +
+        table((t.critical_path||[]).map(r => ({
+          name: r.name, "ms": (r.duration_ms||0).toFixed(1),
+          breakdown: Object.entries(r.breakdown||{})
+            .map(([k,v]) => `${k.replace("_ms","")}=${v}`).join(" "),
+        })));
+    }
+    const rows = await j("/api/traces?limit=100");
+    if (!rows.length) return "<p>no traces recorded yet</p>";
+    return "<h2>recent traces (click to inspect)</h2>" +
+      rows.map(r =>
+        `<div><a href="#traces:${r.trace_id}" onclick="setTimeout(refresh,0)">` +
+        `${r.trace_id}</a> <b>${esc(r.root||"")}</b> ` +
+        `<span class="meta">${r.events} events, ` +
+        `${r.last_time ? ((Date.now()/1000)-r.last_time).toFixed(1) : "?"}s ago` +
+        `</span></div>`).join("");
+  },
+  async latency() {
+    // sliding-window p50/p95/p99 per job with exemplar trace links
+    const s = await j("/api/job_latency");
+    return Object.entries(s).map(([job, w]) =>
+      `<h2>job ${esc(job)} <span class="meta">(${w.count} in window)</span></h2>` +
+      table([{p50: w.p50, p95: w.p95, p99: w.p99, max: w.max}]) +
+      (w.exemplars||[]).map(e =>
+        `<p class="meta">slow: ${e.latency_ms}ms — ` +
+        `<a href="#traces:${e.trace_id}" onclick="tab='traces';nav();setTimeout(refresh,0)">${e.trace_id}</a></p>`
+      ).join("")
+    ).join("") || "<p>no samples in window</p>";
   },
   async node_stats() {
     // per-node reporter metrics (cpu/mem/object-store), heartbeat-pushed

@@ -15,7 +15,7 @@ import cloudpickle
 
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.runtime_env import upload_runtime_env as _upload_runtime_env
-from ray_tpu.util.tracing import inject as _trace_inject
+from ray_tpu.util.tracing import for_submission as _trace_for_submission
 from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu._private.worker import ObjectRef, ObjectRefGenerator, get_runtime, pack_args
 
@@ -125,8 +125,9 @@ class RemoteFunction:
                 opts.get("retry_exceptions")
             ),
             scheduling_strategy=resolve_strategy(opts),
-            runtime_env=_trace_inject(_upload_runtime_env(rt, opts.get("runtime_env"))),
+            runtime_env=_upload_runtime_env(rt, opts.get("runtime_env")),
             is_streaming=streaming,
+            trace_ctx=_trace_for_submission(),
         )
         rt.submit(spec)
         if streaming:

@@ -273,6 +273,84 @@ def cmd_timeline(args):
             print(f"  {name}: {states}")
 
 
+def cmd_trace(args):
+    """Request-tracing plane: reconstruct one request's cross-process span
+    tree and print its critical-path latency decomposition (submit ->
+    queue_wait -> dispatch -> arg_fetch -> execute -> result_put ->
+    stream_yield; TTFT for streaming serve requests)."""
+    import ray_tpu
+
+    _init(args)
+    if args.list or not args.trace_id:
+        rows = ray_tpu.recent_traces(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2))
+            return
+        if not rows:
+            print("no traces recorded (is tracing_enabled on?)")
+            return
+        print(f"{'trace_id':34} {'root':24} {'events':>6}  age")
+        now = time.time()
+        for r in rows:
+            age = now - (r.get("last_time") or now)
+            print(
+                f"{r['trace_id']:34} {str(r.get('root'))[:24]:24} "
+                f"{r.get('events', 0):>6}  {age:.1f}s ago"
+            )
+        return
+    t = ray_tpu.trace(args.trace_id)
+    if not t.span_count():
+        print(f"no events recorded for trace {args.trace_id}")
+        return
+    if args.json:
+        print(json.dumps(t.to_dict(), indent=2, default=str))
+    else:
+        print(t.summary())
+    if args.flame:
+        fmt = "collapsed" if args.flame.endswith(".txt") else "speedscope"
+        n = ray_tpu.profile_dump(
+            args.flame, format=fmt, trace_id=args.trace_id
+        )
+        print(f"wrote {fmt} flame graph ({n} profiles/lines) to {args.flame}")
+
+
+def cmd_profile(args):
+    """Continuous-profiling plane: record (boost the samplers) and export
+    collapsed-stack / speedscope flame graphs with per-task attribution."""
+    import ray_tpu
+
+    _init(args)
+    if args.profile_cmd == "record":
+        n = ray_tpu.request_profile(hz=args.hz, duration_s=args.duration)
+        print(
+            f"profiling {n} workers (+driver) at {args.hz:g}Hz for "
+            f"{args.duration:g}s"
+        )
+        time.sleep(args.duration + 0.5)
+        print("done — export with: ray_tpu profile dump -o profile.json")
+        return
+    if args.profile_cmd == "dump":
+        out = args.output or (
+            "profile.txt" if args.format == "collapsed" else "profile.json"
+        )
+        n = ray_tpu.profile_dump(
+            out, format=args.format, task_id=args.task_id,
+            trace_id=args.trace_id,
+        )
+        print(f"wrote {args.format} flame graph ({n} profiles/lines) to {out}")
+        if args.format == "speedscope":
+            print("open it at https://www.speedscope.app/")
+        return
+    if args.profile_cmd == "top":
+        from ray_tpu._private import sampler as _sampler
+        from ray_tpu._private.worker import get_runtime
+
+        _sampler.get_sampler().drain()
+        rt = get_runtime()
+        rows = rt.scheduler_rpc("profile_samples", (args.task_id, args.trace_id))
+        print(_sampler.format_sample_summary(rows))
+
+
 def _parse_quota(spec):
     """``CPU=4,memory=2e9,object_store_bytes=1e9`` → {resource: cap}."""
     if not spec:
@@ -490,6 +568,46 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=200)
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "trace",
+        help="reconstruct a request's span tree + critical-path latency "
+        "decomposition (request-tracing plane)",
+    )
+    p.add_argument(
+        "trace_id", nargs="?",
+        help="trace id (from `trace --list`, a latency exemplar, the "
+        "x-raytpu-trace-id serve header, or tracing.current_trace_id())",
+    )
+    p.add_argument("--list", action="store_true", help="list recent traces")
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--json", action="store_true")
+    p.add_argument(
+        "--flame", metavar="PATH",
+        help="also export this trace's CPU samples as a flame graph "
+        "(.txt = collapsed stacks, else speedscope JSON)",
+    )
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "profile",
+        help="continuous sampling profiler: record / dump flame graphs",
+    )
+    psub = p.add_subparsers(dest="profile_cmd", required=True)
+    ps = psub.add_parser("record", help="boost cluster-wide sampling")
+    ps.add_argument("--hz", type=float, default=99.0)
+    ps.add_argument("--duration", type=float, default=10.0)
+    ps = psub.add_parser("dump", help="export aggregated samples")
+    ps.add_argument("-o", "--output")
+    ps.add_argument(
+        "--format", choices=["speedscope", "collapsed"], default="speedscope"
+    )
+    ps.add_argument("--task-id", dest="task_id")
+    ps.add_argument("--trace-id", dest="trace_id")
+    ps = psub.add_parser("top", help="top sampled frames digest")
+    ps.add_argument("--task-id", dest="task_id", default=None)
+    ps.add_argument("--trace-id", dest="trace_id", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("ckpt", help="checkpoint plane (list/verify/gc)")
     csub = p.add_subparsers(dest="ckpt_cmd", required=True)

@@ -12,6 +12,7 @@ breaks (replica restarting / autoscaled away).
 
 from __future__ import annotations
 
+import contextlib
 import pickle
 import threading
 from multiprocessing.connection import Client, Listener
@@ -57,56 +58,29 @@ class DirectReplicaServer:
             ).start()
 
     def _serve_conn(self, conn):
+        from ray_tpu.util import tracing as _tracing
+
         try:
             while True:
-                method, args, kwargs, model_id, stream = conn.recv()
-                if method == "__ws__":
-                    # the connection becomes a dedicated bidirectional
-                    # websocket session channel; it never returns to
-                    # request/response framing. A drain rejection (or any
-                    # pre-session failure) goes back as a typed error frame
-                    # so the proxy answers the upgrade cleanly instead of
-                    # dropping the socket.
+                msg = conn.recv()
+                method, args, kwargs, model_id, stream = msg[:5]
+                # optional 6th frame element: the caller's trace context —
+                # activated for this request so replica spans join the
+                # proxy's trace (frames from older proxies simply lack it)
+                ctx = None
+                if len(msg) > 5 and msg[5]:
                     try:
-                        self._replica.handle_websocket(conn, args[0])
-                    except Exception as e:  # noqa: BLE001
-                        try:
-                            blob = cloudpickle.dumps(e)
-                        except Exception:
-                            blob = pickle.dumps(RuntimeError(str(e)))
-                        try:
-                            conn.send(("err", blob))
-                        except (OSError, BrokenPipeError):
-                            pass
-                    return
-                try:
-                    # the ("started", None) frame is the replica-side
-                    # started-marker: a channel that breaks BEFORE the proxy
-                    # saw it provably never executed this request (safe to
-                    # retry elsewhere); a break after it is torn work.
-                    # Draining rejections are checked first so they are
-                    # never marked started.
-                    if getattr(self._replica, "_draining", False):
-                        self._replica._reject_if_draining()
-                    if stream:
-                        conn.send(("started", None))
-                        for item in self._replica.handle_request_streaming(
-                            method, args, kwargs, model_id
-                        ):
-                            conn.send(("item", item))
-                        conn.send(("end", None))
-                    else:
-                        conn.send(("started", None))
-                        result = self._replica.handle_request(
-                            method, args, kwargs, model_id
-                        )
-                        conn.send(("ok", result))
-                except Exception as e:  # noqa: BLE001
-                    try:
-                        blob = cloudpickle.dumps(e)
+                        ctx = _tracing.TraceContext.from_dict(msg[5])
                     except Exception:
-                        blob = pickle.dumps(RuntimeError(str(e)))
-                    conn.send(("err", blob))
+                        ctx = None
+                with _tracing.scope(ctx) if ctx is not None else (
+                    contextlib.nullcontext()
+                ):
+                    done = self._serve_one(
+                        conn, method, args, kwargs, model_id, stream
+                    )
+                if done:
+                    return
         except (EOFError, OSError, BrokenPipeError):
             pass
         finally:
@@ -114,6 +88,58 @@ class DirectReplicaServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_one(self, conn, method, args, kwargs, model_id, stream) -> bool:
+        """Handle one framed request; True = the connection is consumed
+        (websocket sessions never return to request/response framing)."""
+        if method == "__ws__":
+            # the connection becomes a dedicated bidirectional
+            # websocket session channel; it never returns to
+            # request/response framing. A drain rejection (or any
+            # pre-session failure) goes back as a typed error frame
+            # so the proxy answers the upgrade cleanly instead of
+            # dropping the socket.
+            try:
+                self._replica.handle_websocket(conn, args[0])
+            except Exception as e:  # noqa: BLE001
+                try:
+                    blob = cloudpickle.dumps(e)
+                except Exception:
+                    blob = pickle.dumps(RuntimeError(str(e)))
+                try:
+                    conn.send(("err", blob))
+                except (OSError, BrokenPipeError):
+                    pass
+            return True
+        try:
+            # the ("started", None) frame is the replica-side
+            # started-marker: a channel that breaks BEFORE the proxy
+            # saw it provably never executed this request (safe to
+            # retry elsewhere); a break after it is torn work.
+            # Draining rejections are checked first so they are
+            # never marked started.
+            if getattr(self._replica, "_draining", False):
+                self._replica._reject_if_draining()
+            if stream:
+                conn.send(("started", None))
+                for item in self._replica.handle_request_streaming(
+                    method, args, kwargs, model_id
+                ):
+                    conn.send(("item", item))
+                conn.send(("end", None))
+            else:
+                conn.send(("started", None))
+                result = self._replica.handle_request(
+                    method, args, kwargs, model_id
+                )
+                conn.send(("ok", result))
+        except Exception as e:  # noqa: BLE001
+            try:
+                blob = cloudpickle.dumps(e)
+            except Exception:
+                blob = pickle.dumps(RuntimeError(str(e)))
+            conn.send(("err", blob))
+        return False
 
     def close(self):
         self._stop = True
@@ -177,12 +203,23 @@ class DirectChannel:
             self.close()
             raise _ChannelBroken(str(e)) from e
 
+    @staticmethod
+    def _ctx_frame():
+        """The caller's trace context as the frame's optional 6th element
+        (None when untraced) — replica spans join the proxy's span tree."""
+        from ray_tpu.util.tracing import context_args
+
+        return context_args() or None
+
     def call(self, method: str, args, kwargs, model_id: str = "", timeout=None):
         timeout = timeout or self.CALL_TIMEOUT_S
         started = False
         with self._lock:
             try:
-                self._send((method, list(args), dict(kwargs), model_id, False))
+                self._send(
+                    (method, list(args), dict(kwargs), model_id, False,
+                     self._ctx_frame())
+                )
                 kind, payload = self._recv(timeout)
                 if kind == "started":
                     started = True
@@ -204,7 +241,10 @@ class DirectChannel:
         items_sent = 0
         with self._lock:
             try:
-                self._send((method, list(args), dict(kwargs), model_id, True))
+                self._send(
+                    (method, list(args), dict(kwargs), model_id, True,
+                     self._ctx_frame())
+                )
                 while True:
                     try:
                         kind, payload = self._recv(self.STREAM_FRAME_TIMEOUT_S)

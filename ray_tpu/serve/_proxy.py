@@ -18,9 +18,11 @@ an asyncio HTTP/1.1 server runs inside the actor (no extra deps) with:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Dict, Optional, Tuple
@@ -59,6 +61,8 @@ class _NoRouteError(Exception):
 
 def _error_body(status: int, message: str) -> Tuple[int, bytes, str]:
     return status, json.dumps({"error": message}).encode(), "application/json"
+
+
 
 
 def _retry_after_headers(e: DeploymentOverloadedError) -> Dict[str, str]:
@@ -230,9 +234,11 @@ class HTTPProxy:
             )
         loop = asyncio.get_running_loop()
         extra_headers = None
+        ctx = self._mint_trace()
         try:
             status, blob, ctype = await loop.run_in_executor(
-                self._pool, self._call_plain, app, headers, body
+                self._pool, self._call_plain_traced, app, path, headers, body,
+                ctx,
             )
         except DeploymentOverloadedError as e:
             # load shedding: fast 503 + Retry-After instead of queueing the
@@ -243,8 +249,37 @@ class HTTPProxy:
             status, blob, ctype = _error_body(504, str(e))
         except Exception as e:  # noqa: BLE001
             status, blob, ctype = _error_body(500, str(e))
+        if ctx is not None:
+            # the request's trace id rides the response so a slow call can
+            # be inspected with `ray_tpu trace <id>` directly
+            extra_headers = dict(extra_headers or {})
+            extra_headers["x-raytpu-trace-id"] = ctx.trace_id
         await self._write_simple(writer, status, blob, ctype, keep, extra_headers)
         return True
+
+    @staticmethod
+    def _mint_trace():
+        """Root trace context for one proxy request (the serve-plane entry
+        point); None when tracing is off."""
+        from ray_tpu.util import tracing
+
+        return tracing.new_root() if tracing.tracing_enabled() else None
+
+    def _call_plain_traced(self, app, path, headers, body, ctx):
+        """Pool-side wrapper: activate the request's root context and record
+        the proxy span (status + handle/replica sections nest under it)."""
+        if ctx is None:
+            return self._call_plain(app, headers, body)
+        from ray_tpu._private.profiling import traced_section
+        from ray_tpu.util import tracing
+
+        with tracing.scope(ctx):
+            with traced_section(
+                f"serve:proxy:{path}", {"app": app, "entry": "http"}
+            ) as sx:
+                status, blob, ctype = self._call_plain(app, headers, body)
+                sx["status"] = status
+                return status, blob, ctype
 
     def _match(self, path: str) -> Optional[str]:
         for prefix, app in sorted(self.routes.items(), key=lambda kv: -len(kv[0])):
@@ -365,45 +400,75 @@ class HTTPProxy:
                     return False
             return False
 
+        ctx = self._mint_trace()
+
         def pump():
+            from ray_tpu._private.profiling import traced_section
             from ray_tpu.serve._direct import _DirectUnavailable
+            from ray_tpu.util import tracing
 
             try:
-                pool = self._direct.get(app)
-                if pool is not None:
-                    forwarded = False
-                    try:
-                        for event in pool.call_streaming(
-                            "__asgi__", (scope, body), {}
-                        ):
-                            forwarded = True
-                            if not put(event):
-                                return  # client gone; channel cleans itself
-                        put(None)
-                        return
-                    except _DirectUnavailable:
-                        if forwarded:
-                            raise  # mid-stream break: don't replay chunks
-                        # nothing sent yet: fall through to the handle path
-                handle = self._stream_handles[app]
-                for event in handle._call("__asgi__", (scope, body), {}):
-                    if not put(event):
-                        return
-                put(None)
+                with tracing.scope(ctx), traced_section(
+                    f"serve:proxy:{path}", {"app": app, "entry": "asgi"}
+                ) if ctx is not None else contextlib.nullcontext({}) as sx:
+                    import time as _time
+
+                    t0 = _time.perf_counter()
+                    sent = 0
+
+                    def fwd(event) -> bool:
+                        nonlocal sent
+                        if sent == 0 and ctx is not None:
+                            # TTFT: request in -> first response event out
+                            sx["ttft_ms"] = round(
+                                (_time.perf_counter() - t0) * 1e3, 3
+                            )
+                        sent += 1
+                        return put(event)
+
+                    pool = self._direct.get(app)
+                    if pool is not None:
+                        forwarded = False
+                        try:
+                            for event in pool.call_streaming(
+                                "__asgi__", (scope, body), {}
+                            ):
+                                forwarded = True
+                                if not fwd(event):
+                                    return  # client gone; channel cleans up
+                            put(None)
+                            return
+                        except _DirectUnavailable:
+                            if forwarded:
+                                raise  # mid-stream break: don't replay chunks
+                            # nothing sent yet: fall through to handle path
+                    handle = self._stream_handles[app]
+                    for event in handle._call("__asgi__", (scope, body), {}):
+                        if not fwd(event):
+                            return
+                    put(None)
             except BaseException as e:  # noqa: BLE001
                 put(e)
 
         self._pool.submit(pump)
+        extra_headers = (
+            {"x-raytpu-trace-id": ctx.trace_id} if ctx is not None else None
+        )
         try:
-            return await self._write_asgi_response(writer, q, keep)
+            return await self._write_asgi_response(
+                writer, q, keep, extra_headers
+            )
         finally:
             cancelled.set()
 
-    async def _write_asgi_response(self, writer, q, keep) -> bool:
+    async def _write_asgi_response(self, writer, q, keep,
+                                   extra_headers=None) -> bool:
         first = await q.get()
         if first is None or isinstance(first, BaseException):
             msg = str(first) if first is not None else "empty ASGI response"
-            await self._write_simple(writer, *_error_body(500, msg), keep)
+            await self._write_simple(
+                writer, *_error_body(500, msg), keep, extra_headers
+            )
             return True
         _, status, hdr_pairs = first
         # peek the next event to choose Content-Length vs chunked
@@ -413,6 +478,8 @@ class HTTPProxy:
             for k, v in hdr_pairs
             if k.lower() not in (b"content-length", b"transfer-encoding", b"connection")
         ]
+        for k, v in (extra_headers or {}).items():
+            hdr_lines.append(f"{k}: {v}\r\n")
         conn_line = f"Connection: {'keep-alive' if keep else 'close'}\r\n"
         head = f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n" + "".join(hdr_lines)
         bodiless = second is None  # start followed by end: 204/304 pattern
@@ -542,11 +609,19 @@ class HTTPProxy:
                     return False
             return False
 
+        # session root span: minted here (not in the pump thread) so the 101
+        # response can carry the trace id and the session span records below
+        ws_ctx = self._mint_trace()
+        ws_t0 = time.time()
+
         def pump_down():
             import pickle as _pickle
 
             try:
-                conn.send(("__ws__", [scope], {}, "", True))
+                conn.send(
+                    ("__ws__", [scope], {}, "", True,
+                     ws_ctx.to_dict() if ws_ctx is not None else None)
+                )
                 while True:
                     kind, payload = conn.recv()
                     if kind == "evt":
@@ -599,6 +674,10 @@ class HTTPProxy:
                 sub = first.get("subprotocol")
                 if sub:
                     extra.append(f"Sec-WebSocket-Protocol: {sub}\r\n")
+                if ws_ctx is not None:
+                    # the session's trace id rides the upgrade response so
+                    # a slow websocket can be fed to `ray_tpu trace <id>`
+                    extra.append(f"x-raytpu-trace-id: {ws_ctx.trace_id}\r\n")
                 writer.write(
                     (
                         "HTTP/1.1 101 Switching Protocols\r\n"
@@ -717,6 +796,29 @@ class HTTPProxy:
                 conn.close()
             except OSError:
                 pass
+            if ws_ctx is not None:
+                # session span: the trace's proxy entry node (replica-side
+                # spans and nested submissions parent to it), duration =
+                # whole websocket session
+                try:
+                    import os as _os
+
+                    from ray_tpu._private import telemetry as _telemetry
+
+                    end = time.time()
+                    _telemetry.record_span(
+                        {
+                            "event": f"serve:proxy:ws:{path}",
+                            "start": ws_t0,
+                            "end": end,
+                            "duration_ms": (end - ws_t0) * 1e3,
+                            "pid": _os.getpid(),
+                            "extra": {"app": app, "entry": "websocket",
+                                      **ws_ctx.to_dict()},
+                        }
+                    )
+                except Exception:
+                    pass
         return False
 
     # -- control -----------------------------------------------------------

@@ -40,7 +40,9 @@ def _replica_metrics() -> dict:
         _metrics["latency"] = Histogram(
             "ray_tpu_serve_request_latency_ms",
             "end-to-end request execution latency per deployment",
-            boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000],
+            # default sub-ms..10s grid (metrics.DEFAULT_HISTOGRAM_BOUNDARIES)
+            # so fast direct-path requests resolve; override per metric via
+            # configure_histogram_boundaries or RAY_TPU_HIST_BUCKETS_*
             tag_keys=("deployment", "method"),
         )
         _metrics["requests"] = Counter(
@@ -186,7 +188,11 @@ class Replica:
             fn(user_config)
         return True
 
-    def _enter(self, model_id: str):
+    def _enter(self, model_id: str) -> float:
+        """Admit one request; returns the replica-queue wait in ms (time
+        spent gated behind max_ongoing — the serve span's queue stage)."""
+        import time as _time
+
         with self._ongoing_lock:
             # checked under the SAME lock prepare_drain flips the flag
             # under: a request either counts in num_ongoing before the
@@ -199,8 +205,11 @@ class Replica:
             self._ongoing += 1
             depth = self._ongoing
         self._record_depth(depth)
+        t0 = _time.perf_counter()
         self._gate.acquire()
+        queue_wait_ms = (_time.perf_counter() - t0) * 1e3
         _request_ctx.multiplexed_model_id = model_id
+        return queue_wait_ms
 
     def _exit(self):
         self._gate.release()
@@ -226,6 +235,32 @@ class Replica:
             m["requests"].inc(tags=tags)
         except Exception:
             pass
+        try:
+            # sliding-window sample with the request's trace id as exemplar
+            # (aggregated per-deployment by the controller)
+            from ray_tpu.util.tracing import current_trace_id
+
+            win = getattr(self, "_latency_win", None)
+            if win is None:
+                from ray_tpu._private.telemetry import LatencyWindow
+                from ray_tpu._private.worker import get_runtime
+
+                window_s = float(
+                    getattr(get_runtime().config, "latency_window_s", 60.0)
+                )
+                win = self._latency_win = LatencyWindow(window_s=window_s)
+            win.observe(seconds * 1e3, current_trace_id())
+        except Exception:
+            pass
+
+    def latency_samples(self, max_n: int = 512):
+        """Raw in-window (ts, latency_ms, trace_id) samples — the
+        controller folds every replica's into the per-deployment
+        p50/p95/p99 series surfaced by serve.status()."""
+        win = getattr(self, "_latency_win", None)
+        if win is None:
+            return []
+        return win.raw()[-int(max_n):]
 
     def _record_failure(self, method: str, error: BaseException) -> None:
         """Ship a request failure into the cluster event log (forensics
@@ -277,13 +312,24 @@ class Replica:
     def handle_request(self, method: str, args: List, kwargs: Dict, model_id: str = ""):
         import time as _time
 
+        from ray_tpu._private.profiling import traced_section
+
         self._reject_if_draining()
-        self._enter(model_id)
+        queue_wait_ms = self._enter(model_id)
         t0 = _time.perf_counter()
         try:
-            if method == "__call__":
-                return self._callable(*args, **kwargs)
-            return getattr(self._callable, method)(*args, **kwargs)
+            with traced_section(
+                f"serve:replica:{self._deployment}.{method}",
+                {
+                    "deployment": self._deployment,
+                    "method": method,
+                    "replica_id": self._replica_id(),
+                    "queue_wait_ms": round(queue_wait_ms, 3),
+                },
+            ):
+                if method == "__call__":
+                    return self._callable(*args, **kwargs)
+                return getattr(self._callable, method)(*args, **kwargs)
         except BaseException as e:
             self._record_failure(method, e)
             raise
@@ -298,27 +344,47 @@ class Replica:
         streams its response events."""
         import time as _time
 
+        from ray_tpu._private.profiling import traced_section
+
         self._reject_if_draining()
-        self._enter(model_id)
+        queue_wait_ms = self._enter(model_id)
         t0 = _time.perf_counter()
         try:
-            if method == "__asgi__":
-                from ray_tpu.serve._asgi import run_asgi_request
+            with traced_section(
+                f"serve:replica:{self._deployment}.{method}",
+                {
+                    "deployment": self._deployment,
+                    "method": method,
+                    "replica_id": self._replica_id(),
+                    "queue_wait_ms": round(queue_wait_ms, 3),
+                },
+            ) as span_extras:
+                items = 0
+                if method == "__asgi__":
+                    from ray_tpu.serve._asgi import run_asgi_request
 
-                app = getattr(self._callable, "__serve_asgi_app__")
-                scope, body = args
-                for event in run_asgi_request(
-                    app, scope, body, instance=self._callable
-                ):
-                    yield event
-                return
-            fn = (
-                self._callable
-                if method == "__call__"
-                else getattr(self._callable, method)
-            )
-            for item in fn(*args, **kwargs):
-                yield item
+                    app = getattr(self._callable, "__serve_asgi_app__")
+                    scope, body = args
+                    gen = run_asgi_request(
+                        app, scope, body, instance=self._callable
+                    )
+                else:
+                    fn = (
+                        self._callable
+                        if method == "__call__"
+                        else getattr(self._callable, method)
+                    )
+                    gen = fn(*args, **kwargs)
+                for item in gen:
+                    if items == 0:
+                        # TTFT: request admitted -> first item yielded (the
+                        # streaming span's headline stage)
+                        span_extras["ttft_ms"] = round(
+                            (_time.perf_counter() - t0) * 1e3, 3
+                        )
+                    items += 1
+                    yield item
+                span_extras["stream_items"] = items
         except GeneratorExit:
             raise  # consumer stopped early: not a request failure
         except BaseException as e:

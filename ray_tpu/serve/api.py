@@ -718,6 +718,10 @@ class ServeController:
                     "target": spec["num_replicas"],
                     "health": d.get("health", "HEALTHY"),
                     "draining": draining.get((app, name), 0),
+                    # controller-aggregated per-deployment request latency
+                    # (sliding-window p50/p95/p99 across ALL replicas, with
+                    # exemplar trace ids for the slow tail)
+                    "latency": d.get("latency"),
                     # the resilience knobs, surfaced for operators
                     # (docstring: Deployment)
                     "config": _handle_config(spec),
@@ -877,6 +881,31 @@ class ServeController:
                 if depths is not None
                 else None
             )
+            # per-DEPLOYMENT latency aggregation: fold every replica's
+            # sliding-window samples (with exemplar trace ids) into one
+            # window — the per-replica histograms only tell half the story
+            try:
+                sample_refs = [r.latency_samples.remote() for r in alive]
+                all_samples = ray_tpu.get(
+                    sample_refs,
+                    timeout=max(0.5, probe_deadline - time.monotonic()),
+                )
+                from ray_tpu._private.telemetry import LatencyWindow
+                from ray_tpu._private.worker import get_runtime
+
+                win = LatencyWindow(
+                    window_s=float(
+                        getattr(
+                            get_runtime().config, "latency_window_s", 60.0
+                        )
+                    )
+                )
+                for samples in all_samples:
+                    if samples:
+                        win.merge_from(samples)
+                d["latency"] = win.snapshot()
+            except Exception:
+                pass
             # health state vs the PRE-autoscale target and BEFORE repair:
             # replica deaths are the forensics signal, an autoscale-up gap
             # is not

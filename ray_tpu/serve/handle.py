@@ -92,6 +92,37 @@ def _record_counter(name: str, deployment: str) -> None:
         pass  # metrics never fail a request
 
 
+def _trace_event(name: str, **extra) -> None:
+    """Instant span under the active trace context (retry/shed decisions —
+    the handle's routing story inside ray_tpu.trace output). No-op when
+    untraced; never fails a request."""
+    try:
+        from ray_tpu.util import tracing
+        from ray_tpu._private import telemetry
+
+        ctx = tracing.get_current_context()
+        if ctx is None:
+            return
+        now = time.time()
+        telemetry.record_span(
+            {
+                "event": name,
+                "start": now,
+                "end": now,
+                "duration_ms": 0.0,
+                "pid": __import__("os").getpid(),
+                "extra": {
+                    **extra,
+                    "trace_id": ctx.trace_id,
+                    "span_id": tracing._new_id(8),
+                    "parent_id": ctx.span_id,
+                },
+            }
+        )
+    except Exception:
+        pass
+
+
 class DeploymentResponse:
     """Future for one deployment call (parity: ``DeploymentResponse``).
 
@@ -108,6 +139,9 @@ class DeploymentResponse:
         # re-dispatch; None for bare refs (back-compat constructions)
         self._call = call
         self._attempts = 0
+        # the request's trace context: failover re-dispatches re-activate it
+        # so retried attempts land in the SAME trace
+        self._trace_ctx = None
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
@@ -132,10 +166,13 @@ class DeploymentResponse:
 
     def _redispatch(self, error: BaseException) -> None:
         """Fail over to another replica (or raise ReplicaDiedError)."""
+        from ray_tpu.util import tracing
+
         handle, method, args, kwargs, rid = self._call
-        new_ref, new_rid, new_done = handle._failover(
-            method, args, kwargs, rid, error, self._attempts
-        )
+        with tracing.scope(self._trace_ctx):
+            new_ref, new_rid, new_done = handle._failover(
+                method, args, kwargs, rid, error, self._attempts
+            )
         self._attempts += 1
         # settle the failed dispatch's outstanding slot, then track the new
         if self._on_done:
@@ -179,7 +216,7 @@ class DeploymentResponseGenerator:
     """
 
     def __init__(self, gen=None, on_done=None, *, handle=None, method=None,
-                 args=None, kwargs=None):
+                 args=None, kwargs=None, trace_ctx=None):
         # legacy positional (gen, on_done) construction still works for
         # callers that pre-dispatched; handle-driven construction enables
         # failover re-dispatch
@@ -190,18 +227,24 @@ class DeploymentResponseGenerator:
         self._method = method
         self._args = args
         self._kwargs = kwargs
+        # request trace context: every (re-)dispatch activates it so the
+        # stream's attempts all land in one trace
+        self._trace_ctx = trace_ctx
 
     def __iter__(self):
         if self._handle is None:
             yield from self._iter_legacy()
             return
+        from ray_tpu.util import tracing
+
         handle = self._handle
         item_timeout = handle._stream_item_timeout_s
         attempts = 0
         while True:
-            gen, rid, done = handle._dispatch(
-                self._method, self._args, self._kwargs, streaming=True
-            )
+            with tracing.scope(self._trace_ctx):
+                gen, rid, done = handle._dispatch(
+                    self._method, self._args, self._kwargs, streaming=True
+                )
             got_any = False
             try:
                 try:
@@ -268,6 +311,17 @@ class DeploymentResponseGenerator:
                 attempts += 1
                 handle._backoff_and_refresh(attempts)
                 _record_counter("retries", handle.deployment_name)
+                from ray_tpu.util import tracing as _tracing
+
+                with _tracing.scope(self._trace_ctx):
+                    _trace_event(
+                        "serve:retry",
+                        deployment=handle.deployment_name,
+                        method=self._method,
+                        failed_replica=rid,
+                        attempt=attempts,
+                        reason=type(e).__name__,
+                    )
 
     def _iter_legacy(self):
         try:
@@ -493,6 +547,12 @@ class DeploymentHandle:
             if emit_event:
                 self._last_shed_event = now
         _record_counter("shed", self.deployment_name)
+        _trace_event(
+            "serve:shed",
+            deployment=self.deployment_name,
+            load=load,
+            capacity=cap,
+        )
         if emit_event:
             try:
                 from ray_tpu._private.telemetry import record_cluster_event
@@ -591,19 +651,45 @@ class DeploymentHandle:
         with self._lock:
             self._retry_count += 1
         _record_counter("retries", self.deployment_name)
+        _trace_event(
+            "serve:retry",
+            deployment=self.deployment_name,
+            method=method,
+            failed_replica=rid,
+            attempt=attempts_used + 1,
+            reason=type(error).__name__,
+        )
         return self._dispatch(method, args, kwargs)
 
     def _call(self, method: str, args, kwargs):
+        from ray_tpu.util import tracing
+
         self._maybe_refresh()
-        self._check_admission()
-        if self._stream:
-            return DeploymentResponseGenerator(
-                handle=self, method=method, args=args, kwargs=kwargs
-            )
-        ref, rid, done = self._dispatch(method, args, kwargs)
-        return DeploymentResponse(
+        # tracing entry point: a driver-side serve call with no active
+        # context roots a fresh trace (proxy requests arrive with one)
+        ctx = tracing.get_current_context()
+        if ctx is None and tracing.tracing_enabled():
+            ctx = tracing.new_root()
+        with tracing.scope(ctx):
+            self._check_admission()
+            if self._stream:
+                return DeploymentResponseGenerator(
+                    handle=self, method=method, args=args, kwargs=kwargs,
+                    trace_ctx=ctx,
+                )
+            from ray_tpu._private.profiling import traced_section
+
+            with traced_section(
+                f"serve:handle:{self.deployment_name}.{method}",
+                {"deployment": self.deployment_name, "app": self.app_name},
+            ) as sx:
+                ref, rid, done = self._dispatch(method, args, kwargs)
+                sx["replica_id"] = rid
+        resp = DeploymentResponse(
             ref, on_done=done, call=(self, method, args, kwargs, rid)
         )
+        resp._trace_ctx = ctx
+        return resp
 
     def remote(self, *args, **kwargs):
         return self._call("__call__", args, kwargs)

@@ -80,13 +80,71 @@ class Gauge(_Metric):
         self._store(self._key(tags), value)
 
 
+# default histogram grid: sub-millisecond buckets resolve dispatch-path
+# costs (direct-call send, lease grant, arg materialization live in the
+# 10us-1ms band the old [0.1, 1, 10, 100, 1000] grid lumped into one
+# bucket), still reaching 10s for slow requests. Units are whatever the
+# metric observes — for *_ms series this spans 10us .. 10s.
+DEFAULT_HISTOGRAM_BOUNDARIES: List[float] = [
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000,
+]
+
+# per-metric boundary overrides (configure_histogram_boundaries), consulted
+# at CONSTRUCTION time; env var RAY_TPU_HIST_BUCKETS_<NAME> (comma-separated
+# floats, metric name uppercased with non-alnum -> _) wins over both
+_boundary_overrides: Dict[str, List[float]] = {}
+
+
+def configure_histogram_boundaries(name: str, boundaries: List[float]) -> None:
+    """Set the bucket bounds for histograms named ``name`` created AFTER
+    this call (per-metric bucket configurability). Bounds must ascend."""
+    bounds = list(boundaries)
+    if bounds != sorted(bounds) or not bounds:
+        raise ValueError("histogram boundaries must be ascending and non-empty")
+    with _lock:
+        _boundary_overrides[name] = bounds
+
+
+def _env_boundaries(name: str) -> Optional[List[float]]:
+    import os
+    import re
+
+    key = "RAY_TPU_HIST_BUCKETS_" + re.sub(r"[^A-Za-z0-9]", "_", name).upper()
+    raw = os.environ.get(key)
+    if not raw:
+        return None
+    try:
+        bounds = [float(p) for p in raw.split(",") if p.strip()]
+        return bounds if bounds == sorted(bounds) and bounds else None
+    except ValueError:
+        return None
+
+
+def resolve_boundaries(name: str, explicit: Optional[List[float]] = None) -> List[float]:
+    """Boundary resolution order: env override > configure_histogram_
+    boundaries > constructor argument > the default grid."""
+    env = _env_boundaries(name)
+    if env is not None:
+        return env
+    with _lock:
+        override = _boundary_overrides.get(name)
+    if override is not None:
+        return list(override)
+    if explicit:
+        # preserved verbatim: int bounds render as le="1", not le="1.0"
+        return list(explicit)
+    return list(DEFAULT_HISTOGRAM_BOUNDARIES)
+
+
 class Histogram(_Metric):
     KIND = "histogram"
 
     def __init__(self, name, description="", boundaries: Optional[List[float]] = None,
                  tag_keys: Tuple[str, ...] = ()):
         super().__init__(name, description, tag_keys)
-        self._boundaries = boundaries or [0.1, 1, 10, 100, 1000]
+        self._boundaries = resolve_boundaries(name, boundaries)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = self._key(tags)

@@ -9,6 +9,7 @@ backed by the scheduler's task-event buffer and tables (the reference's
 from ray_tpu.util.state.api import (
     backlog_summary,
     get_log,
+    job_latency,
     list_actors,
     list_checkpoints,
     list_cluster_events,
@@ -18,6 +19,7 @@ from ray_tpu.util.state.api import (
     list_objects,
     list_placement_groups,
     list_tasks,
+    list_traces,
     list_workers,
     summarize_tasks,
 )
@@ -34,6 +36,8 @@ __all__ = [
     "list_cluster_events",
     "list_jobs",
     "list_logs",
+    "list_traces",
+    "job_latency",
     "get_log",
     "summarize_tasks",
 ]

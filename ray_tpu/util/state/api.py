@@ -130,6 +130,19 @@ def list_jobs(filters=None, limit: int = 10_000) -> List[dict]:
     return _list("list_jobs", filters, limit)
 
 
+def list_traces(limit: int = 100) -> List[dict]:
+    """Recent request traces (tracing plane), newest first: one digest per
+    trace id (``first_time`` / ``last_time`` / ``root`` / ``events``).
+    Drill into one with ``ray_tpu.trace(trace_id)``."""
+    return _rpc("list_traces", int(limit))
+
+
+def job_latency() -> Dict[str, dict]:
+    """Per-job sliding-window latency quantiles (p50/p95/p99 + exemplar
+    trace ids), keyed by job id hex."""
+    return _rpc("job_latency")
+
+
 def list_checkpoints(filters=None, limit: int = 10_000) -> List[dict]:
     """Checkpoints of every run registered with the checkpoint plane
     (``ray_tpu.train.checkpointing``): one row per checkpoint prefix with

@@ -346,7 +346,7 @@ def test_trace_context_propagation(ray_start_regular):
         assert outer["parent_id"] == root.span_id
         assert inner["parent_id"] == outer["span_id"]
     finally:
-        tracing.disable_tracing()
+        tracing.reset_tracing()  # back to config-driven (default-on) tracing
         tracing.deactivate()
 
 

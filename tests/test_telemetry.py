@@ -122,7 +122,7 @@ def test_trace_parent_links_nested_task_actor(ray_start_regular):
         flow_ids = {e.get("id") for e in events if e.get("ph") in ("s", "f")}
         assert inner["span_id"] in flow_ids
     finally:
-        tracing.disable_tracing()
+        tracing.reset_tracing()  # back to config-driven (default-on) tracing
         tracing.deactivate()
 
 

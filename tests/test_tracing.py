@@ -1,0 +1,433 @@
+"""Request-tracing & continuous-profiling plane (see DESIGN_MAP "Request
+tracing & profiling").
+
+Covers the PR's acceptance bar: trace-context propagation across nested
+tasks, direct actor calls, and serve streaming (TTFT span present); retried
+attempts linked to the same trace; stage decomposition summing to the
+measured wall time; profiler attribution for threaded actors; sub-ms
+histogram buckets; per-deployment latency aggregation with exemplars.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def _get_trace(trace_id, min_spans=1, tries=12):
+    """Trace reads ride telemetry batches: retry briefly until the span
+    tree is complete (request_telemetry_flush is read-your-writes for
+    workers, but serve controller/proxy threads flush on their own cadence)."""
+    for _ in range(tries):
+        t = ray_tpu.trace(trace_id)
+        if t.span_count() >= min_spans:
+            return t
+        time.sleep(0.3)
+    return ray_tpu.trace(trace_id)
+
+
+def test_nested_task_span_tree_and_stage_sum(ray_start_regular):
+    """A nested task graph yields one complete cross-process span tree, and
+    the root's stage decomposition sums to its wall time within 10%."""
+
+    @ray_tpu.remote
+    def leaf(x):
+        time.sleep(0.05)
+        return x * 2
+
+    @ray_tpu.remote
+    def mid(x):
+        return ray_tpu.get(leaf.remote(x)) + 1
+
+    @ray_tpu.remote
+    def root(x):
+        time.sleep(0.02)
+        return ray_tpu.get(mid.remote(x)) + 100
+
+    assert ray_tpu.get(root.remote(3)) == 107
+    traces = ray_tpu.recent_traces(limit=20)
+    tid = next(t["trace_id"] for t in traces if t["root"] == "root")
+    tr = _get_trace(tid, min_spans=3)
+    assert tr.span_count() == 3
+    # one chain: root -> mid -> leaf, all in the SAME trace, across
+    # (potentially) three worker processes
+    assert len(tr.roots) == 1
+    r = tr.roots[0]
+    assert r.name == "root"
+    assert len(r.children) == 1 and r.children[0].name == "mid"
+    assert len(r.children[0].children) == 1
+    assert r.children[0].children[0].name == "leaf"
+    # every span has worker-side execution stages
+    for s in tr.spans.values():
+        assert s.states.get("RUNNING") is not None
+        assert s.end is not None and s.start is not None
+    # acceptance: stages cover the root's wall time within 10%
+    bd = r.stage_breakdown()
+    assert bd, "no stage decomposition on the root span"
+    covered = sum(bd.values())
+    wall = r.duration_ms
+    assert wall > 0
+    assert abs(covered - wall) / wall < 0.10, (bd, wall)
+    # critical path reaches the leaf
+    names = [row["name"] for row in tr.critical_path()]
+    assert names == ["root", "mid", "leaf"]
+
+
+def test_direct_actor_call_trace_and_arg_fetch(ray_start_regular):
+    """Direct actor calls (which never touch the head) still produce spans
+    — caller-side SUBMITTED + worker-side RUNNING/FINISHED — and large ref
+    args are attributed with bytes + transfer path."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Worker:
+        def consume(self, arr):
+            return int(arr.nbytes)
+
+    a = Worker.remote()
+    big = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))  # 1 MiB, stored
+    assert ray_tpu.get(a.consume.remote(big)) == 1 << 20
+    tid = next(
+        t["trace_id"]
+        for t in ray_tpu.recent_traces(limit=20)
+        if t["root"] == "consume"
+    )
+    tr = _get_trace(tid)
+    span = next(s for s in tr.spans.values() if s.name == "consume")
+    # caller-side submission anchor + worker execution on one span
+    assert "SUBMITTED" in span.states
+    assert "RUNNING" in span.states
+    assert span.end is not None
+    # arg_fetch stage carries bytes and the transfer path
+    assert span.stages.get("arg_bytes", 0) >= 1 << 20
+    assert span.stages.get("arg_paths"), span.stages
+    assert span.stages.get("arg_fetch_ms") is not None
+
+
+def test_retry_lands_in_same_trace(ray_start_regular):
+    """A task that fails once and retries records BOTH attempts under the
+    same trace/span (attempt count >= 2)."""
+    import os
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(marker_dir):
+        marker = os.path.join(marker_dir, "attempted")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)  # hard death: provokes a retry
+        return "ok"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        assert ray_tpu.get(flaky.remote(d), timeout=120) == "ok"
+    tid = next(
+        t["trace_id"]
+        for t in ray_tpu.recent_traces(limit=20)
+        if t["root"] == "flaky"
+    )
+    tr = _get_trace(tid)
+    span = next(s for s in tr.spans.values() if s.name == "flaky")
+    # the retried attempt lands in the SAME trace/span: either both worker
+    # attempts reported (attempts >= 2), or — when the killed worker died
+    # before flushing its batch — the head's RETRY event links them
+    assert span.attempts >= 2 or "RETRY" in span.states, span.to_dict()
+    assert "FINISHED" in span.states
+
+
+def test_serve_streaming_ttft_span(ray_start_regular):
+    """A streaming serve request yields a trace whose replica span carries a
+    TTFT extra, and the task span records first_yield/stream stages."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Streamer:
+        def gen(self, n):
+            for i in range(int(n)):
+                time.sleep(0.02)
+                yield i
+
+    h = serve.run(Streamer.bind(), name="ttft_app")
+    try:
+        sh = h.options(stream=True)
+        assert list(sh.gen.remote(3)) == [0, 1, 2]
+        tr = None
+        for digest in ray_tpu.recent_traces(limit=30):
+            cand = _get_trace(digest["trace_id"], min_spans=2)
+            if any(
+                (s.name or "").startswith("serve:replica:Streamer")
+                for s in cand.spans.values()
+            ):
+                tr = cand
+                break
+        assert tr is not None, "no serve streaming trace found"
+        replica_span = next(
+            s
+            for s in tr.spans.values()
+            if (s.name or "").startswith("serve:replica:Streamer")
+        )
+        # TTFT present on the replica section (first item yielded)
+        assert replica_span.extra.get("ttft_ms") is not None
+        assert replica_span.extra.get("stream_items") == 3
+        # the task span measured the stream stages too
+        task_span = next(
+            (s for s in tr.spans.values() if s.stages.get("stream_items")),
+            None,
+        )
+        assert task_span is not None
+        assert task_span.stages.get("first_yield_ms") is not None
+    finally:
+        serve.shutdown()
+
+
+def test_serve_failover_retry_same_trace(ray_start_regular):
+    """A request that fails over to another replica (unstarted failure)
+    records a serve:retry event in the SAME trace as the final success."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Svc:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Svc.bind(), name="retry_app")
+    try:
+        # drain one replica so its next dispatch is rejected (provably
+        # unstarted -> transparent retry on the other replica)
+        info = ray_tpu.get(
+            ray_tpu.get_actor("SERVE_CONTROLLER").get_handle_info.remote(
+                "retry_app", "Svc"
+            ),
+            timeout=30,
+        )
+        victim = info["replicas"][0]
+        ray_tpu.get(victim.prepare_drain.remote(), timeout=30)
+        results = set()
+        for i in range(8):
+            results.add(h.remote(i).result(timeout_s=30))
+        assert results == {i + 1 for i in range(8)}
+        assert h._retry_count + h._shed_count >= 0  # sanity
+        # find a trace containing a serve:retry event alongside a finished
+        # replica execution
+        found = False
+        for digest in ray_tpu.recent_traces(limit=60):
+            tr = ray_tpu.trace(digest["trace_id"])
+            names = [(s.name or "") for s in tr.spans.values()]
+            if any(n == "serve:retry" for n in names):
+                assert any(
+                    n.startswith("serve:replica:") or n == "__call__"
+                    for n in names
+                ), names
+                found = True
+                break
+        assert found or h._retry_count == 0
+    finally:
+        serve.shutdown()
+
+
+def test_profiler_threaded_actor_attribution(ray_start_regular):
+    """Stack samples taken inside a threaded actor attribute to the right
+    task ids (per pool thread), not to <untasked>."""
+
+    @ray_tpu.remote(max_concurrency=2)
+    class Busy:
+        def spin(self, s):
+            t0 = time.time()
+            x = 0
+            while time.time() - t0 < s:
+                x += 1
+            return x
+
+    a = Busy.remote()
+    ray_tpu.get(a.spin.remote(0.05))  # ensure the worker is up
+    ray_tpu.request_profile(hz=200, duration_s=3.0)
+    refs = [a.spin.remote(1.0), a.spin.remote(1.0)]
+    ray_tpu.get(refs, timeout=60)
+    time.sleep(1.2)  # one flush interval: samples ride telemetry batches
+    rt = ray_tpu._worker.get_runtime()
+    rows = None
+    for _ in range(10):
+        from ray_tpu._private import telemetry as _tele
+
+        _tele.flush()
+        rt.scheduler.request_telemetry_flush()
+        rows = rt.scheduler_rpc("profile_samples", (None, None))
+        tasks = {r[0] for r in rows if r[0]}
+        if len(tasks) >= 2:
+            break
+        time.sleep(0.5)
+    tasks = {r[0] for r in rows if r[0]}
+    # both concurrent spin() calls sampled under their own task ids
+    assert len(tasks) >= 2, tasks
+    attributed = sum(n for t, _tr, _s, n in rows if t)
+    assert attributed > 0
+    # spans carry trace attribution too
+    traced = {r[1] for r in rows if r[1]}
+    assert traced, "no trace ids on profiler samples"
+
+
+def test_profile_dump_formats(ray_start_regular, tmp_path):
+    """Collapsed-stack and speedscope exports are well-formed."""
+
+    @ray_tpu.remote
+    def spin(s):
+        t0 = time.time()
+        while time.time() - t0 < s:
+            pass
+        return 1
+
+    ray_tpu.get(spin.remote(0.05))
+    ray_tpu.request_profile(hz=150, duration_s=2.0)
+    ray_tpu.get([spin.remote(0.8) for _ in range(2)], timeout=60)
+    time.sleep(1.2)
+    collapsed = tmp_path / "prof.txt"
+    n_lines = ray_tpu.profile_dump(str(collapsed), format="collapsed")
+    assert n_lines > 0
+    for line in collapsed.read_text().splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and int(count) > 0
+    speedscope = tmp_path / "prof.json"
+    n_prof = ray_tpu.profile_dump(str(speedscope), format="speedscope")
+    assert n_prof > 0
+    doc = json.loads(speedscope.read_text())
+    assert doc["$schema"].startswith("https://www.speedscope.app")
+    assert doc["shared"]["frames"]
+    for prof in doc["profiles"]:
+        assert prof["type"] == "sampled"
+        assert len(prof["samples"]) == len(prof["weights"])
+        nframes = len(doc["shared"]["frames"])
+        for sample in prof["samples"]:
+            assert all(0 <= i < nframes for i in sample)
+
+
+def test_histogram_submillisecond_buckets_and_overrides(ray_start_regular):
+    """Default histogram grid resolves sub-ms observations; bounds are
+    configurable per metric (API + env var)."""
+    import os
+
+    from ray_tpu.util import metrics as m
+
+    # default grid includes sub-ms buckets
+    assert any(b < 1 for b in m.DEFAULT_HISTOGRAM_BOUNDARIES)
+    h = m.Histogram("tr_default_grid_ms")
+    h.observe(0.02)
+    h.observe(0.3)
+    h.observe(40)
+    text = m.prometheus_text()
+    assert 'tr_default_grid_ms_bucket{le="0.05"} 1' in text
+    assert 'tr_default_grid_ms_bucket{le="0.5"} 2' in text
+    # per-metric override API
+    m.configure_histogram_boundaries("tr_custom_ms", [5, 50])
+    h2 = m.Histogram("tr_custom_ms")
+    assert h2._boundaries == [5, 50]
+    # env override wins over everything
+    os.environ["RAY_TPU_HIST_BUCKETS_TR_ENV_MS"] = "2,20,200"
+    try:
+        h3 = m.Histogram("tr_env_ms", boundaries=[1, 10])
+        assert h3._boundaries == [2.0, 20.0, 200.0]
+    finally:
+        del os.environ["RAY_TPU_HIST_BUCKETS_TR_ENV_MS"]
+    # serve's latency histogram rides the fine default grid now
+    from ray_tpu.serve import _replica
+
+    lat = _replica._replica_metrics()["latency"]
+    assert any(b < 1 for b in lat._boundaries)
+
+
+def test_job_latency_window_and_exemplars(ray_start_regular):
+    """Per-job sliding-window quantiles exist with exemplar trace ids that
+    resolve to real traces."""
+
+    @ray_tpu.remote
+    def work(ms):
+        time.sleep(ms / 1e3)
+        return ms
+
+    ray_tpu.get([work.remote(5), work.remote(30), work.remote(60)])
+    rt = ray_tpu._worker.get_runtime()
+    lat = rt.scheduler_rpc("job_latency", ())
+    assert lat, "no per-job latency windows"
+    snap = next(iter(lat.values()))
+    assert snap["count"] >= 3
+    assert snap["p50"] is not None and snap["p99"] >= snap["p50"]
+    assert snap["exemplars"], snap
+    ex = snap["exemplars"][0]
+    tr = _get_trace(ex["trace_id"])
+    assert tr.span_count() >= 1
+    # the exemplar series also reaches the Prometheus exposition
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "ray_tpu_job_latency_ms" in text
+
+
+def test_serve_per_deployment_latency_in_status(ray_start_regular):
+    """Controller aggregates replica latency windows per deployment and
+    surfaces them in serve.status() (satellite: was per-replica only)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Api:
+        def __call__(self, x):
+            time.sleep(0.01)
+            return x
+
+    h = serve.run(Api.bind(), name="lat_app")
+    try:
+        for i in range(6):
+            h.remote(i).result(timeout_s=30)
+        deadline = time.time() + 30
+        lat = None
+        while time.time() < deadline:
+            st = serve.status()
+            lat = st.get("lat_app", {}).get("Api", {}).get("latency")
+            if lat and lat.get("count"):
+                break
+            time.sleep(0.5)
+        assert lat and lat["count"] >= 1, lat
+        assert lat["p50"] is not None
+        assert "exemplars" in lat
+    finally:
+        serve.shutdown()
+
+
+def test_tracing_disabled_is_silent(ray_start_regular):
+    """tracing disabled: tasks run untraced (no trace index growth), and
+    the plane's read APIs still answer."""
+    from ray_tpu.util import tracing
+
+    tracing.disable_tracing()
+    try:
+        before = len(ray_tpu.recent_traces(limit=1000))
+
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get(f.remote(1)) == 1
+        after = len(ray_tpu.recent_traces(limit=1000))
+        assert after == before
+    finally:
+        tracing.reset_tracing()
+
+
+def test_timeline_regression_with_tracing(ray_start_regular):
+    """PR-2 chrome timeline keeps working with the tracing plane on: events
+    parse, lifecycle phases present, PROFILE spans carry trace args."""
+
+    @ray_tpu.remote
+    def t(x):
+        from ray_tpu._private.profiling import profile
+
+        with profile("user_section"):
+            time.sleep(0.01)
+        return x
+
+    ray_tpu.get(t.remote(1))
+    events = ray_tpu.timeline()
+    assert any(e.get("cat") == "TASK_PHASE" for e in events)
+    user = [e for e in events if e.get("name") == "user_section"]
+    assert user and user[0]["args"].get("trace_id")
