@@ -140,6 +140,28 @@ class Config:
     # sliding-window latency series (per-job / per-deployment p50/p95/p99
     # with exemplar trace ids): window length in seconds
     latency_window_s: float = 60.0
+    # --- memory observability plane (allocation provenance / leak
+    # watchdog / byte attribution; see DESIGN_MAP "Memory observability")
+    # ---
+    # capture creation-callsite provenance for every store-backed put /
+    # task return / stream item, ship it in telemetry batches into the
+    # scheduler's bounded provenance index, and run the leak watchdog.
+    # Requires telemetry_enabled; bench-tracked overhead ratio <= 1.05
+    memory_plane_enabled: bool = True
+    # bound on the scheduler-side provenance index (oid -> callsite/job/
+    # trace); overflow is counted in ray_tpu_object_provenance_dropped_total
+    object_provenance_max: int = 50_000
+    # leak watchdog: scan cadence joining the ownership table against live
+    # workers/jobs, classifying objects (IN_USE / PINNED_BY_DEAD_OWNER /
+    # CAPTURED_IN_ACTOR / LEAK_SUSPECT) and flagging per-callsite monotonic
+    # growth over a sliding window of scans
+    leak_watchdog_interval_s: float = 1.0
+    # consecutive scans a callsite's live bytes must grow monotonically
+    # (with net growth over the minimums below) before it is flagged as a
+    # LEAK_SUSPECT and an OBJECT_LEAK_SUSPECT event is emitted
+    leak_watchdog_window: int = 8
+    leak_watchdog_min_growth_bytes: int = 1024 * 1024
+    leak_watchdog_min_count_growth: int = 8
     # --- failure forensics (cluster event log, watchdogs) ---
     # bound on the scheduler's structured cluster-event log (WORKER_DIED,
     # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest

@@ -99,7 +99,7 @@ def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
         picked = scheduler.pick_oom_victim()
         if picked is None:
             return False
-        victim, job_bin, priority = picked
+        victim, job_bin, priority, victim_prov = picked
         try:
             victim.proc.terminate()
         except Exception:
@@ -109,6 +109,15 @@ def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
             scheduler.note_oom_kill(job_bin)
         except Exception:
             pass
+        # kill-time memory snapshot (memory plane): the event names what
+        # FILLED the store — store usage + top creation callsites, overall
+        # and for the victim's job — not just who died. Forensics only:
+        # a failure here must not flip the kill verdict.
+        snapshot = {}
+        try:
+            snapshot = scheduler.memory_forensics_snapshot(job_bin=job_bin)
+        except Exception:
+            snapshot = {}
         try:
             # forensics only: must not flip the kill verdict — a False here
             # would make the monitor escalate onto a second worker while
@@ -125,6 +134,8 @@ def make_scheduler_kill_policy(scheduler) -> Callable[[], bool]:
                 pid=victim.proc.pid,
                 job_id=job_bin.hex() if job_bin else None,
                 priority=priority,
+                victim=victim_prov,
+                **snapshot,
             )
         except Exception:
             pass

@@ -1,0 +1,313 @@
+"""Memory-observability plane: allocation provenance + byte attribution.
+
+Answers "where did the *bytes* go" the way the tracing plane (PR 11)
+answers "where did the *time* go". Parity: ``ray memory``'s per-object
+provenance grouped by creation callsite with ref-holder attribution
+(``python/ray/_private/internal_api.py`` memory_summary / the
+CoreWorker's ``ObjectRefInfo`` callsite capture).
+
+Three process-side capture points feed the scheduler's bounded provenance
+index through the PR-2 telemetry ring:
+
+* **allocation provenance** — every store-backed ``put`` / task-return /
+  stream-item records its creation callsite (``file.py:LINE`` digest,
+  interned with bounded cardinality), size, kind, and active trace id;
+  the owner task/job ids ride in the object id itself (an oid embeds its
+  creating task id). Shipped batched (``telemetry.record_object_event``),
+  never per-record RPCs.
+* **spill/restore byte attribution** — the store clients call
+  :func:`note_spill` / :func:`note_restore` with the victim oid; the
+  owning job is decoded from the oid and the bytes land on the
+  ``ray_tpu_spill_bytes_total{job=}`` / ``ray_tpu_restore_bytes_total``
+  counters (batched through the same metrics pipeline).
+* **device-memory telemetry** — :func:`maybe_record_device_metrics` is
+  probed from the telemetry flusher cadence (the PR-11 jax-monitoring
+  seam): once user code has imported jax, per-device
+  ``ray_tpu_device_*`` gauges (live buffer count/bytes, bytes-in-use and
+  HBM peak where the backend reports ``memory_stats``) are recorded.
+  Never imports jax itself.
+
+Scheduler-side consumers: the provenance index, the 1 Hz leak watchdog,
+``state.summarize_objects`` server-side grouping, the ``ray_tpu memory``
+CLI, and the OOM-kill forensics snapshot (see
+``Scheduler._memory_watchdog_scan`` / ``memory_forensics_snapshot``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+# bounded per-process callsite interning: beyond the cap every new site
+# collapses into one bucket so a pathological codegen loop can't balloon
+# the provenance index's label cardinality
+_CALLSITE_CACHE_MAX = 1024
+_ELIDED = "<elided>"
+
+_callsite_cache: Dict[tuple, str] = {}
+_callsite_lock = threading.Lock()
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# (runtime identity, verdict) — the flags can't change under a live
+# runtime, and this check sits on the put hot path (bench-budgeted)
+_enabled_cache: tuple = (None, False)
+
+
+def enabled() -> bool:
+    """Memory plane on? Requires the telemetry pipeline (records ride its
+    batches); ``memory_plane_enabled`` gates the capture side. Memoized
+    per connected runtime — this is the put hot path."""
+    from ray_tpu._private import telemetry
+
+    rt = telemetry._runtime()
+    if rt is None:
+        return False
+    global _enabled_cache
+    cached_rt, verdict = _enabled_cache
+    if cached_rt is rt:
+        return verdict
+    cfg = getattr(rt, "config", None)
+    verdict = bool(getattr(cfg, "telemetry_enabled", True)) and bool(
+        getattr(cfg, "memory_plane_enabled", True)
+    )
+    _enabled_cache = (rt, verdict)
+    return verdict
+
+
+def user_callsite(depth_limit: int = 12) -> str:
+    """``file.py:LINE`` of the nearest stack frame OUTSIDE ray_tpu — the
+    user line that created the object. Interned (bounded): repeated puts
+    from one site share a single string."""
+    try:
+        frame = sys._getframe(1)
+    except ValueError:
+        return "<unknown>"
+    depth = 0
+    while frame is not None and depth < depth_limit:
+        code = frame.f_code
+        fn = code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            key = (fn, frame.f_lineno)
+            with _callsite_lock:
+                cs = _callsite_cache.get(key)
+                if cs is None:
+                    if len(_callsite_cache) >= _CALLSITE_CACHE_MAX:
+                        return _ELIDED
+                    cs = f"{os.path.basename(fn)}:{frame.f_lineno}"
+                    _callsite_cache[key] = cs
+            return cs
+        frame = frame.f_back
+        depth += 1
+    return "<internal>"
+
+
+def capture_put() -> Optional[tuple]:
+    """Hot-path provenance capture for ``put``: returns ``(callsite,
+    trace_id, t)`` to ride the put's EXISTING registration message
+    (``put_done`` / ``submit_put``) — zero extra messages, and the
+    provenance can never race the commit it describes. None when the
+    plane is off. Returns/stream items have no per-object message and use
+    :func:`record_object` (telemetry batches) instead."""
+    if not enabled():
+        return None
+    from ray_tpu.util import tracing
+
+    return (user_callsite(), tracing.current_trace_id(), time.time())
+
+
+def record_object(oid, size: int, kind: str, callsite: Optional[str] = None) -> None:
+    """One store-backed object came to life: ship its provenance record
+    (batched). ``kind`` is ``put`` / ``return`` / ``stream_item``. The
+    creating task and job ids are embedded in the oid — the scheduler
+    decodes them at ingest, keeping this record small. Hot path: one
+    bounded stack walk + one ring-buffer append per store-backed put."""
+    if not enabled():
+        return
+    from ray_tpu._private import telemetry
+    from ray_tpu.util import tracing
+
+    # compact positional record (oid_bin, size, kind, callsite, trace, t):
+    # one tuple alloc on the put hot path, decoded scheduler-side
+    buf = telemetry.get_buffer()
+    buf.record_object_event(
+        (
+            oid.binary(),
+            int(size),
+            kind,
+            callsite if callsite is not None else user_callsite(),
+            tracing.current_trace_id(),
+            time.time(),
+        )
+    )
+    buf.ensure_flusher()
+
+
+# --------------------------------------------------------------------------
+# spill / restore byte attribution (per owning job)
+# --------------------------------------------------------------------------
+
+_byte_counters: Dict[str, object] = {}
+_counter_lock = threading.Lock()
+
+
+def _job_hex_of(oid) -> str:
+    try:
+        return oid.binary()[20:24].hex()
+    except Exception:
+        return "unknown"
+
+
+def _spill_restore_counters():
+    """Lazily construct the per-job spill/restore counters (metric names
+    stay literal constructor args: the metrics-lint scanner keys on it)."""
+    with _counter_lock:
+        if "spill" not in _byte_counters:
+            from ray_tpu.util.metrics import Counter
+
+            _byte_counters["spill"] = Counter(
+                "ray_tpu_spill_bytes_total",
+                "bytes spilled out of the object-store arena, by owning job",
+                tag_keys=("job",),
+            )
+            _byte_counters["restore"] = Counter(
+                "ray_tpu_restore_bytes_total",
+                "bytes restored from the spill path into the object store, "
+                "by owning job",
+                tag_keys=("job",),
+            )
+    return _byte_counters
+
+
+def note_spill(oid, nbytes: int) -> None:
+    """An object left the arena for the spill path; charge its owning job
+    (the oid embeds the creating task's job id)."""
+    if not enabled():
+        return
+    try:
+        _spill_restore_counters()["spill"].inc(
+            int(nbytes), tags={"job": _job_hex_of(oid)}
+        )
+    except Exception:
+        pass  # observability must never fail the data path
+
+
+def note_restore(oid, nbytes: int) -> None:
+    """A spilled object was restored into the store; per-job accounting."""
+    if not enabled():
+        return
+    try:
+        _spill_restore_counters()["restore"].inc(
+            int(nbytes), tags={"job": _job_hex_of(oid)}
+        )
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# device-memory telemetry (the PR-11 jax-monitoring seam)
+# --------------------------------------------------------------------------
+
+_DEVICE_PROBE_INTERVAL_S = 5.0
+_last_device_probe = 0.0
+_device_gauges: Dict[str, object] = {}
+
+
+def _get_device_gauges() -> Dict[str, object]:
+    """Lazily construct the ``ray_tpu_device_*`` gauges (literal names:
+    the metrics-lint scanner keys on the constructor call)."""
+    with _counter_lock:
+        if "live_buffers" not in _device_gauges:
+            from ray_tpu.util.metrics import Gauge
+
+            _device_gauges["live_buffers"] = Gauge(
+                "ray_tpu_device_live_buffers",
+                "live jax arrays held by this process (jax.live_arrays)",
+                tag_keys=("pid",),
+            )
+            _device_gauges["live_bytes"] = Gauge(
+                "ray_tpu_device_live_bytes",
+                "bytes held by live jax arrays in this process",
+                tag_keys=("pid",),
+            )
+            _device_gauges["bytes_in_use"] = Gauge(
+                "ray_tpu_device_bytes_in_use",
+                "device allocator bytes in use (jax memory_stats)",
+                tag_keys=("pid", "device"),
+            )
+            _device_gauges["peak_bytes_in_use"] = Gauge(
+                "ray_tpu_device_peak_bytes_in_use",
+                "device allocator high-water mark (HBM peak)",
+                tag_keys=("pid", "device"),
+            )
+    return _device_gauges
+
+
+def maybe_record_device_metrics() -> bool:
+    """Record per-device JAX memory gauges when (and only when) user code
+    has imported jax in this process. Called from the telemetry flusher
+    cadence; self-rate-limited; never imports jax itself. Returns True
+    when a sweep was recorded."""
+    global _last_device_probe
+    if "jax" not in sys.modules or not enabled():
+        return False
+    now = time.monotonic()
+    if now - _last_device_probe < _DEVICE_PROBE_INTERVAL_S:
+        return False
+    _last_device_probe = now
+    try:
+        return collect_device_metrics()
+    except Exception:
+        return False
+
+
+def collect_device_metrics() -> bool:
+    """One sweep of jax device stats into the ``ray_tpu_device_*`` gauges.
+    Separate from the rate-limited probe so tests/read paths can force it."""
+    import jax  # already imported by user code (see maybe_record_device_metrics)
+
+    pid = str(os.getpid())
+    gauges = _get_device_gauges()
+    # host-side view: live committed arrays (buffer count + bytes). This is
+    # what a leaked jnp array shows up in even on CPU-only builds where the
+    # backend has no allocator stats.
+    try:
+        arrs = jax.live_arrays()
+        n_bytes = 0
+        for a in arrs:
+            try:
+                n_bytes += int(a.nbytes)
+            except Exception:
+                pass
+        gauges["live_buffers"].set(len(arrs), tags={"pid": pid})
+        gauges["live_bytes"].set(n_bytes, tags={"pid": pid})
+    except Exception:
+        pass
+    # allocator-side view: per-device bytes_in_use / peak (TPU/GPU backends;
+    # CPU returns None -> skipped)
+    try:
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            tags = {
+                "pid": pid,
+                "device": f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', '?')}",
+            }
+            if "bytes_in_use" in stats:
+                gauges["bytes_in_use"].set(
+                    int(stats["bytes_in_use"]), tags=tags
+                )
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                gauges["peak_bytes_in_use"].set(int(peak), tags=tags)
+    except Exception:
+        pass
+    return True

@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Dict, Optional, Set, Tuple
 
-from ray_tpu._private import fastcopy
+from ray_tpu._private import fastcopy, memplane
 from ray_tpu._private.fastcopy import stage_timer
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError, StorePutMixin
@@ -321,6 +321,7 @@ class NativeStoreClient(StorePutMixin):
                 mv = self.get(oid, timeout=0)
                 if mv is not None:
                     self._external_miss.pop(oid, None)
+                    memplane.note_restore(oid, n or 0)
                     return mv
             except Exception:
                 _abort_created()
@@ -332,6 +333,7 @@ class NativeStoreClient(StorePutMixin):
             self._note_external_miss(oid)
             return None
         self._external_miss.pop(oid, None)
+        memplane.note_restore(oid, len(data))
         try:
             dest = self.create(oid, len(data))
             fastcopy.copy_into(dest, data)
@@ -361,12 +363,14 @@ class NativeStoreClient(StorePutMixin):
                     if not os.path.exists(self._spill_marker(vid)):
                         if not self._spill_external(vid, src):
                             return False
+                        memplane.note_spill(vid, size.value)
                 elif not self._fallback.contains(vid):
                     try:
                         dest = self._fallback.create(vid, size.value)
                         with stage_timer("store.spill.copy", size.value):
                             fastcopy.copy_into(dest, src)
                         self._fallback.seal(vid)
+                        memplane.note_spill(vid, size.value)
                     except ValueError:
                         pass  # concurrent spiller won the race
                     except FileNotFoundError:
@@ -479,6 +483,14 @@ class NativeStoreClient(StorePutMixin):
 
     def usage_bytes(self) -> int:
         return int(self._lib.rt_store_used_bytes(self._h)) + self._fallback.usage_bytes()
+
+    def usage_stats(self):
+        """Arena used bytes count as sealed (the arena only holds created-
+        or-sealed blocks; in-flight creates are a transient sliver), plus
+        the file-store fallback's lock-consistent sealed/unsealed split."""
+        out = self._fallback.usage_stats()
+        out["sealed_bytes"] += int(self._lib.rt_store_used_bytes(self._h))
+        return out
 
     def list_objects(self):
         return self._fallback.list_objects()  # arena listing: not yet exposed

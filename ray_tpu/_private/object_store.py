@@ -321,18 +321,59 @@ class ObjectStoreClient(StorePutMixin):
                     pass
 
     def usage_bytes(self) -> int:
-        total = 0
+        st = self.usage_stats()
+        return st["sealed_bytes"] + st["unsealed_bytes"]
+
+    def usage_stats(self) -> Dict[str, int]:
+        """One consistent point-in-time usage snapshot, sealed vs unsealed
+        split. ``unsealed_bytes`` are in-flight ``create`` allocations (a
+        crashed creator's orphans age out via create()'s reclaim path).
+
+        Lock-free on purpose (the 1 Hz watchdog + metrics scrapes call
+        this; holding the client lock across an O(n) directory walk would
+        stall every concurrent create/seal/get once per second). The
+        seal-time ``.building`` → ``.obj`` rename can make a raw scan see
+        BOTH names for one object — the transient that made the dashboard
+        show usage > capacity — so entries are collected per object stem
+        first and a stem seen sealed never also counts as unsealed."""
+        out = {
+            "sealed_bytes": 0,
+            "unsealed_bytes": 0,
+            "sealed_objects": 0,
+            "unsealed_objects": 0,
+            "fallback_bytes": 0,
+        }
         for d in (self._shm_dir, self._fallback_dir):
+            fallback = d == self._fallback_dir
+            sealed: Dict[str, int] = {}
+            unsealed: Dict[str, int] = {}
             try:
                 with os.scandir(d) as it:
                     for e in it:
                         try:
-                            total += e.stat().st_size
+                            size = e.stat().st_size
                         except FileNotFoundError:
-                            pass
+                            continue
+                        if e.name.endswith(".obj"):
+                            sealed[e.name[:-4]] = size
+                        elif e.name.endswith(".building"):
+                            unsealed[e.name[:-9]] = size
+                        # else: native arena file / spill .uri markers —
+                        # not object payload (the arena's USED bytes are
+                        # reported by the native client)
             except FileNotFoundError:
-                pass
-        return total
+                continue
+            for stem in sealed.keys() & unsealed.keys():
+                del unsealed[stem]  # mid-rename duplicate: it IS sealed
+            out["sealed_bytes"] += sum(sealed.values())
+            out["unsealed_bytes"] += sum(unsealed.values())
+            out["sealed_objects"] += len(sealed)
+            out["unsealed_objects"] += len(unsealed)
+            if fallback:
+                out["fallback_bytes"] += sum(sealed.values()) + sum(
+                    unsealed.values()
+                )
+        return out
 
     def list_objects(self):
         out = []

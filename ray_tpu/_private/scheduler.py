@@ -643,6 +643,34 @@ class Scheduler:
         # -owned results never appear here)
         self._refop_count = 0
         self._commit_count = 0
+        # ---- memory observability plane (allocation provenance + leak
+        # watchdog; see DESIGN_MAP "Memory observability") ----
+        # bounded provenance index: oid hex -> {oid, cs (creation callsite),
+        # kind, size, trace, t, job, task}; fed by telemetry object records,
+        # entries die with the object (_free_object) or via the watchdog's
+        # stale sweep (a record can race its own free)
+        self._obj_prov: Dict[str, dict] = {}
+        self._prov_dropped = 0
+        # leak watchdog: per-callsite (count, bytes) history over the last
+        # `leak_watchdog_window` scans; callsites currently flagged; event
+        # dedup stamps so one leaking site emits at most one
+        # OBJECT_LEAK_SUSPECT per re-arm period
+        self._leak_history: Dict[str, Deque[Tuple[int, int]]] = {}
+        self._leak_suspects: Dict[str, dict] = {}
+        self._leak_events_total = 0
+        self._leak_last_event: Dict[str, float] = {}
+        # object classification from the last scan (IN_USE /
+        # PINNED_BY_DEAD_OWNER / CAPTURED_IN_ACTOR / LEAK_SUSPECT):
+        # oid hex -> class, plus the aggregate per-class counts
+        self._obj_class: Dict[str, str] = {}
+        self._obj_class_counts: Dict[str, int] = {}
+        self._last_memscan = time.monotonic()
+        # store arena high-water mark (sealed+unsealed peak seen by the
+        # watchdog/metrics scans)
+        self._store_highwater = 0
+        # per-(job, path) completed inter-node transfer bytes — the per-job
+        # split of _xfer_done_bytes
+        self._xfer_bytes_by_job: Dict[Tuple[str, str], int] = {}
         # ---- multi-host plane (daemon-backed nodes) ----
         # daemon socket -> node id (the socket is in the wait set)
         self._daemon_conns: Dict[Any, NodeID] = {}
@@ -1073,6 +1101,8 @@ class Scheduler:
         elif kind == "submit_put":
             if len(msg) > 2 and msg[2]:
                 self._note_object_size(msg[1], int(msg[2]))
+            if len(msg) > 3 and msg[3]:
+                self._ingest_put_prov(msg[1], int(msg[2] or 0), msg[3])
             self._object_locations[msg[1]].add(self._loc_node(w.node_id))
             self._commit_result(msg[1], ("stored",))
         elif kind == "put_object":
@@ -1345,7 +1375,17 @@ class Scheduler:
                 # charged == socket path; uncharged == same-host shm read
                 idx = 0 if entry[1] else 1
                 self._xfer_done_count[idx] += 1
-                self._xfer_done_bytes[idx] += self._object_sizes.get(oid, 0)
+                nbytes = self._object_sizes.get(oid, 0)
+                self._xfer_done_bytes[idx] += nbytes
+                if nbytes:
+                    # memory plane: per-owning-job transfer attribution
+                    jk = (
+                        oid.binary()[20:24].hex(),
+                        "socket" if entry[1] else "shm",
+                    )
+                    self._xfer_bytes_by_job[jk] = (
+                        self._xfer_bytes_by_job.get(jk, 0) + nbytes
+                    )
             self._object_locations[oid].add(dest)
             self._shm_xfer_failed.discard((oid, dest))
         elif entry is not None and not entry[1]:
@@ -1503,6 +1543,8 @@ class Scheduler:
                 self._object_locations[cmd[1]].add(self._node.head_node_id)
                 if len(cmd) > 3 and cmd[3]:
                     self._note_object_size(cmd[1], int(cmd[3]))
+                if len(cmd) > 4 and cmd[4]:
+                    self._ingest_put_prov(cmd[1], int(cmd[3] or 0), cmd[4])
             self._commit_result(cmd[1], cmd[2])
         elif kind == "protect":
             # preemption shield window (mid-commit checkpoint save): victim
@@ -2380,7 +2422,9 @@ class Scheduler:
         then highest held usage — the same ranking as priority preemption
         — with retriable-before-non-retriable and last-started-first as
         tiebreaks inherited from the classic policy. Returns
-        ``(worker, job_bin, priority)`` or None."""
+        ``(worker, job_bin, priority, provenance)`` or None; provenance is
+        the ranking's inputs, so the OOM event can show WHY this victim
+        (memory plane forensics)."""
         ranked = []
         for w in list(self.workers.values()):
             if w.current_task is None or w.state == "dead":
@@ -2405,13 +2449,25 @@ class Scheduler:
                     w,
                     js.job_bin if js is not None else None,
                     prio,
+                    {
+                        "task_id": rec.spec.task_id.hex(),
+                        "task_name": rec.spec.name,
+                        "attempt": rec.attempt,
+                        "retriable": retriable,
+                        "held_usage": round(held, 3),
+                        "running_s": round(
+                            time.monotonic() - (rec.start_time or 0), 3
+                        )
+                        if rec.start_time
+                        else None,
+                    },
                 )
             )
         if not ranked:
             return None
         ranked.sort(key=lambda e: e[0])
-        _, w, job_bin, prio = ranked[0]
-        return w, job_bin, prio
+        _, w, job_bin, prio, prov = ranked[0]
+        return w, job_bin, prio, prov
 
     def _job_row(self, js: JobState, ready: int, order: List[bytes]) -> dict:
         try:
@@ -2507,6 +2563,11 @@ class Scheduler:
             self._maybe_detect_stragglers()
         except Exception:
             logger.exception("straggler scan failed")
+        # memory plane: 1 Hz ownership-join / leak-watchdog scan
+        try:
+            self._maybe_memory_scan()
+        except Exception:
+            logger.exception("memory watchdog scan failed")
         # multi-tenant job plane: drain the admission queue while backlog
         # allows, then scan for starved high-priority work to preempt for
         # (both rate-limit themselves; see DESIGN_MAP "Multi-tenant job
@@ -4513,21 +4574,20 @@ class Scheduler:
             ]
             return self._apply_limit(rows, args)
         if op == "list_objects":
-            store = self._node.store_client
-            out = []
+            # memory plane: provenance-enriched rows, filters pushed
+            # server-side, hard row cap + truncation flag (see
+            # _list_objects_rows)
             limit = args[0] if args and isinstance(args[0], int) else None
-            if store is not None:
-                for oid, size in store.list_objects():
-                    out.append(
-                        {
-                            "object_id": oid.hex(),
-                            "size_bytes": size,
-                            "ref_count": self._ref_counts.get(oid, 0),
-                        }
-                    )
-                    if limit is not None and len(out) >= limit:
-                        break
-            return out
+            filters = args[1] if len(args) > 1 else None
+            return self._list_objects_rows(limit, filters)
+        if op == "summarize_objects":
+            group_by = args[0] if args and args[0] else "callsite"
+            limit = args[1] if len(args) > 1 and args[1] else 50
+            return self._summarize_objects(group_by, int(limit))
+        if op == "memory_forensics":
+            job_hex = args[0] if args else None
+            job_bin = bytes.fromhex(job_hex) if job_hex else None
+            return self.memory_forensics_snapshot(job_bin=job_bin)
         if op == "pending_demand":
             # resource shapes the scheduler cannot currently place (autoscaler
             # input; parity: GcsAutoscalerStateManager cluster_resource_state).
@@ -4989,6 +5049,8 @@ class Scheduler:
     def _free_object(self, oid: ObjectID):
         self._cross_channel.discard(oid)
         self._ref_channel.pop(oid, None)
+        self._obj_prov.pop(oid.hex(), None)
+        self._obj_class.pop(oid.hex(), None)
         freed = self._object_sizes.pop(oid, None)
         if freed:
             # uncharge the owning job's object-store-bytes ledger
@@ -5611,6 +5673,11 @@ class Scheduler:
                 logger.exception("log record handling failed")
         for cev in batch.get("cluster_events") or ():
             self._ingest_cluster_event(dict(cev))
+        for orec in batch.get("objects") or ():
+            try:
+                self._ingest_object_record(orec)
+            except Exception:
+                logger.exception("object provenance record ingest failed")
         for name, (kind, description, data) in (batch.get("metrics") or {}).items():
             try:
                 self._merge_metric(name, kind, description, data, proc)
@@ -5658,6 +5725,488 @@ class Scheduler:
             {"kind": kind, "description": description, "data": merged}
         ).encode()
         self.gcs.kv_put("metrics", name.encode(), blob, True)
+
+    # ---- memory observability plane ------------------------------------
+
+    def _ingest_object_record(self, rec) -> None:
+        """Merge one allocation-provenance tuple ``(oid_bin, size, kind,
+        callsite, trace_id, t)`` (memory plane) into the bounded index.
+        The creating task/job ids are decoded from the oid itself;
+        overflow beyond ``object_provenance_max`` is counted, never
+        silent."""
+        try:
+            oid_bin, size, kind, cs, trace, t = rec
+        except (TypeError, ValueError):
+            return
+        if not isinstance(oid_bin, bytes) or len(oid_bin) != ObjectID.SIZE:
+            return
+        oid = ObjectID(oid_bin)
+        # dead on arrival: under put/del churn a record lands up to one
+        # flush interval AFTER its object was freed (the free rides the
+        # owner's channel, the record rides the batch). Indexing those
+        # would grow the table at churn-rate x flush-interval and make the
+        # 1 Hz scan O(dead) — the commit always precedes the record on the
+        # same FIFO pipe, so "not live here" means "already freed", never
+        # "not yet known"
+        if not self._object_is_live(oid):
+            return
+        key = oid.hex()
+        cap = int(getattr(self.config, "object_provenance_max", 50_000) or 50_000)
+        if key not in self._obj_prov and len(self._obj_prov) >= cap:
+            self._prov_dropped += 1
+            return
+        size = int(size or 0)
+        self._obj_prov[key] = {
+            "oid": oid,
+            "cs": str(cs or "<unknown>"),
+            "kind": str(kind or "put"),
+            "size": size,
+            "trace": trace,
+            "t": float(t or time.time()),
+            "job": oid_bin[20:24].hex(),
+            "task": oid_bin[:24].hex(),
+        }
+        # sizes learned here also feed the locality scorer and the per-job
+        # object_store_bytes quota ledger (stored RETURNS previously had no
+        # size head-side) — but only for live objects, so a record racing
+        # its own free can't re-charge a dead oid
+        if size and oid not in self._object_sizes and self._object_is_live(oid):
+            self._note_object_size(oid, size)
+
+    def _ingest_put_prov(self, oid: ObjectID, size: int, prov) -> None:
+        """Provenance that rode a put's own registration message
+        (``put_done`` / ``submit_put``): ``(callsite, trace_id, t)``.
+        Same bounded index as the telemetry-batch path."""
+        key = oid.hex()
+        cap = int(getattr(self.config, "object_provenance_max", 50_000) or 50_000)
+        if key not in self._obj_prov and len(self._obj_prov) >= cap:
+            self._prov_dropped += 1
+            return
+        cs, trace, t = prov
+        oid_bin = oid.binary()
+        self._obj_prov[key] = {
+            "oid": oid,
+            "cs": cs or "<unknown>",
+            "kind": "put",
+            "size": size,
+            "trace": trace,
+            "t": t,
+            "job": oid_bin[20:24].hex(),
+            "task": oid_bin[:24].hex(),
+        }
+
+    def _object_is_live(self, oid: ObjectID) -> bool:
+        return (
+            self.memory_store.contains(oid)
+            or oid in self._ref_counts
+            or oid in self._object_sizes
+        )
+
+    def _maybe_memory_scan(self) -> None:
+        if not getattr(self.config, "memory_plane_enabled", True):
+            return
+        interval = float(
+            getattr(self.config, "leak_watchdog_interval_s", 1.0) or 1.0
+        )
+        now = time.monotonic()
+        if now - self._last_memscan < interval:
+            return
+        self._last_memscan = now
+        self._memory_watchdog_scan()
+
+    def _memory_watchdog_scan(self) -> None:
+        """One watchdog pass: prune stale provenance, join the ownership
+        table against live workers/jobs to classify every tracked object,
+        and flag callsites whose live footprint grew monotonically across
+        the sliding window (``OBJECT_LEAK_SUSPECT`` cluster events with
+        exemplar oids)."""
+        now_w = time.time()
+        stale = [
+            k
+            for k, rec in self._obj_prov.items()
+            if now_w - rec["t"] > 10.0 and not self._object_is_live(rec["oid"])
+        ]
+        for k in stale:
+            del self._obj_prov[k]
+            self._obj_class.pop(k, None)
+        # ref-holder join: oid hex -> holder WorkerStates (the borrower
+        # attribution table keyed back onto tracked objects)
+        oid_key = {rec["oid"]: k for k, rec in self._obj_prov.items()}
+        holders_by_key: Dict[str, List[WorkerState]] = {}
+        for holder, held in list(self._holder_refs.items()):
+            w = self.workers.get(holder) if holder is not None else None
+            for oid in held:
+                k = oid_key.get(oid)
+                if k is not None:
+                    holders_by_key.setdefault(k, []).append(w)
+        # pass 1: live per-callsite footprint (leak detection input)
+        per_cs: Dict[str, List[int]] = {}
+        live_keys: List[str] = []
+        for k, rec in self._obj_prov.items():
+            if not self._object_is_live(rec["oid"]):
+                continue
+            live_keys.append(k)
+            agg = per_cs.setdefault(rec["cs"], [0, 0])
+            agg[0] += 1
+            agg[1] += rec["size"]
+        # sliding-window monotonic-growth detector, per callsite
+        window = max(2, int(getattr(self.config, "leak_watchdog_window", 8)))
+        min_bytes = int(
+            getattr(self.config, "leak_watchdog_min_growth_bytes", 1 << 20)
+        )
+        min_count = int(
+            getattr(self.config, "leak_watchdog_min_count_growth", 8)
+        )
+        interval = float(
+            getattr(self.config, "leak_watchdog_interval_s", 1.0) or 1.0
+        )
+        for cs in list(self._leak_history):
+            if cs not in per_cs:  # site fully freed: forget it
+                del self._leak_history[cs]
+                self._leak_suspects.pop(cs, None)
+        suspects: Dict[str, dict] = {}
+        for cs, (count, nbytes) in per_cs.items():
+            hist = self._leak_history.get(cs)
+            if hist is None:
+                hist = self._leak_history[cs] = collections.deque(
+                    maxlen=window
+                )
+            hist.append((count, nbytes))
+            if len(hist) < window:
+                continue
+            monotonic = all(
+                hist[i][0] <= hist[i + 1][0] and hist[i][1] <= hist[i + 1][1]
+                for i in range(len(hist) - 1)
+            )
+            grew = (
+                hist[-1][1] - hist[0][1] >= min_bytes
+                and hist[-1][0] - hist[0][0] >= min_count
+            )
+            if not (monotonic and grew):
+                self._leak_suspects.pop(cs, None)
+                continue
+            exemplars = [
+                k
+                for k, rec in self._obj_prov.items()
+                if rec["cs"] == cs and self._object_is_live(rec["oid"])
+            ][-3:]
+            jobs = sorted(
+                {
+                    self._obj_prov[k]["job"]
+                    for k in exemplars
+                    if k in self._obj_prov
+                }
+            )
+            info = {
+                "callsite": cs,
+                "live_count": count,
+                "live_bytes": nbytes,
+                "growth_bytes": hist[-1][1] - hist[0][1],
+                "growth_count": hist[-1][0] - hist[0][0],
+                "window_s": round(window * interval, 3),
+                "exemplar_object_ids": exemplars,
+                "jobs": jobs,
+                "first_flagged": self._leak_suspects.get(cs, {}).get(
+                    "first_flagged", now_w
+                ),
+            }
+            suspects[cs] = info
+            last = self._leak_last_event.get(cs, 0.0)
+            if now_w - last >= 60.0:
+                self._leak_last_event[cs] = now_w
+                self._leak_events_total += 1
+                self.record_cluster_event(
+                    "OBJECT_LEAK_SUSPECT",
+                    f"callsite {cs} grew monotonically to {count} live "
+                    f"objects / {nbytes} bytes over the last "
+                    f"{info['window_s']:g}s "
+                    f"(+{info['growth_bytes']} bytes)",
+                    severity="WARNING",
+                    **{k: v for k, v in info.items() if k != "first_flagged"},
+                )
+        self._leak_suspects = suspects
+        # pass 2: classification AFTER leak detection, so this scan's
+        # fresh suspects reclassify EVERY object of a flagged callsite
+        # (not just exemplars) and per-row class agrees with the
+        # ray_tpu_objects_by_class split for the same instant
+        classes: Dict[str, str] = {}
+        class_counts: Dict[str, int] = {}
+        for k in live_keys:
+            rec = self._obj_prov.get(k)
+            if rec is None:
+                continue
+            cls = "IN_USE"
+            try:
+                job_bin = bytes.fromhex(rec["job"])
+            except ValueError:
+                job_bin = None
+            if job_bin is not None and job_bin not in self._jobs:
+                # the owning job's arbitration record is gone (terminated /
+                # GC'd) while the bytes are still held
+                cls = "PINNED_BY_DEAD_OWNER"
+            elif any(
+                w is not None and w.actor_id is not None
+                for w in holders_by_key.get(k) or ()
+            ):
+                cls = "CAPTURED_IN_ACTOR"
+            elif rec["cs"] in suspects:
+                cls = "LEAK_SUSPECT"
+            classes[k] = cls
+            class_counts[cls] = class_counts.get(cls, 0) + 1
+        self._obj_class = classes
+        self._obj_class_counts = class_counts
+        # arena high-water mark (sealed + in-flight creates)
+        store = self._node.store_client
+        if store is not None:
+            try:
+                st = store.usage_stats()
+                self._store_highwater = max(
+                    self._store_highwater,
+                    st["sealed_bytes"] + st["unsealed_bytes"],
+                )
+            except Exception:
+                pass
+
+    _LIST_OBJECTS_HARD_CAP = 10_000
+
+    @staticmethod
+    def _row_match(row: dict, filters) -> bool:
+        """Server-side filter predicate (the PR-2 state-API pushdown
+        contract: ``=``/``!=`` raw, ordering operators numeric)."""
+        for key, op, value in filters or ():
+            have = row.get(key)
+            if op == "=":
+                if have != value:
+                    return False
+            elif op == "!=":
+                if have == value:
+                    return False
+            elif op in ("<", ">", "<=", ">="):
+                try:
+                    a, b = float(have), float(value)
+                except (TypeError, ValueError):
+                    return False
+                if op == "<" and not a < b:
+                    return False
+                if op == ">" and not a > b:
+                    return False
+                if op == "<=" and not a <= b:
+                    return False
+                if op == ">=" and not a >= b:
+                    return False
+            else:
+                raise ValueError(f"unsupported filter operator {op!r}")
+        return True
+
+    def _list_objects_rows(self, limit, filters) -> dict:
+        """Server-side ``list_objects``: provenance-enriched rows, filters
+        applied at the source, hard row cap with an explicit truncation
+        flag (a client-side 10k-row dump does not survive million-object
+        stores)."""
+        cap = self._LIST_OBJECTS_HARD_CAP
+        if isinstance(limit, int) and limit > 0:
+            cap = min(limit, cap)
+        now = time.time()
+        rows: List[dict] = []
+        matched = 0
+        seen: Set[str] = set()
+
+        def emit(row: dict) -> None:
+            nonlocal matched
+            if not self._row_match(row, filters):
+                return
+            matched += 1
+            if len(rows) < cap:
+                rows.append(row)
+
+        for key, rec in self._obj_prov.items():
+            oid = rec["oid"]
+            if not self._object_is_live(oid):
+                continue
+            seen.add(key)
+            emit(
+                {
+                    "object_id": key,
+                    "size_bytes": rec["size"],
+                    "ref_count": self._ref_counts.get(oid, 0),
+                    "callsite": rec["cs"],
+                    "kind": rec["kind"],
+                    "job": rec["job"],
+                    "task": rec["task"],
+                    "class": self._obj_class.get(key, "IN_USE"),
+                    "age_s": round(max(0.0, now - rec["t"]), 3),
+                    "trace_id": rec.get("trace"),
+                }
+            )
+        # objects the head knows about without provenance (plane toggled
+        # on mid-run, legacy clients): still listed, untracked callsite
+        for oid, size in list(self._object_sizes.items()):
+            key = oid.hex()
+            if key in seen:
+                continue
+            emit(
+                {
+                    "object_id": key,
+                    "size_bytes": size,
+                    "ref_count": self._ref_counts.get(oid, 0),
+                    "callsite": "<untracked>",
+                    "kind": "unknown",
+                    "job": oid.binary()[20:24].hex(),
+                    "task": oid.binary()[:24].hex(),
+                    "class": "IN_USE",
+                    "age_s": None,
+                    "trace_id": None,
+                }
+            )
+        return {"rows": rows, "truncated": matched > len(rows), "total": matched}
+
+    def _summarize_objects(self, group_by: str = "callsite", limit: int = 50) -> dict:
+        """Server-side grouping over the provenance index (parity: ``ray
+        memory --group-by``): one row per callsite / job / node with live
+        count+bytes, classification split, and exemplar object ids."""
+        if group_by not in ("callsite", "job", "node"):
+            raise ValueError(
+                f"summarize_objects group_by must be callsite|job|node, "
+                f"got {group_by!r}"
+            )
+        groups: Dict[str, dict] = {}
+        total_bytes = 0
+        total_objects = 0
+
+        def bucket(gk: str) -> dict:
+            g = groups.get(gk)
+            if g is None:
+                g = groups[gk] = {
+                    "group": gk,
+                    "count": 0,
+                    "bytes": 0,
+                    "classes": {},
+                    "callsites": {},
+                    "jobs": set(),
+                    "exemplars": [],
+                    "leak_suspect": False,
+                }
+            return g
+
+        seen: Set[ObjectID] = set()
+        for key, rec in self._obj_prov.items():
+            oid = rec["oid"]
+            if not self._object_is_live(oid):
+                continue
+            seen.add(oid)
+            if group_by == "callsite":
+                gk = rec["cs"]
+            elif group_by == "job":
+                gk = rec["job"]
+            else:
+                locs = self._object_locations.get(oid)
+                gk = next(iter(locs)).hex()[:12] if locs else "head"
+            g = bucket(gk)
+            g["count"] += 1
+            g["bytes"] += rec["size"]
+            cls = self._obj_class.get(key, "IN_USE")
+            g["classes"][cls] = g["classes"].get(cls, 0) + 1
+            cs_agg = g["callsites"].setdefault(rec["cs"], [0, 0])
+            cs_agg[0] += 1
+            cs_agg[1] += rec["size"]
+            g["jobs"].add(rec["job"])
+            if len(g["exemplars"]) < 3:
+                g["exemplars"].append(key)
+            if rec["cs"] in self._leak_suspects:
+                g["leak_suspect"] = True
+            total_bytes += rec["size"]
+            total_objects += 1
+        # untracked live objects keep totals honest
+        for oid, size in list(self._object_sizes.items()):
+            if oid in seen:
+                continue
+            gk = (
+                "<untracked>"
+                if group_by == "callsite"
+                else oid.binary()[20:24].hex()
+                if group_by == "job"
+                else "head"
+            )
+            g = bucket(gk)
+            g["count"] += 1
+            g["bytes"] += size
+            g["classes"]["IN_USE"] = g["classes"].get("IN_USE", 0) + 1
+            total_bytes += size
+            total_objects += 1
+        rows = sorted(groups.values(), key=lambda g: -g["bytes"])
+        truncated = len(rows) > limit
+        rows = rows[: int(limit)]
+        for g in rows:
+            g["jobs"] = sorted(g["jobs"])
+            # top-3 callsites per group (the quota-kill "who filled it" view)
+            g["callsites"] = [
+                {"callsite": cs, "count": c, "bytes": b}
+                for cs, (c, b) in sorted(
+                    g["callsites"].items(), key=lambda kv: -kv[1][1]
+                )[:3]
+            ]
+        store_stats = {}
+        store = self._node.store_client
+        if store is not None:
+            try:
+                store_stats = dict(store.usage_stats())
+            except Exception:
+                store_stats = {}
+        store_stats["capacity_bytes"] = int(self.config.object_store_memory)
+        store_stats["highwater_bytes"] = int(self._store_highwater)
+        return {
+            "group_by": group_by,
+            "rows": rows,
+            "truncated": truncated,
+            "total_objects": total_objects,
+            "total_bytes": total_bytes,
+            "store": store_stats,
+            "leak_suspects": dict(self._leak_suspects),
+            "class_counts": dict(self._obj_class_counts),
+        }
+
+    def _top_callsites(self, job_hex: Optional[str] = None, top: int = 5):
+        """Top live callsites by bytes (optionally one job's) — the OOM /
+        quota forensics digest. Off-loop tolerant: iterates snapshots."""
+        per_cs: Dict[str, List[int]] = {}
+        try:
+            for rec in list(self._obj_prov.values()):
+                if job_hex is not None and rec["job"] != job_hex:
+                    continue
+                agg = per_cs.setdefault(rec["cs"], [0, 0])
+                agg[0] += 1
+                agg[1] += rec["size"]
+        except RuntimeError:
+            pass  # racing the loop's dict mutation: partial digest is fine
+        return [
+            {"callsite": cs, "count": c, "bytes": b}
+            for cs, (c, b) in sorted(
+                per_cs.items(), key=lambda kv: -kv[1][1]
+            )[: int(top)]
+        ]
+
+    def memory_forensics_snapshot(
+        self, job_bin: Optional[bytes] = None, top: int = 5
+    ) -> dict:
+        """Store usage + top-callsites digest for kill-time forensics (the
+        OOM event names what filled the store, not just the victim).
+        Callable from any thread."""
+        out: dict = {}
+        store = self._node.store_client
+        if store is not None:
+            try:
+                st = store.usage_stats()
+                out["store_used_bytes"] = st["sealed_bytes"]
+                out["store_unsealed_bytes"] = st["unsealed_bytes"]
+            except Exception:
+                pass
+        out["store_capacity_bytes"] = int(self.config.object_store_memory)
+        out["top_callsites"] = self._top_callsites(top=top)
+        if job_bin is not None:
+            out["job_top_callsites"] = self._top_callsites(
+                job_hex=job_bin.hex(), top=top
+            )
+        return out
 
     def request_telemetry_flush(self, timeout: float = 2.0) -> bool:
         """Cluster-wide read-your-writes flush: ask every live worker to
@@ -5875,18 +6424,39 @@ class Scheduler:
         )
         store = self._node.store_client
         used = 0
+        unsealed = 0
         nobj = 0
         if store is not None:
             try:
-                used = int(getattr(store, "usage_bytes", lambda: 0)())
-                nobj = sum(1 for _ in store.list_objects())
+                st = store.usage_stats()
+                used = int(st["sealed_bytes"])
+                unsealed = int(st["unsealed_bytes"])
+                nobj = int(st["sealed_objects"])
+                self._store_highwater = max(
+                    self._store_highwater, used + unsealed
+                )
             except Exception:
                 pass
         add(
             "ray_tpu_object_store_bytes_used",
             "gauge",
-            "bytes of sealed objects in the head object store",
+            "bytes of SEALED objects in the head object store (one "
+            "consistent snapshot; in-flight creates are reported "
+            "separately so usage can never transiently exceed capacity)",
             {lk(): used},
+        )
+        add(
+            "ray_tpu_object_store_unsealed_bytes",
+            "gauge",
+            "bytes of in-flight (created, not yet sealed) store "
+            "allocations",
+            {lk(): unsealed},
+        )
+        add(
+            "ray_tpu_object_store_highwater_bytes",
+            "gauge",
+            "high-water mark of sealed+unsealed store bytes this session",
+            {lk(): int(self._store_highwater)},
         )
         add(
             "ray_tpu_object_store_capacity_bytes",
@@ -5899,6 +6469,73 @@ class Scheduler:
             "gauge",
             "sealed objects in the head object store",
             {lk(): nobj},
+        )
+        # ---- memory observability plane ----
+        add(
+            "ray_tpu_object_provenance_entries",
+            "gauge",
+            "objects tracked by the allocation-provenance index "
+            "(callsite/job/trace per live object)",
+            {lk(): len(self._obj_prov)},
+        )
+        add(
+            "ray_tpu_object_provenance_dropped_total",
+            "counter",
+            "provenance records dropped at the object_provenance_max bound",
+            {lk(): self._prov_dropped},
+        )
+        add(
+            "ray_tpu_object_leak_suspects",
+            "gauge",
+            "callsites currently flagged by the leak watchdog "
+            "(monotonic live-byte growth over the sliding window)",
+            {lk(): len(self._leak_suspects)},
+        )
+        add(
+            "ray_tpu_object_leak_events_total",
+            "counter",
+            "OBJECT_LEAK_SUSPECT cluster events emitted by the watchdog",
+            {lk(): self._leak_events_total},
+        )
+        add(
+            "ray_tpu_objects_by_class",
+            "gauge",
+            "tracked objects by ref-holder classification (IN_USE / "
+            "PINNED_BY_DEAD_OWNER / CAPTURED_IN_ACTOR / LEAK_SUSPECT)",
+            {
+                lk(**{"class": c}): n
+                for c, n in sorted(self._obj_class_counts.items())
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_object_bytes_by_job",
+            "gauge",
+            "live object-store bytes charged per owning job (the "
+            "object_store_bytes quota ledger)",
+            {lk(job=js.name): js.object_bytes for js in jobs_sorted}
+            or {lk(): 0},
+        )
+        def _job_label(job_hex: str) -> str:
+            # label by job NAME like every other per-job series (the raw
+            # 4-byte hex would make this unjoinable with
+            # ray_tpu_object_bytes_by_job in a dashboard)
+            try:
+                js = self._jobs.get(bytes.fromhex(job_hex))
+            except ValueError:
+                js = None
+            return js.name if js is not None else job_hex
+
+        add(
+            "ray_tpu_object_transfer_bytes_by_job",
+            "counter",
+            "completed inter-node transfer bytes split per owning job "
+            "and path",
+            {
+                lk(job=_job_label(j), path=p): n
+                for (j, p), n in sorted(self._xfer_bytes_by_job.items())
+            }
+            or {lk(): 0},
         )
         from ray_tpu._private import fastcopy as _fastcopy
 

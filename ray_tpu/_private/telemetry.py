@@ -81,6 +81,10 @@ class TelemetryBuffer:
         # cluster events recorded OUTSIDE the scheduler (serve replicas,
         # library code); merged into the scheduler's event log on flush
         self._cluster_events: collections.deque = collections.deque()
+        # object provenance records (memory plane: one per store-backed
+        # put / task return / stream item — see _private/memplane.py);
+        # merged into the scheduler's bounded provenance index on flush
+        self._objects: collections.deque = collections.deque()
         # name -> (kind, description, data snapshot): last writer wins, so
         # N records within one interval flush as ONE write per metric
         self._metrics: Dict[str, Tuple[str, str, dict]] = {}
@@ -140,6 +144,16 @@ class TelemetryBuffer:
                 return
             self._cluster_events.append(ev)
 
+    def record_object_event(self, rec) -> None:
+        """One (oid_bin, size, kind, callsite, trace_id, t) provenance
+        tuple (memory plane)."""
+        with self._lock:
+            if len(self._objects) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._objects.append(rec)
+
     def record_metric(self, name: str, kind: str, description: str, data: dict) -> None:
         with self._lock:
             self._metrics[name] = (kind, description, data)
@@ -176,6 +190,7 @@ class TelemetryBuffer:
                 or self._spans
                 or self._logs
                 or self._cluster_events
+                or self._objects
                 or self._metrics
                 or self._samples
                 or self._dropped_pending
@@ -188,6 +203,7 @@ class TelemetryBuffer:
                 list(self._cluster_events),
                 collections.deque(),
             )
+            objects, self._objects = list(self._objects), collections.deque()
             metrics, self._metrics = dict(self._metrics), {}
             samples, self._samples = (
                 [(k, v) for k, v in self._samples.items()],
@@ -200,6 +216,7 @@ class TelemetryBuffer:
             "spans": spans,
             "logs": logs,
             "cluster_events": cluster_events,
+            "objects": objects,
             "metrics": metrics,
             "samples": samples,
             "dropped": dropped,
@@ -221,6 +238,7 @@ class TelemetryBuffer:
             + len(batch["spans"])
             + len(batch["logs"])
             + len(batch["cluster_events"])
+            + len(batch.get("objects") or ())
             # per-SAMPLE, not per-stack-key (matches record_samples and the
             # scheduler-side accounting)
             + sum(n for _k, n in batch.get("samples") or ())
@@ -265,6 +283,14 @@ class TelemetryBuffer:
                 from ray_tpu._private import sampler as _sampler
 
                 _sampler.maybe_install_jax_hooks()
+            except Exception:
+                pass
+            try:
+                # memory plane: per-device jax memory gauges on the same
+                # probe-don't-import seam (self-rate-limited)
+                from ray_tpu._private import memplane as _memplane
+
+                _memplane.maybe_record_device_metrics()
             except Exception:
                 pass
 
@@ -322,6 +348,16 @@ def record_log(rec: dict) -> None:
     if not enabled():
         return
     _buffer.record_log(rec)
+    _buffer.ensure_flusher()
+
+
+def record_object_event(rec) -> None:
+    """One object-provenance tuple (memory plane); batched. The hot-path
+    caller (``memplane.record_object``) gates on ``memplane.enabled()``
+    and appends to the buffer directly; this wrapper is for cold paths."""
+    if not enabled():
+        return
+    _buffer.record_object_event(rec)
     _buffer.ensure_flusher()
 
 

@@ -384,7 +384,12 @@ class DriverRuntime:
         oid = ObjectID.for_put(self.task_id, self._put_counter.next())
         size = self.store.put_serialized(oid, self.serde, value)
         self.scheduler.memory_store.put(oid, ("stored",))
-        self.scheduler.post(("put_done", oid, ("stored",), size))
+        from ray_tpu._private import memplane
+
+        # provenance rides the registration message itself (memory plane)
+        self.scheduler.post(
+            ("put_done", oid, ("stored",), size, memplane.capture_put())
+        )
         return oid
 
     def object_ready(self, oid: ObjectID) -> bool:

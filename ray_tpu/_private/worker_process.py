@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import serialization
+from ray_tpu._private import memplane, serialization
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID, _Counter
 from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, TaskSpec, TaskType
@@ -340,7 +340,8 @@ class WorkerRuntime:
         tid = self.current_task_id or TaskID.nil()
         oid = ObjectID.for_put(tid, self._put_counter.next())
         size = self.store.put_serialized(oid, self.serde, value)
-        self._send(("submit_put", oid, size))
+        # provenance rides the registration message itself (memory plane)
+        self._send(("submit_put", oid, size, memplane.capture_put()))
         return oid
 
     def get_objects(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
@@ -824,6 +825,11 @@ class WorkerRuntime:
                         except ValueError:
                             if not self.store.contains(oid):
                                 raise
+                    # provenance: a return's creation site IS the task —
+                    # group leaked returns under the function that made them
+                    memplane.record_object(
+                        oid, size, "return", callsite=f"task:{spec.name}"
+                    )
                     out.append(("stored",))
                 except StoreFullError:
                     out.append(
@@ -952,6 +958,12 @@ class WorkerRuntime:
                     item_oid = ObjectID.for_return(spec.task_id, count + 1)
                     if entry[0] == "stored":
                         self.store.put_bytes(item_oid, blob)
+                        memplane.record_object(
+                            item_oid,
+                            len(blob),
+                            "stream_item",
+                            callsite=f"task:{spec.name}",
+                        )
                     if reply is not None:
                         # direct caller: the item rides its connection; large
                         # items additionally register at the head so any

@@ -58,7 +58,23 @@ def start_dashboard(port: int = 8765) -> int:
                 elif self.path == "/api/workers":
                     body = state.list_workers()
                 elif self.path == "/api/objects":
-                    body = state.list_objects()
+                    # local flush only — 2s UI polling (see /api/memory)
+                    body = state.list_objects_page(cluster_flush=False)[
+                        "rows"
+                    ]
+                elif urlparse(self.path).path == "/api/memory":
+                    # memory plane: live objects grouped server-side by
+                    # callsite/job/node + store usage + leak suspects.
+                    # Local flush only: the UI re-polls every 2s, and a
+                    # cluster-wide flush fan-out per tick would hammer
+                    # every worker (same rationale as /api/trace) —
+                    # worker-side records lag at most one batch interval
+                    q = parse_qs(urlparse(self.path).query)
+                    body = state.summarize_objects(
+                        group_by=q.get("group_by", ["callsite"])[0],
+                        limit=int(q.get("limit", ["50"])[0]),
+                        cluster_flush=False,
+                    )
                 elif self.path == "/api/placement_groups":
                     body = state.list_placement_groups()
                 elif self.path == "/api/serve":

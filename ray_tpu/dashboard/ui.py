@@ -56,8 +56,8 @@ PAGE = """<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
-              "placement_groups","serve","jobs","logs","events","event_stats",
-              "traces","latency","stacks","profile"];
+              "memory","placement_groups","serve","jobs","logs","events",
+              "event_stats","traces","latency","stacks","profile"];
 // hash may carry a selection suffix, e.g. "#traces:<trace_id>"
 let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
 window.addEventListener("hashchange", () => {
@@ -125,6 +125,31 @@ const RENDER = {
     const total = rows.reduce((a,r)=>a+(r.size_bytes||0), 0);
     return `<p>${rows.length} objects, ${(total/1e6).toFixed(1)} MB</p>` +
       table(rows.slice(0,300));
+  },
+  async memory() {
+    // memory plane: live objects grouped by creation callsite, store
+    // usage split (sealed vs unsealed vs capacity), leak suspects
+    const s = await j("/api/memory?group_by=callsite&limit=50");
+    const st = s.store || {};
+    const mb = (n)=> ((n||0)/1e6).toFixed(1);
+    const rows = (s.rows||[]).map(g => ({
+      callsite: g.group, count: g.count, mb: mb(g.bytes),
+      leak: g.leak_suspect ? "YES" : "",
+      classes: Object.entries(g.classes||{}).map(([c,n])=>`${c}:${n}`).join(" "),
+      jobs: (g.jobs||[]).join(" "),
+      exemplars: (g.exemplars||[]).map(o=>o.slice(0,12)).join(" "),
+    }));
+    const leaks = Object.values(s.leak_suspects||{}).map(i => ({
+      callsite: i.callsite, live: i.live_count, mb: mb(i.live_bytes),
+      growth_mb: mb(i.growth_bytes), window_s: i.window_s,
+    }));
+    return `<p>${s.total_objects} live objects, ${mb(s.total_bytes)} MB — ` +
+      `store sealed ${mb(st.sealed_bytes)} / unsealed ${mb(st.unsealed_bytes)} ` +
+      `/ capacity ${mb(st.capacity_bytes)} / high-water ${mb(st.highwater_bytes)} MB</p>` +
+      (leaks.length ? `<h2>leak suspects</h2>` +
+        table(leaks, ["callsite","live","mb","growth_mb","window_s"]) : "") +
+      `<h2>by creation callsite</h2>` +
+      table(rows, ["callsite","count","mb","leak","classes","jobs","exemplars"]);
   },
   async placement_groups() { return table(await j("/api/placement_groups")); },
   async serve() {

@@ -125,15 +125,99 @@ def cmd_summary(args):
     print(json.dumps(state.summarize_tasks(), indent=2))
 
 
+_MEM_UNITS = {"B": 1, "KB": 1e3, "MB": 1e6, "GB": 1e9}
+
+
+def _fmt_bytes(n, units: str) -> str:
+    div = _MEM_UNITS[units]
+    return f"{n}" if units == "B" else f"{n / div:.1f}"
+
+
 def cmd_memory(args):
+    """Memory plane: live objects grouped by creation callsite (or job /
+    node / ungrouped) with owner, bytes, and leak classification — the
+    ``ray memory`` parity surface for "where did the bytes go"."""
     from ray_tpu.util import state
 
     _init(args)
-    rows = state.list_objects()
-    total = sum(r["size_bytes"] for r in rows)
-    print(f"{len(rows)} objects, {total / 1e6:.1f} MB total")
-    for r in rows[:50]:
-        print(f"  {r['object_id'][:16]} {r['size_bytes']:>12} bytes refs={r['ref_count']}")
+    units = args.units
+    if args.group_by == "object":
+        page = state.list_objects_page(limit=args.limit)
+        rows = page["rows"]
+        if args.leaks_only:
+            rows = [r for r in rows if r.get("class") == "LEAK_SUSPECT"]
+        rows.sort(key=lambda r: -r["size_bytes"])
+        if args.json:
+            page["rows"] = rows  # --leaks-only + sort apply to JSON too
+            print(json.dumps(page, indent=2, default=str))
+            return
+        total = sum(r["size_bytes"] for r in rows)
+        print(
+            f"{len(rows)} objects, {_fmt_bytes(total, units)} {units} live"
+            + ("  [TRUNCATED]" if page.get("truncated") else "")
+        )
+        print(
+            f"{'BYTES(' + units + ')':>12} {'REFS':>5} {'CLASS':<20} "
+            f"{'JOB':<10} {'KIND':<12} {'OBJECT':<18} CALLSITE"
+        )
+        for r in rows:
+            print(
+                f"{_fmt_bytes(r['size_bytes'], units):>12} "
+                f"{r['ref_count']:>5} {r.get('class') or '-':<20} "
+                f"{r.get('job') or '-':<10} {r.get('kind') or '-':<12} "
+                f"{r['object_id'][:16]:<18} {r.get('callsite') or '-'}"
+            )
+        return
+    summary = state.summarize_objects(group_by=args.group_by, limit=args.limit)
+    rows = summary["rows"]
+    if args.leaks_only:
+        rows = [r for r in rows if r.get("leak_suspect")]
+    if args.json:
+        summary["rows"] = rows
+        print(json.dumps(summary, indent=2, default=str))
+        return
+    store = summary.get("store") or {}
+    print(
+        f"== object store: {summary['total_objects']} live objects, "
+        f"{_fmt_bytes(summary['total_bytes'], units)} {units} "
+        f"(sealed {_fmt_bytes(store.get('sealed_bytes', 0), units)} / "
+        f"unsealed {_fmt_bytes(store.get('unsealed_bytes', 0), units)} / "
+        f"capacity {_fmt_bytes(store.get('capacity_bytes', 0), units)} / "
+        f"high-water {_fmt_bytes(store.get('highwater_bytes', 0), units)} "
+        f"{units}) =="
+    )
+    print(
+        f"{'BYTES(' + units + ')':>12} {'COUNT':>6} {'LEAK':<5} "
+        f"{'CLASSES':<28} {args.group_by.upper()}"
+    )
+    for g in rows:
+        classes = ",".join(
+            f"{c}:{n}" for c, n in sorted(g.get("classes", {}).items())
+        )
+        print(
+            f"{_fmt_bytes(g['bytes'], units):>12} {g['count']:>6} "
+            f"{'YES' if g.get('leak_suspect') else '-':<5} "
+            f"{classes:<28} {g['group']}"
+        )
+    if summary.get("truncated"):
+        print(f"  ... truncated at {args.limit} groups")
+    suspects = summary.get("leak_suspects") or {}
+    if suspects:
+        print(f"== leak suspects ({len(suspects)}) ==")
+        for cs, info in sorted(
+            suspects.items(), key=lambda kv: -kv[1]["live_bytes"]
+        ):
+            print(
+                f"  {cs}: {info['live_count']} objects, "
+                f"{_fmt_bytes(info['live_bytes'], units)} {units} "
+                f"(+{_fmt_bytes(info['growth_bytes'], units)} over "
+                f"{info['window_s']:g}s)  exemplars: "
+                + ",".join(
+                    o[:16] for o in info.get("exemplar_object_ids", [])[:3]
+                )
+            )
+    elif args.leaks_only and not rows:
+        print("no leak suspects")
 
 
 def cmd_events(args):
@@ -547,7 +631,32 @@ def main(argv=None):
     p = sub.add_parser("summary", help="task state summary")
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("memory", help="object store contents")
+    p = sub.add_parser(
+        "memory",
+        help="live objects by creation callsite with owner/bytes/leak "
+        "classification (memory plane)",
+    )
+    p.add_argument(
+        "--group-by",
+        dest="group_by",
+        choices=["callsite", "job", "node", "object"],
+        default="callsite",
+        help="server-side grouping (object = ungrouped per-object rows)",
+    )
+    p.add_argument(
+        "--units",
+        choices=sorted(_MEM_UNITS),
+        default="MB",
+        help="byte display units",
+    )
+    p.add_argument(
+        "--leaks-only",
+        dest="leaks_only",
+        action="store_true",
+        help="only rows flagged by the leak watchdog",
+    )
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("timeline", help="dump chrome-trace task timeline")

@@ -17,10 +17,12 @@ from ray_tpu.util.state.api import (
     list_logs,
     list_nodes,
     list_objects,
+    list_objects_page,
     list_placement_groups,
     list_tasks,
     list_traces,
     list_workers,
+    summarize_objects,
     summarize_tasks,
 )
 
@@ -30,6 +32,8 @@ __all__ = [
     "list_actors",
     "list_checkpoints",
     "list_objects",
+    "list_objects_page",
+    "summarize_objects",
     "list_nodes",
     "list_workers",
     "list_placement_groups",

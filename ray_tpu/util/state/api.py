@@ -72,8 +72,56 @@ def list_nodes(filters=None, limit: int = 10_000) -> List[dict]:
     return _list("list_nodes", filters, limit)
 
 
+def _flush_for_read(cluster: bool = True) -> None:
+    """Read-your-writes for memory-plane reads: provenance records ride
+    telemetry batches, so pull buffered batches first (in-process driver
+    only; remote drivers accept one interval of lag). ``cluster=False``
+    drains only THIS process — polling consumers (the dashboard's 2s
+    tick) must not fan a flush broadcast out to every worker per poll
+    (same rationale as the /api/trace handler)."""
+    rt = get_runtime()
+    if hasattr(rt, "scheduler"):
+        from ray_tpu._private import telemetry
+
+        telemetry.flush()
+        if cluster:
+            try:
+                rt.scheduler.request_telemetry_flush()
+            except Exception:
+                pass
+
+
 def list_objects(filters=None, limit: int = 10_000) -> List[dict]:
-    return _list("list_objects", filters, limit)
+    """Live objects with allocation provenance (memory plane): one row per
+    object with ``size_bytes`` / ``ref_count`` / ``callsite`` / ``kind`` /
+    ``job`` / ``task`` / ``class`` / ``age_s`` / ``trace_id``. Filters AND
+    the row cap run server-side (the PR-2 pushdown contract) — see
+    :func:`list_objects_page` for the truncation flag."""
+    return list_objects_page(filters, limit)["rows"]
+
+
+def list_objects_page(
+    filters=None, limit: int = 10_000, *, cluster_flush: bool = True
+) -> dict:
+    """``{"rows": [...], "truncated": bool, "total": matched}`` — the raw
+    server reply. ``truncated`` means more rows matched than the (hard-
+    capped) limit returned; ``total`` counts every match examined."""
+    _flush_for_read(cluster=cluster_flush)
+    return _rpc("list_objects", limit, filters)
+
+
+def summarize_objects(
+    group_by: str = "callsite", limit: int = 50, *, cluster_flush: bool = True
+) -> dict:
+    """Server-side grouping of live objects by creation ``callsite`` /
+    ``job`` / ``node`` (parity: ``ray memory``'s group-by views): rows
+    carry live count+bytes, the ref-holder classification split (IN_USE /
+    PINNED_BY_DEAD_OWNER / CAPTURED_IN_ACTOR / LEAK_SUSPECT), exemplar
+    object ids, and a ``leak_suspect`` flag from the watchdog; plus store
+    usage (sealed vs unsealed vs capacity, high-water) and the current
+    leak-suspect table."""
+    _flush_for_read(cluster=cluster_flush)
+    return _rpc("summarize_objects", group_by, limit)
 
 
 def list_placement_groups(filters=None, limit: int = 10_000) -> List[dict]:

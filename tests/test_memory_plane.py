@@ -1,0 +1,404 @@
+"""Memory-observability plane tests (fast tier-1).
+
+Covers: allocation-provenance round-trip (driver puts + task returns land
+in the scheduler's index with a resolvable creation callsite), server-side
+``list_objects`` filter pushdown with the hard row cap + truncation flag,
+``summarize_objects`` groupings, the leak watchdog (flags a deliberately
+leaked ref within one window; stays silent on a churning-but-bounded
+workload), sealed-vs-unsealed store accounting, per-job spill byte
+attribution, the OOM-kill memory snapshot, the ``ray_tpu memory`` CLI
+output, and a PR-2/PR-11 telemetry regression guard with the plane on.
+"""
+
+import gc
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def _sch():
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().node.scheduler
+
+
+@pytest.fixture
+def two_cpu():
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def leak_tuned():
+    """Cluster with the watchdog tuned tight enough to converge within a
+    test budget: 0.1s scans, 5-scan window, small growth thresholds, fast
+    telemetry flushes so provenance reaches the index promptly."""
+    rt = ray_tpu.init(
+        num_cpus=1,
+        _system_config={
+            "leak_watchdog_interval_s": 0.1,
+            "leak_watchdog_window": 5,
+            "leak_watchdog_min_growth_bytes": 50_000,
+            "leak_watchdog_min_count_growth": 3,
+            "metrics_report_interval_ms": 50,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _flush():
+    from ray_tpu._private import telemetry
+
+    telemetry.flush()
+    _sch().request_telemetry_flush()
+
+
+def test_callsite_provenance_roundtrip(two_cpu):
+    """Driver puts and task returns land in the provenance index with a
+    resolvable creation callsite, owner job/task decoded from the oid,
+    size, and kind."""
+
+    @ray_tpu.remote
+    def make_block():
+        return np.zeros(200_000, dtype=np.uint8)
+
+    put_ref = ray_tpu.put(np.ones(150_000, dtype=np.uint8))  # PROBE-LINE
+    ret_ref = make_block.remote()
+    ray_tpu.get(ret_ref, timeout=60)
+    _flush()
+    rows = {r["object_id"]: r for r in state.list_objects()}
+    put_row = rows[put_ref.hex()]
+    assert put_row["callsite"].startswith("test_memory_plane.py:")
+    assert put_row["kind"] == "put"
+    assert put_row["size_bytes"] > 150_000
+    assert put_row["job"] == put_ref.binary()[20:24].hex()
+    assert put_row["task"] == put_ref.binary()[:24].hex()
+    assert put_row["class"] in (
+        "IN_USE",
+        "CAPTURED_IN_ACTOR",
+        "LEAK_SUSPECT",
+        "PINNED_BY_DEAD_OWNER",
+    )
+    ret_row = rows[ret_ref.hex()]
+    assert ret_row["callsite"] == "task:make_block"
+    assert ret_row["kind"] == "return"
+    assert ret_row["size_bytes"] > 200_000
+    # server-side grouping: the put's callsite shows up with its bytes
+    summary = state.summarize_objects(group_by="callsite")
+    by_group = {g["group"]: g for g in summary["rows"]}
+    assert any(
+        cs.startswith("test_memory_plane.py:") for cs in by_group
+    ), summary["rows"]
+    assert "task:make_block" in by_group
+    assert by_group["task:make_block"]["bytes"] >= 200_000
+    assert summary["total_bytes"] >= 350_000
+    # job grouping sums both objects under the interactive job
+    jobs = state.summarize_objects(group_by="job")
+    jrow = {g["group"]: g for g in jobs["rows"]}[put_row["job"]]
+    assert jrow["count"] >= 2
+    # exemplars resolve back to real object ids
+    assert all(len(e) == 56 for g in summary["rows"] for e in g["exemplars"])
+
+
+def test_list_objects_server_side_filter_and_cap(two_cpu):
+    refs = [ray_tpu.put(np.zeros(60_000, dtype=np.uint8)) for _ in range(8)]
+    big = ray_tpu.put(np.zeros(500_000, dtype=np.uint8))
+    _flush()
+    # ordering filter pushed server-side: only the big object matches
+    page = state.list_objects_page(
+        filters=[("size_bytes", ">", 400_000)], limit=100
+    )
+    assert [r["object_id"] for r in page["rows"]] == [big.hex()]
+    assert page["total"] == 1 and not page["truncated"]
+    # hard cap + truncation flag: more matches than the limit
+    page = state.list_objects_page(limit=3)
+    assert len(page["rows"]) == 3
+    assert page["truncated"] is True
+    assert page["total"] >= 9
+    # equality filter on provenance fields works server-side too
+    page = state.list_objects_page(filters=[("kind", "=", "put")], limit=100)
+    assert page["total"] >= 9
+    del refs, big
+
+
+def test_leak_watchdog_flags_seeded_leak(leak_tuned):
+    """A deliberately leaked ref stream (grow-only holder list) is flagged
+    within one window: OBJECT_LEAK_SUSPECT with a resolvable callsite and
+    exemplar object ids."""
+    from ray_tpu._private import telemetry
+
+    hoard = []
+    deadline = time.monotonic() + 20
+    flagged = []
+    while time.monotonic() < deadline:
+        hoard.append(ray_tpu.put(np.zeros(30_000, dtype=np.uint8)))  # LEAK-SITE
+        telemetry.flush()
+        flagged = state.list_cluster_events(
+            filters=[("type", "=", "OBJECT_LEAK_SUSPECT")]
+        )
+        if flagged:
+            break
+        time.sleep(0.1)
+    assert flagged, "leak watchdog never flagged the seeded leak"
+    ev = flagged[-1]
+    # the callsite resolves to the leaking line in THIS file
+    assert ev["callsite"].startswith("test_memory_plane.py:")
+    assert ev["live_count"] >= 3
+    assert ev["live_bytes"] >= 50_000
+    exemplars = ev["exemplar_object_ids"]
+    assert exemplars and all(len(e) == 56 for e in exemplars)
+    live_ids = {r.hex() for r in hoard}
+    assert set(exemplars) <= live_ids
+    # the suspect surfaces in summarize_objects + the class counts
+    summary = state.summarize_objects(group_by="callsite")
+    assert ev["callsite"] in summary["leak_suspects"]
+    flagged_groups = [g for g in summary["rows"] if g["leak_suspect"]]
+    assert any(g["group"] == ev["callsite"] for g in flagged_groups)
+
+
+def test_leak_watchdog_silent_on_bounded_churn(leak_tuned):
+    """A churning-but-bounded put/get/del workload (the calm bench_core
+    shape) must produce ZERO leak suspects."""
+    from ray_tpu._private import telemetry
+
+    keep = None
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 2.5:
+        keep = ray_tpu.put(np.zeros(40_000, dtype=np.uint8))
+        ray_tpu.get(keep, timeout=30)
+        keep = None
+        gc.collect()
+        telemetry.flush()
+        time.sleep(0.02)
+    events = state.list_cluster_events(
+        filters=[("type", "=", "OBJECT_LEAK_SUSPECT")]
+    )
+    assert events == [], f"false-positive leak flags on bounded churn: {events}"
+    assert _sch()._leak_suspects == {}
+
+
+def test_usage_stats_sealed_unsealed_split(tmp_path):
+    """usage_stats snapshots under the store lock and reports in-flight
+    (created, unsealed) bytes separately from sealed ones."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectStoreClient
+
+    store = ObjectStoreClient(
+        str(tmp_path / "shm"), str(tmp_path / "fb"), capacity=1 << 24
+    )
+    sealed_id, open_id = ObjectID.from_random(), ObjectID.from_random()
+    buf = store.create(sealed_id, 1000)
+    buf[:4] = b"xxxx"
+    store.seal(sealed_id)
+    store.create(open_id, 2000)  # deliberately never sealed
+    st = store.usage_stats()
+    assert st["sealed_objects"] == 1 and st["unsealed_objects"] == 1
+    assert 1000 <= st["sealed_bytes"] <= 1100
+    assert 2000 <= st["unsealed_bytes"] <= 2100
+    # usage_bytes = one consistent snapshot's total
+    assert store.usage_bytes() == st["sealed_bytes"] + st["unsealed_bytes"]
+    store.abort(open_id)
+    st = store.usage_stats()
+    assert st["unsealed_objects"] == 0 and st["unsealed_bytes"] == 0
+    store.close()
+
+
+def test_spill_bytes_attributed_per_job(tmp_path):
+    """LRU spill out of a small arena lands on the owning job's
+    ray_tpu_spill_bytes_total series."""
+    rt = ray_tpu.init(num_cpus=1, object_store_memory=8 * 1024 * 1024)
+    try:
+        from ray_tpu._private.native_store import NativeStoreClient
+
+        if not isinstance(rt.node.store_client, NativeStoreClient):
+            pytest.skip("native arena store not available (no LRU spill path)")
+        refs = [
+            ray_tpu.put(np.random.bytes(3 * 1024 * 1024)) for _ in range(4)
+        ]
+        from ray_tpu.util.metrics import prometheus_text
+
+        text = prometheus_text()
+        job_hex = rt.job_id.binary().hex()
+        needle = f'ray_tpu_spill_bytes_total{{job="{job_hex}"}}'
+        assert needle in text, text[:2000]
+        value = float(
+            next(
+                line.split()[-1]
+                for line in text.splitlines()
+                if line.startswith(needle)
+            )
+        )
+        assert value >= 3 * 1024 * 1024
+        del refs
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_event_carries_memory_snapshot(two_cpu):
+    """The memory-monitor kill event names what FILLED the store (usage +
+    top callsites) and the victim-ranking provenance, not just the
+    victim."""
+    from ray_tpu._private.memory_monitor import make_scheduler_kill_policy
+
+    hold = ray_tpu.put(np.zeros(300_000, dtype=np.uint8))  # OOM-FILLER
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        time.sleep(60)
+
+    ref = hog.remote()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any(
+            t["state"] == "RUNNING"
+            for t in state.list_tasks(filters=[("name", "=", "hog")])
+        ):
+            break
+        time.sleep(0.05)
+    _flush()
+    kill = make_scheduler_kill_policy(_sch())
+    assert kill()
+    events = state.list_cluster_events(filters=[("type", "=", "OOM")])
+    assert events
+    ev = events[-1]
+    assert ev["store_capacity_bytes"] > 0
+    assert "store_used_bytes" in ev
+    tops = ev["top_callsites"]
+    assert tops and any(
+        t["callsite"].startswith("test_memory_plane.py:") for t in tops
+    )
+    assert "job_top_callsites" in ev
+    # pick_oom_victim provenance in the event body
+    victim = ev["victim"]
+    assert victim["task_name"] == "hog"
+    assert victim["retriable"] is True
+    assert victim["task_id"]
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+    del hold
+
+
+def test_memory_cli_output(two_cpu, capsys):
+    """`ray_tpu memory` golden-ish output: store header, grouped callsite
+    rows with bytes/count/class columns, --json parses, units honored."""
+    from ray_tpu.scripts import cli
+
+    keep = ray_tpu.put(np.zeros(250_000, dtype=np.uint8))  # CLI-SITE
+    _flush()
+    cli.main(["memory", "--units", "KB"])
+    out = capsys.readouterr().out
+    assert "== object store:" in out
+    assert "BYTES(KB)" in out and "CALLSITE" in out
+    assert "test_memory_plane.py:" in out
+    cli.main(["memory", "--group-by", "object", "--units", "B", "--limit", "10"])
+    out = capsys.readouterr().out
+    assert "OBJECT" in out and "test_memory_plane.py:" in out
+    cli.main(["memory", "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["group_by"] == "callsite"
+    assert parsed["total_bytes"] >= 250_000
+    cli.main(["memory", "--leaks-only"])
+    out = capsys.readouterr().out
+    assert "== object store:" in out  # calm cluster: header, no leak rows
+    del keep
+
+
+def test_telemetry_and_tracing_regression_guard(two_cpu):
+    """PR-2/PR-11 surfaces stay intact with the memory plane on: timeline
+    events flow, prometheus text exposes both old and new series, traces
+    still resolve."""
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1), timeout=60) == 2
+    keep = ray_tpu.put(np.zeros(120_000, dtype=np.uint8))
+    events = ray_tpu.timeline()
+    assert any(e.get("cat") == "TASK_PHASE" for e in events)
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    for series in (
+        "ray_tpu_telemetry_batches_total",  # PR-2
+        "ray_tpu_scheduler_queue_depth",  # PR-2
+        "ray_tpu_object_store_bytes_used",  # PR-2 (now sealed-only)
+        "ray_tpu_object_store_unsealed_bytes",  # memory plane
+        "ray_tpu_object_provenance_entries",  # memory plane
+        "ray_tpu_objects_by_class",  # memory plane
+    ):
+        assert series in text, f"{series} missing from /metrics"
+    traces = ray_tpu.recent_traces(limit=5)
+    assert traces, "tracing plane lost its recent-trace index"
+    t = ray_tpu.trace(traces[0]["trace_id"])
+    assert t.span_count() >= 1
+    del keep
+
+
+def test_device_memory_gauges(two_cpu):
+    """Once jax is imported, the device-memory sweep records live-array
+    gauges (the PR-11 probe-don't-import seam)."""
+    import jax
+    import jax.numpy as jnp
+
+    keep = jnp.zeros((1024,), dtype=jnp.float32)
+    keep.block_until_ready()
+    from ray_tpu._private import memplane
+
+    assert memplane.collect_device_metrics()
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "ray_tpu_device_live_buffers" in text
+    assert "ray_tpu_device_live_bytes" in text
+    value = max(
+        float(line.split()[-1])
+        for line in text.splitlines()
+        if line.startswith("ray_tpu_device_live_bytes{")
+    )
+    assert value >= keep.nbytes
+    del keep, jax
+
+
+def test_provenance_index_bounded(two_cpu):
+    """Overflow beyond object_provenance_max is counted, never silent."""
+    sch = _sch()
+    sch.config.object_provenance_max = 5
+    try:
+        refs = [
+            ray_tpu.put(np.zeros(40_000, dtype=np.uint8)) for _ in range(9)
+        ]
+        _flush()
+        assert len(sch._obj_prov) <= 5
+        series = {
+            s["name"]: s for s in ray_tpu.get_runtime().rpc("runtime_metrics")
+        }
+        dropped = sum(
+            series["ray_tpu_object_provenance_dropped_total"]["data"].values()
+        )
+        assert dropped >= 4
+        del refs
+    finally:
+        sch.config.object_provenance_max = 50_000
+
+
+def test_freed_objects_leave_the_index(two_cpu):
+    ref = ray_tpu.put(np.zeros(90_000, dtype=np.uint8))
+    oid_hex = ref.hex()
+    _flush()
+    assert any(r["object_id"] == oid_hex for r in state.list_objects())
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(r["object_id"] != oid_hex for r in state.list_objects()):
+            break
+        time.sleep(0.2)
+    assert all(r["object_id"] != oid_hex for r in state.list_objects())
+    assert oid_hex not in _sch()._obj_prov

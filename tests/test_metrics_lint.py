@@ -1,0 +1,112 @@
+"""Metrics lint (tier-1, fast): every ``ray_tpu_*`` Prometheus series must
+be snake_case, registered in exactly one place, and documented in the
+DESIGN_MAP metrics table — and the table must not list dead series.
+
+Registration sites are the two real pipelines:
+
+* ``metrics.Counter/Gauge/Histogram("ray_tpu_...")`` constructors
+  (application metrics riding the telemetry KV aggregation), and
+* ``add("ray_tpu_...", kind, ...)`` rows in the scheduler's
+  ``_runtime_metric_series`` (runtime-internal series).
+
+Docstrings/comments mentioning a series name do not count. An
+undocumented, duplicated, or badly-named series fails here, at commit
+time, instead of surfacing as a silently-unscrapable dashboard panel.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+DESIGN_MAP = os.path.join(REPO, "DESIGN_MAP.md")
+
+# a registration is a Counter/Gauge/Histogram constructor or a runtime
+# `add(` series row whose FIRST argument is the literal series name
+# (possibly on the next line — black wraps long calls)
+_REG_RE = re.compile(
+    r"(?:\b(?:Counter|Gauge|Histogram)|(?<![\w.])add)\(\s*\n?\s*"
+    r"[rbf]?[\"'](ray_tpu_[A-Za-z0-9_]+)[\"']",
+    re.MULTILINE,
+)
+_SNAKE_RE = re.compile(r"^ray_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+# DESIGN_MAP metrics-table rows: `| ray_tpu_foo | kind | ... |`
+_TABLE_RE = re.compile(r"^\|\s*`?(ray_tpu_[A-Za-z0-9_]+)`?\s*\|", re.MULTILINE)
+
+
+def find_registrations() -> Dict[str, List[Tuple[str, int]]]:
+    """series name -> [(relpath, lineno), ...] across the package."""
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            for m in _REG_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                sites.setdefault(m.group(1), []).append(
+                    (os.path.relpath(path, REPO), line)
+                )
+    return sites
+
+
+def documented_series() -> List[str]:
+    with open(DESIGN_MAP, encoding="utf-8") as fh:
+        return _TABLE_RE.findall(fh.read())
+
+
+def test_metric_names_are_snake_case():
+    bad = [n for n in find_registrations() if not _SNAKE_RE.match(n)]
+    assert not bad, f"non-snake_case metric series: {bad}"
+
+
+def test_single_registration_site_per_series():
+    dupes = {
+        name: sites
+        for name, sites in find_registrations().items()
+        if len(sites) > 1
+    }
+    assert not dupes, (
+        "metric series registered in more than one place (merge them or "
+        f"rename): {dupes}"
+    )
+
+
+def test_every_series_documented_in_design_map():
+    registered = set(find_registrations())
+    documented = documented_series()
+    missing = sorted(registered - set(documented))
+    assert not missing, (
+        "series registered in code but missing from the DESIGN_MAP "
+        f"metrics table: {missing}"
+    )
+
+
+def test_no_stale_series_in_design_map():
+    registered = set(find_registrations())
+    documented = documented_series()
+    stale = sorted(set(documented) - registered)
+    assert not stale, (
+        "DESIGN_MAP metrics table documents series with no registration "
+        f"site (dead docs): {stale}"
+    )
+    dupes = sorted(n for n in set(documented) if documented.count(n) > 1)
+    assert not dupes, f"series listed twice in the DESIGN_MAP table: {dupes}"
+
+
+def test_scanner_finds_known_series():
+    """Guard the scanner itself: if the regex rots, the other tests pass
+    vacuously. These three series span both registration pipelines."""
+    found = find_registrations()
+    for name in (
+        "ray_tpu_object_store_bytes_used",  # scheduler add(...)
+        "ray_tpu_spill_bytes_total",  # memplane Counter(...)
+        "ray_tpu_serve_request_latency_ms",  # serve Histogram(...)
+    ):
+        assert name in found, f"metrics-lint scanner lost {name}"
