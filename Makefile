@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs ci clean
 
 all: native cpp
 
@@ -66,6 +66,19 @@ trace-demo:
 # --append writes the rows to BENCH_CORE.jsonl
 bench-trace:
 	JAX_PLATFORMS=cpu $(PY) bench_trace.py
+
+# training step-plane smoke: 2-rank run with throttled ingest + per-step
+# checkpoints -> per-rank step waterfall (stage sums within 10% of wall),
+# then a seeded-kill rerun whose goodput gap must be attributed by the
+# downtime ledger. Fails non-zero on any coverage/attribution violation.
+train-obs-demo:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/train_obs_demo.py
+
+# step-plane overhead: alternating fresh-cluster on/off pairs over a tight
+# report loop; the recorded acceptance signal is the per-step ratio
+# (budget <= 1.05). --append writes the row to BENCH_CORE.jsonl
+bench-train-obs:
+	JAX_PLATFORMS=cpu $(PY) bench_train_obs.py --append
 
 # multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
 # must not degrade a high-priority job's p99 probe latency beyond 2x its

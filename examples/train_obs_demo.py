@@ -1,0 +1,174 @@
+"""Training step-plane smoke (`make train-obs-demo`).
+
+Two proofs, non-zero exit on violation:
+
+1. **Stage coverage** — a calm 2-rank run with a throttled dataset and
+   per-step checkpoints: every per-rank step record's stage decomposition
+   (data_wait / host_to_device / compile / compute / collective_wait /
+   checkpoint_stall / other) must sum to within 10% of its measured step
+   wall, the throttled data operator must be named in the ingest stalls,
+   and the per-rank step waterfall is printed.
+
+2. **Downtime attribution** — the same run re-executed with one seeded
+   kill (rank 1 dies once mid-run): the goodput gap vs the calm run must
+   be attributed by the downtime ledger — ledger seconds within 10% of
+   the calm-vs-churned wall delta (plus a small absolute slack for
+   scheduler noise on shared hosts).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.util import state
+
+STEPS = 8
+STEP_SLEEP = 0.15
+STAGES = (
+    "data_wait_ms",
+    "host_to_device_ms",
+    "compile_ms",
+    "compute_ms",
+    "collective_wait_ms",
+    "checkpoint_stall_ms",
+    "other_ms",
+)
+
+
+def make_loop(kill_marker=None):
+    def loop(config):
+        ctx = train.get_context()
+        it = train.get_dataset_shard("train")
+        batches = it.iter_batches(batch_size=4) if it is not None else None
+        # checkpoint-resumable: a recovered attempt continues from the
+        # committed step instead of redoing work — the churned run then
+        # does the SAME useful work as the calm one, so the wall delta is
+        # pure downtime for the ledger to attribute
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "step.txt")) as fh:
+                start = int(fh.read()) + 1
+        for i in range(start, STEPS):
+            if batches is not None:
+                next(batches, None)  # throttled ingest -> data_wait
+            time.sleep(STEP_SLEEP)  # "compute"
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "step.txt"), "w") as fh:
+                fh.write(str(i))
+            train.report(
+                {"step": float(i)}, checkpoint=Checkpoint.from_directory(d)
+            )
+            if (
+                kill_marker
+                and i == 3
+                and ctx.get_world_rank() == 1
+                and not os.path.exists(kill_marker)
+            ):
+                open(kill_marker, "w").close()
+                os._exit(1)  # seeded preemption
+
+    return loop
+
+
+def run(name, tmp, kill_marker=None):
+    def slow(block):
+        time.sleep(0.02)
+        return block
+
+    ds = ray_tpu.data.range(STEPS * 2 * 4, num_blocks=16).map_batches(slow)
+    trainer = JaxTrainer(
+        make_loop(kill_marker),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=os.path.join(tmp, name),
+            name=name,
+            failure_config=FailureConfig(max_failures=2, retry_backoff_s=0.2),
+        ),
+        datasets={"train": ds},
+    )
+    t0 = time.perf_counter()
+    res = trainer.fit()
+    wall = time.perf_counter() - t0
+    assert res.error is None, f"{name} failed: {res.error}"
+    return res, wall
+
+
+def fail(msg):
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main():
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    tmp = tempfile.mkdtemp(prefix="train_obs_demo_")
+
+    # warm the worker pool / jit of the data path so the calm-vs-churned
+    # wall comparison below isn't dominated by first-run startup costs
+    run("obs_demo_warm", tmp)
+
+    # -- 1. calm run: stage coverage -----------------------------------
+    _res, calm_wall = run("obs_demo_calm", tmp)
+    d = state.train_run("obs_demo_calm")
+    if d is None or d["steps_seen"] < STEPS:
+        fail(f"step records missing: {d and d['steps_seen']}")
+    worst = 0.0
+    records = 0
+    for srec in d["steps"]:
+        for rec in srec["ranks"].values():
+            total = sum(rec["stages"].get(k, 0.0) for k in STAGES)
+            err = abs(total - rec["wall_ms"]) / max(rec["wall_ms"], 1e-9)
+            worst = max(worst, err)
+            records += 1
+    print(
+        f"coverage: {records} records, worst |stage_sum - wall|/wall "
+        f"= {worst:.3f}"
+    )
+    if worst > 0.10:
+        fail(f"stage coverage violation: {worst:.3f} > 0.10")
+    if not d["ops"]:
+        fail("no per-operator ingest stall attribution recorded")
+    print("\n" + ray_tpu.train_timeline("obs_demo_calm").summary(max_steps=8))
+
+    # -- 2. churned run: downtime ledger attribution -------------------
+    marker = os.path.join(tmp, "killed_once")
+    res, churn_wall = run("obs_demo_churn", tmp, kill_marker=marker)
+    ledger = res.goodput["downtime_ledger"]
+    attributed = sum(e["seconds"] for e in ledger)
+    delta = churn_wall - calm_wall
+    print(
+        f"\ncalm wall {calm_wall:.2f}s  churned wall {churn_wall:.2f}s  "
+        f"delta {delta:.2f}s  ledger {attributed:.2f}s"
+    )
+    print(ray_tpu.train_timeline("obs_demo_churn").summary(max_steps=4))
+    if not ledger:
+        fail("seeded kill produced no downtime ledger entries")
+    if not {e["cause"] for e in ledger} & {"recovery", "gang_restart", "preemption"}:
+        fail(f"ledger has no kill-attributed cause: {ledger}")
+    # the goodput gap must be attributed: ledger sum within 10% of the
+    # calm-vs-churned wall delta, with a small absolute slack (shared
+    # hosts jitter the calm baseline itself)
+    slack = max(0.10 * delta, 0.75)
+    if delta > 0 and abs(attributed - delta) > slack:
+        fail(
+            f"downtime ledger {attributed:.2f}s does not attribute the "
+            f"goodput gap {delta:.2f}s (tolerance {slack:.2f}s)"
+        )
+    print("\nOK: stage coverage + downtime attribution hold")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -278,6 +278,23 @@ def recent_traces(limit: int = 100) -> List[dict]:
     return _sched_rpc("list_traces", int(limit))
 
 
+def train_timeline(run: str, max_steps: Optional[int] = None):
+    """One training run's step-time attribution — "where did the step go".
+
+    Returns a :class:`ray_tpu._private.stepplane.TrainTimeline`: per-rank
+    step records decomposed into data_wait -> host_to_device -> compile ->
+    compute -> collective_wait (with the straggler rank) ->
+    checkpoint_stall -> other, run-level stage shares, per-operator ingest
+    stalls, recompile flags, and the goodput downtime ledger attributed by
+    cause. Print ``.summary()`` for the per-rank step waterfall or inspect
+    ``.to_dict()``. ``run`` is the RunConfig name (see
+    ``state.list_train_runs()``)."""
+    from ray_tpu._private.stepplane import TrainTimeline
+
+    data = _traced_rpc("train_run", str(run), max_steps)
+    return TrainTimeline(data or {})
+
+
 def request_profile(hz: float = 99.0, duration_s: float = 10.0) -> int:
     """Boost the continuous sampling profiler cluster-wide for a bounded
     window (on top of the steady-state ``profiler_hz``). Returns the number

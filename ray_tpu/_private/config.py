@@ -162,6 +162,34 @@ class Config:
     leak_watchdog_window: int = 8
     leak_watchdog_min_growth_bytes: int = 1024 * 1024
     leak_watchdog_min_count_growth: int = 8
+    # --- training step plane (per-step/per-rank stage attribution +
+    # goodput downtime ledger; see DESIGN_MAP "Training observability") ---
+    # decompose every train.report boundary into data_wait / host_to_device
+    # / compile / compute / collective_wait / checkpoint_stall / other per
+    # rank, index records per run scheduler-side, and attribute goodput
+    # loss to downtime causes. Requires telemetry_enabled; bench-tracked
+    # overhead ratio <= 1.05 (bench_train_obs.py)
+    train_obs_enabled: bool = True
+    # steps kept per run in the scheduler's StepIndex (older steps are
+    # evicted into run-level stage aggregates, never silently lost)
+    train_step_index_max: int = 512
+    # distinct runs kept in the StepIndex (oldest evicted)
+    train_runs_max: int = 32
+    # steps of jit warmup before a compile event counts as a RECOMPILE
+    # (flagged with the changed batch shape signature)
+    train_recompile_warmup_steps: int = 2
+    # steps whose wall is below this floor coalesce into one merged record
+    # per flush interval (stage sums and counts preserved exactly) instead
+    # of one row each: a sub-ms report loop would otherwise pay record
+    # construction per step AND flood the bounded per-run step window with
+    # sub-ms rows (512 rows = 0.25s of history). Steps with a checkpoint,
+    # a recompile flag, or operator-attributed stalls always get their own
+    # row. 0 disables coalescing.
+    train_obs_min_step_ms: float = 2.0
+    # cadence of the executor's live goodput + downtime-ledger publication
+    # (ray_tpu_train_goodput and the train_run_meta push); previously the
+    # gauge only appeared at fit() teardown
+    train_goodput_publish_interval_s: float = 5.0
     # --- failure forensics (cluster event log, watchdogs) ---
     # bound on the scheduler's structured cluster-event log (WORKER_DIED,
     # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest

@@ -303,7 +303,13 @@ def maybe_install_jax_hooks() -> None:
 def install_jax_hooks() -> bool:
     """Record ``jax:<event>`` profile spans for jax's monitored durations
     (compile/backend/execute events) when jax's monitoring listener API is
-    importable. Safe no-op otherwise; idempotent."""
+    importable. Safe no-op otherwise; idempotent.
+
+    Each span is attributed to the (task, trace) the TRIGGERING thread is
+    executing — the sampler's per-thread registry plus the thread's active
+    trace context — so compile time lands inside the request's span tree
+    (``ray_tpu.trace``) instead of as a global orphan, and feeds the active
+    training step's ``compile`` stage (``stepplane.note_compile``)."""
     global _jax_hooked
     if _jax_hooked:
         return True
@@ -316,18 +322,49 @@ def install_jax_hooks() -> bool:
 
         def _listener(event: str, duration_s: float, **kwargs) -> None:
             try:
-                from ray_tpu._private import profiling as _prof
-
                 end = time.time()
+                task_id, trace_id = _thread_tasks.get(
+                    threading.get_ident(), (None, None)
+                )
+                extra: Dict[str, str] = {}
+                try:
+                    from ray_tpu.util import tracing as _tracing
+
+                    ctx = _tracing.get_current_context()
+                    if ctx is not None:
+                        # a child span of the executing task's span: the
+                        # compile appears as its own node in the trace tree
+                        extra = {
+                            "trace_id": ctx.trace_id,
+                            "span_id": _tracing._new_id(8),
+                            "parent_id": ctx.span_id,
+                        }
+                    elif trace_id:
+                        # registry knows the trace but no live context on
+                        # this thread (e.g. a pool thread between scopes)
+                        extra = {
+                            "trace_id": trace_id,
+                            "span_id": _tracing._new_id(8),
+                        }
+                except Exception:
+                    pass
                 span = {
                     "event": f"jax:{event.strip('/').replace('/', '.')}",
                     "start": end - duration_s,
                     "end": end,
                     "duration_ms": duration_s * 1e3,
                     "pid": os.getpid(),
-                    "extra": {},
+                    "task_id": task_id,
+                    "extra": extra,
                 }
-                _prof._emit(span)
+                from ray_tpu._private import telemetry as _telemetry
+
+                _telemetry.record_span(span)
+                # training step plane: attribute compile time to the step
+                # that triggered it (and arm the recompile detector)
+                from ray_tpu._private import stepplane as _stepplane
+
+                _stepplane.note_compile(event, duration_s)
             except Exception:
                 pass
 

@@ -593,6 +593,11 @@ class Scheduler:
         from ray_tpu._private.telemetry import LatencyWindow as _LatencyWindow
 
         self._job_latency: Dict[str, _LatencyWindow] = {}
+        # ---- training step plane (per-run step records + downtime
+        # ledger; see DESIGN_MAP "Training observability") ----
+        from ray_tpu._private.stepplane import StepIndex as _StepIndex
+
+        self._train_index = _StepIndex(config)
         # ---- failure-forensics plane ----
         # structured cluster events (WORKER_DIED, NODE_DEAD, TASK_RETRY,
         # TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, STRAGGLER, ...);
@@ -4802,6 +4807,35 @@ class Scheduler:
             limit = args[0] if args and isinstance(args[0], int) else 100
             rows = list(self._trace_index.values())[-limit:]
             return [dict(r) for r in reversed(rows)]  # newest first
+        if op == "list_train_runs":
+            # training step plane: one digest row per run in the bounded
+            # StepIndex (steps seen, recompiles, goodput, attributed
+            # downtime, data-wait ratio, max rank skew)
+            return self._train_index.list_runs()
+        if op == "train_run":
+            # one run's full step-time attribution: per-step per-rank stage
+            # records (+ head-computed collective_wait and straggler rank),
+            # run-level stage totals, and the executor-pushed downtime
+            # ledger / goodput metadata
+            run = args[0] if args else None
+            max_steps = args[1] if len(args) > 1 else None
+            return self._train_index.get_run(run, max_steps=max_steps)
+        if op == "train_steps_batch":
+            # executor-pushed step records (drained off the report rpcs
+            # they rode, batched on the publish cadence)
+            for srec in args[0] if args else ():
+                try:
+                    self._train_index.ingest(srec)
+                except Exception:
+                    logger.exception("train step record ingest failed")
+            return True
+        if op == "train_run_meta":
+            # executor-pushed run metadata (periodic goodput + downtime
+            # ledger publication and the final run status)
+            run = args[0] if args else None
+            meta = args[1] if len(args) > 1 else None
+            self._train_index.note_meta(run, meta or {})
+            return True
         if op == "profile_samples":
             # aggregated continuous-profiler stacks, optionally filtered to
             # one task or one trace: [(task_id, trace_id, stack, count)]
@@ -5678,6 +5712,11 @@ class Scheduler:
                 self._ingest_object_record(orec)
             except Exception:
                 logger.exception("object provenance record ingest failed")
+        for srec in batch.get("train_steps") or ():
+            try:
+                self._train_index.ingest(srec)
+            except Exception:
+                logger.exception("train step record ingest failed")
         for name, (kind, description, data) in (batch.get("metrics") or {}).items():
             try:
                 self._merge_metric(name, kind, description, data, proc)

@@ -85,6 +85,10 @@ class TelemetryBuffer:
         # put / task return / stream item — see _private/memplane.py);
         # merged into the scheduler's bounded provenance index on flush
         self._objects: collections.deque = collections.deque()
+        # per-(run, rank, step) training step records (step plane: one per
+        # train.report boundary — see _private/stepplane.py); merged into
+        # the scheduler's bounded per-run StepIndex on flush
+        self._train_steps: collections.deque = collections.deque()
         # name -> (kind, description, data snapshot): last writer wins, so
         # N records within one interval flush as ONE write per metric
         self._metrics: Dict[str, Tuple[str, str, dict]] = {}
@@ -154,6 +158,16 @@ class TelemetryBuffer:
                 return
             self._objects.append(rec)
 
+    def record_train_step(self, rec) -> None:
+        """One per-rank training step record (step plane; compact
+        positional tuple — see ``stepplane.decode_record``)."""
+        with self._lock:
+            if len(self._train_steps) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._train_steps.append(rec)
+
     def record_metric(self, name: str, kind: str, description: str, data: dict) -> None:
         with self._lock:
             self._metrics[name] = (kind, description, data)
@@ -191,6 +205,7 @@ class TelemetryBuffer:
                 or self._logs
                 or self._cluster_events
                 or self._objects
+                or self._train_steps
                 or self._metrics
                 or self._samples
                 or self._dropped_pending
@@ -204,6 +219,10 @@ class TelemetryBuffer:
                 collections.deque(),
             )
             objects, self._objects = list(self._objects), collections.deque()
+            train_steps, self._train_steps = (
+                list(self._train_steps),
+                collections.deque(),
+            )
             metrics, self._metrics = dict(self._metrics), {}
             samples, self._samples = (
                 [(k, v) for k, v in self._samples.items()],
@@ -217,6 +236,7 @@ class TelemetryBuffer:
             "logs": logs,
             "cluster_events": cluster_events,
             "objects": objects,
+            "train_steps": train_steps,
             "metrics": metrics,
             "samples": samples,
             "dropped": dropped,
@@ -239,6 +259,7 @@ class TelemetryBuffer:
             + len(batch["logs"])
             + len(batch["cluster_events"])
             + len(batch.get("objects") or ())
+            + len(batch.get("train_steps") or ())
             # per-SAMPLE, not per-stack-key (matches record_samples and the
             # scheduler-side accounting)
             + sum(n for _k, n in batch.get("samples") or ())
@@ -358,6 +379,17 @@ def record_object_event(rec) -> None:
     if not enabled():
         return
     _buffer.record_object_event(rec)
+    _buffer.ensure_flusher()
+
+
+def record_train_step(rec) -> None:
+    """One per-rank training step record (step plane; compact tuple);
+    batched. The hot caller (``stepplane.StepTimer.finalize_step``) gates
+    on ``stepplane.enabled`` and appends to the buffer directly; this
+    wrapper is for cold paths."""
+    if not enabled():
+        return
+    _buffer.record_train_step(rec)
     _buffer.ensure_flusher()
 
 

@@ -143,6 +143,26 @@ def start_dashboard(port: int = 8765) -> int:
                         body = build_trace(events, tid).to_dict()
                     else:
                         body = {}
+                elif urlparse(self.path).path == "/api/train":
+                    # training step plane: run digests, or one run's
+                    # per-rank step records + downtime ledger (?run=).
+                    # Local flush only — 2s UI polling (the /api/trace
+                    # rule); worker step records lag at most one telemetry
+                    # batch interval
+                    from ray_tpu._private import telemetry as _tele
+                    from ray_tpu._private.worker import get_driver
+
+                    _tele.flush()
+                    q = parse_qs(urlparse(self.path).query)
+                    run = q.get("run", [""])[0]
+                    if run:
+                        body = get_driver().rpc(
+                            "train_run",
+                            run,
+                            int(q.get("max_steps", ["50"])[0]),
+                        ) or {}
+                    else:
+                        body = get_driver().rpc("list_train_runs")
                 elif self.path == "/api/job_latency":
                     # per-job sliding-window p50/p95/p99 + exemplar traces
                     from ray_tpu._private.worker import get_driver

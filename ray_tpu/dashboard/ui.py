@@ -56,8 +56,8 @@ PAGE = """<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
-              "memory","placement_groups","serve","jobs","logs","events",
-              "event_stats","traces","latency","stacks","profile"];
+              "memory","placement_groups","serve","jobs","train","logs",
+              "events","event_stats","traces","latency","stacks","profile"];
 // hash may carry a selection suffix, e.g. "#traces:<trace_id>"
 let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
 window.addEventListener("hashchange", () => {
@@ -175,6 +175,74 @@ const RENDER = {
       table(jobs, ["name","status","q#","prio","weight","running","ready",
                    "usage","quota","obj MB","preempt","oom"]) +
       `<h2>submissions (${subs.length})</h2>` + table(subs);
+  },
+  async train() {
+    // training step plane: run digests; ?run drills into the per-rank
+    // step waterfall (stage-colored bars) + downtime ledger
+    const STAGES = ["data_wait_ms","host_to_device_ms","compile_ms",
+                    "compute_ms","collective_wait_ms","checkpoint_stall_ms",
+                    "other_ms"];
+    const COLORS = {data_wait_ms:"#e3a04f", host_to_device_ms:"#b06fd8",
+                    compile_ms:"#e3504f", compute_ms:"#38c172",
+                    collective_wait_ms:"#4fa3ff",
+                    checkpoint_stall_ms:"#d8c94f", other_ms:"#6b7a8c"};
+    const sel = location.hash.split(":")[1];
+    if (sel) {
+      const d = await j("/api/train?run=" + sel);
+      if (!d.run) return `<p>no step records for run ${esc(sel)}</p>`;
+      const meta = d.meta || {}, gp = meta.goodput || {};
+      const ledger = meta.downtime_ledger || [];
+      const legend = STAGES.map(s =>
+        `<span style="color:${COLORS[s]}">■ ${s.replace("_ms","")}</span>`
+      ).join(" ");
+      const bar = (st, wall) => {
+        if (!wall) return "";
+        return `<span class="barbg" style="width:240px">` + STAGES.map(k => {
+          const w = Math.round(240 * (st[k]||0) / wall);
+          return w ? `<span class="bar" style="width:${w}px;background:${COLORS[k]}"></span>` : "";
+        }).join("") + `</span>`;
+      };
+      const rows = [];
+      (d.steps || []).slice(-50).forEach(s => {
+        const skew = (d.skew || {})[s.step] || {};
+        Object.keys(s.ranks || {}).sort().forEach(r => {
+          const rec = s.ranks[r], st = rec.stages || {};
+          rows.push(`<tr><td>${s.step}</td><td>${r}` +
+            `${skew.straggler_rank == r && skew.skew_ms > 0 ? " ⚠" : ""}</td>` +
+            `<td>${bar(st, rec.wall_ms)}</td>` +
+            `<td>${(rec.wall_ms||0).toFixed(1)}ms</td>` +
+            `<td>${rec.recompiled ? "<span class='bad'>RECOMPILED</span>" : ""}` +
+            `${rec.trace_id ? ` <a href="#traces:${rec.trace_id}">trace</a>` : ""}</td></tr>`);
+        });
+      });
+      return `<h2>run ${esc(d.run)} — world ${d.world}, ` +
+        `${d.steps_seen} steps, ${d.recompiles} recompiles` +
+        `${gp.goodput != null ? `, goodput ${gp.goodput.toFixed(3)}` : ""}</h2>` +
+        `<p>${legend}</p>` +
+        (ledger.length ? "<h2>downtime ledger</h2>" +
+          table(ledger.map(e => ({cause: e.cause,
+            seconds: (e.seconds||0).toFixed(2), detail: e.detail||""}))) : "") +
+        "<h2>step waterfall (per rank)</h2>" +
+        "<table><tr><th>step</th><th>rank</th><th>stages</th><th>wall</th>" +
+        "<th></th></tr>" + rows.join("") + "</table>";
+    }
+    const rows = await j("/api/train");
+    if (!rows.length) return "<p>no training runs recorded</p>";
+    const cols = ["run","world","steps","recompiles","goodput","downtime s",
+                  "data wait","skew ms","status"];
+    return "<h2>training runs (click to inspect)</h2>" +
+      "<table><tr>" + cols.map(c=>`<th>${c}</th>`).join("") + "</tr>" +
+      rows.map(r =>
+        `<tr><td><a href="#train:${encodeURIComponent(r.run)}" ` +
+        `onclick="setTimeout(refresh,0)">${esc(r.run)}</a></td>` +
+        `<td>${r.world}</td><td>${r.steps}</td><td>${r.recompiles}</td>` +
+        `<td>${r.goodput == null ? "" : r.goodput.toFixed(3)}</td>` +
+        `<td>${(r.downtime_s||0).toFixed(1)}</td>` +
+        `<td>${r.data_wait_ratio == null ? "" :
+               (100*r.data_wait_ratio).toFixed(1) + "%"}</td>` +
+        `<td>${(r.max_skew_ms||0).toFixed(1)}</td>` +
+        `<td class="${/finished/.test(r.status)?'ok':/failed/.test(r.status)?'bad':''}">${esc(r.status)}</td></tr>`
+      ).join("") + "</table>";
   },
   async logs() { return table(await j("/api/logs")); },
   async events() {

@@ -398,6 +398,101 @@ def cmd_trace(args):
         print(f"wrote {fmt} flame graph ({n} profiles/lines) to {args.flame}")
 
 
+def cmd_train(args):
+    """Training step plane: per-run step-time attribution ("where did the
+    step go") — run digests, per-rank step waterfalls with stage
+    decomposition + straggler marks, and ingest-stall / downtime views."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    _init(args)
+    sub = args.train_cmd
+    if sub == "runs":
+        rows = state.list_train_runs()
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        if not rows:
+            print("no training runs recorded (is train_obs_enabled on?)")
+            return
+        print(
+            f"{'run':28} {'world':>5} {'steps':>6} {'recomp':>6} "
+            f"{'goodput':>8} {'downtime':>9} {'data_wait':>9} "
+            f"{'skew_ms':>8}  status"
+        )
+        for r in rows:
+            gp = r.get("goodput")
+            dw = r.get("data_wait_ratio")
+            gp_s = "?" if gp is None else f"{gp:.3f}"
+            dw_s = "?" if dw is None else f"{dw:.1%}"
+            print(
+                f"{str(r.get('run'))[:28]:28} {r.get('world', 0):>5} "
+                f"{r.get('steps', 0):>6} {r.get('recompiles', 0):>6} "
+                f"{gp_s:>8} "
+                f"{r.get('downtime_s') or 0:>8.1f}s "
+                f"{dw_s:>9} "
+                f"{r.get('max_skew_ms') or 0:>8.1f}  {r.get('status', '?')}"
+            )
+        return
+    if not args.run:
+        raise SystemExit(f"`ray_tpu train {sub}` needs --run <name>")
+    t = ray_tpu.train_timeline(args.run, max_steps=args.limit)
+    if not t.to_dict():
+        print(f"no step records for run {args.run!r}")
+        return
+    if sub == "steps":
+        d = t.to_dict()
+        if args.rank is not None:
+            # keep only the requested rank's records in every step row
+            for srec in d.get("steps") or []:
+                srec["ranks"] = {
+                    r: rec
+                    for r, rec in (srec.get("ranks") or {}).items()
+                    if int(r) == args.rank
+                }
+            d["steps"] = [s for s in d["steps"] if s["ranks"]]
+            t = type(t)(d)
+        if args.json:
+            print(json.dumps(t.to_dict(), indent=2, default=str))
+        else:
+            print(t.summary(max_steps=args.limit or 20))
+        return
+    if sub == "stalls":
+        d = t.to_dict()
+        body = {
+            "run": d.get("run"),
+            "ingest_stalls_by_operator_ms": d.get("ops") or {},
+            "stage_shares": t.stage_shares(),
+            "downtime_ledger": (d.get("meta") or {}).get("downtime_ledger")
+            or [],
+            "skew": d.get("skew") or {},
+        }
+        if args.json:
+            print(json.dumps(body, indent=2, default=str))
+            return
+        print(f"run {body['run']} — where did the step go")
+        shares = body["stage_shares"]
+        if shares:
+            for k, v in sorted(shares.items(), key=lambda kv: -kv[1]):
+                print(f"  {k:<18} {v * 100:6.1f}%")
+        ops = body["ingest_stalls_by_operator_ms"]
+        if ops:
+            print("ingest stalls by operator:")
+            for op, ms in sorted(ops.items(), key=lambda kv: -kv[1]):
+                print(f"  {op:<24} {ms:10.1f}ms")
+        ledger = body["downtime_ledger"]
+        if ledger:
+            total = sum(e.get("seconds", 0.0) for e in ledger)
+            print(f"downtime ledger ({total:.2f}s attributed):")
+            for e in ledger:
+                print(
+                    f"  {e.get('cause', '?'):<18} {e.get('seconds', 0):8.2f}s"
+                    f"  {e.get('detail', '')}"
+                )
+        return
+    raise SystemExit(f"unknown train subcommand {sub!r}")
+
+
 def cmd_profile(args):
     """Continuous-profiling plane: record (boost the samplers) and export
     collapsed-stack / speedscope flame graphs with per-task attribution."""
@@ -677,6 +772,25 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=200)
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "train",
+        help="training step-time & goodput attribution (step plane): "
+        "runs | steps | stalls",
+    )
+    p.add_argument(
+        "train_cmd",
+        choices=["runs", "steps", "stalls"],
+        help="runs = digest per run; steps = per-rank step waterfall; "
+        "stalls = ingest stalls by operator + downtime ledger",
+    )
+    p.add_argument("--run", help="run name (RunConfig.name)")
+    p.add_argument(
+        "--rank", type=int, help="restrict the steps view to one rank"
+    )
+    p.add_argument("--limit", type=int, default=20, help="steps shown")
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser(
         "trace",

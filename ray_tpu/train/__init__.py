@@ -22,6 +22,7 @@ from ray_tpu.train._result import Result
 from ray_tpu.train._session import (
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     load_elastic,
     report,
     report_elastic,
@@ -52,6 +53,7 @@ __all__ = [
     "elastic",
     "get_context",
     "get_checkpoint",
+    "get_dataset_shard",
 ]
 
 from ray_tpu._private import usage as _usage

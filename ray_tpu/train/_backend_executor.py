@@ -84,8 +84,18 @@ def _get_metrics() -> Dict[str, Any]:
                     "ray_tpu_train_goodput",
                     "useful-step-time / wall-time of the training run "
                     "(1.0 = no time lost to churn, redone steps, or "
-                    "recovery)",
+                    "recovery); published live on "
+                    "train_goodput_publish_interval_s, not just at fit() "
+                    "teardown",
                     tag_keys=("run",),
+                ),
+                "downtime": Counter(
+                    "ray_tpu_train_downtime_seconds",
+                    "training wall time lost to attributed downtime "
+                    "windows (cause=recovery|gang_restart|preemption|"
+                    "checkpoint_drain|admission_wait) — the goodput gap's "
+                    "ledger",
+                    tag_keys=("run", "cause"),
                 ),
             }
     return _metrics
@@ -100,12 +110,16 @@ class _ReportCollector:
     collective against a dead peer."""
 
     def __init__(self):
-        self.reports: List[Tuple[int, int, dict, Optional[str]]] = []
+        self.reports: List[Tuple[int, int, dict, Optional[str], Any]] = []
         self._offset = 0  # entries already drained and dropped
         self._abort_gen: Optional[int] = None
 
-    def report(self, rank, iteration, metrics, ckpt_path):
-        self.reports.append((rank, iteration, metrics, ckpt_path))
+    def report(self, rank, iteration, metrics, ckpt_path, step_rec=None):
+        # step_rec is the rank's PREVIOUS step-plane record riding this
+        # report (compact tuple; see _private/stepplane.py) — drained to
+        # the executor, which batch-pushes records into the scheduler's
+        # StepIndex on the publish cadence
+        self.reports.append((rank, iteration, metrics, ckpt_path, step_rec))
         return True if self._abort_gen is None else self._abort_gen
 
     def drain(self, start: int):
@@ -159,6 +173,7 @@ class _TrainWorker:
         latest_ckpt,
         rank: Optional[int] = None,
         world_size: Optional[int] = None,
+        run_name: str = "train",
     ):
         if rank is not None:
             self.context.world_rank = rank
@@ -166,7 +181,19 @@ class _TrainWorker:
         if world_size is not None:
             self.context.world_size = world_size
         fn = cloudpickle.loads(fn_blob)
-        session = _Session(self.context, collector, latest_ckpt)
+        datasets = None
+        if isinstance(config, dict) and "__datasets__" in config:
+            # internal plumbing, not a hyperparameter: the user fn gets a
+            # config it can json.dumps/log without tripping over Datasets
+            config = dict(config)
+            datasets = config.pop("__datasets__")
+        session = _Session(
+            self.context,
+            collector,
+            latest_ckpt,
+            run_name=run_name,
+            datasets=datasets,
+        )
         _set_session(session)
         try:
             if config is not None:
@@ -223,6 +250,22 @@ class BackendExecutor:
             "steps_useful": 0,
             "steps_redone": 0,
         }
+        # downtime ledger: goodput's gap attributed by cause. Each entry is
+        # {cause, start (wall clock), end, seconds, detail}; _open_dt is the
+        # window currently accruing (closed by the next dispatch). Windows
+        # open at the LAST PROGRESS timestamp, not at detection: the work
+        # since the last report is discarded by the abort/restart, so it is
+        # part of the loss this ledger must sum to.
+        self._downtime: List[Dict[str, Any]] = []
+        self._open_dt: Optional[Dict[str, Any]] = None
+        self._last_progress: Optional[float] = None  # wall clock
+        self._preempt_seen_at: float = 0.0
+        self._last_publish: float = 0.0
+        self._run_name: str = "train"
+        self._admission_noted = False  # start() runs once per gang attempt
+        # step-plane records drained off reports, batch-pushed into the
+        # scheduler's StepIndex on the publish cadence
+        self._step_recs: List[Any] = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -254,6 +297,39 @@ class BackendExecutor:
         self._bundles: List[Optional[int]] = list(range(self.scaling.num_workers))
         # ignore cluster events from before this group existed
         self._last_event_id = self._event_horizon()
+        self._note_admission_wait()
+
+    def _note_admission_wait(self) -> None:
+        """If this driver's job sat in the admission queue (multi-tenant
+        plane: JOB_QUEUED -> JOB_ADMITTED), that wait is training downtime
+        too — attribute it in the ledger instead of letting it read as a
+        slow first step."""
+        if self._admission_noted:
+            return
+        self._admission_noted = True
+        try:
+            from ray_tpu._private.worker import get_runtime
+
+            job_hex = getattr(get_runtime(), "job_id", None)
+            job_hex = job_hex.hex() if job_hex is not None else None
+            if not job_hex:
+                return
+            queued = admitted = None
+            for ev in self._list_events(limit=512):
+                if ev.get("job_id") != job_hex:
+                    continue
+                if ev.get("type") == "JOB_QUEUED":
+                    queued = ev.get("time")
+                elif ev.get("type") == "JOB_ADMITTED" and queued is not None:
+                    admitted = ev.get("time")
+            if queued is not None and admitted is not None and admitted > queued:
+                self.add_downtime(
+                    "admission_wait",
+                    admitted - queued,
+                    detail=f"job {job_hex} queued for admission",
+                )
+        except Exception:
+            pass
 
     def _spawn(self, rank: int, world: int, bundle_index: Optional[int] = None):
         res = self.scaling.worker_resources()
@@ -288,10 +364,29 @@ class BackendExecutor:
     def _drain_reports(self, report_callback: Optional[Callable]) -> None:
         new = ray_tpu.get(self.collector.drain.remote(self._seen), timeout=60)
         self._seen += len(new)
+        if new:
+            self._last_progress = time.time()
+            if self._open_dt is not None and self._open_dt.pop(
+                "until_report", False
+            ):
+                # recovery's downtime window ends at the first report the
+                # RESUMED generation produces (re-dispatch alone is not
+                # recovery — session re-setup and the survivors' discarded
+                # partial steps are part of the loss), minus one nominal
+                # step: the step that produced this report was useful work
+                gp = self._gp
+                avg = (
+                    gp["useful_s"] / gp["steps_useful"]
+                    if gp["steps_useful"]
+                    else 0.0
+                )
+                self._close_downtime(discount_s=avg)
         for r in new:
             self._note_goodput(r)
+            if len(r) > 4 and r[4] is not None:
+                self._step_recs.append(r[4])
             if report_callback:
-                report_callback(*r)
+                report_callback(*r[:4])
 
     def _note_goodput(self, report) -> None:
         rank, iteration = report[0], report[1]
@@ -309,18 +404,156 @@ class BackendExecutor:
         gp["max_step"] = max(gp["max_step"], iteration)
         gp["last_ts"] = now
 
-    def goodput_stats(self) -> Dict[str, float]:
+    def goodput_stats(self) -> Dict[str, Any]:
         gp = self._gp
         wall = (
             time.monotonic() - gp["wall_start"] if gp["wall_start"] else 0.0
         )
+        by_cause: Dict[str, float] = {}
+        for e in self._downtime:
+            by_cause[e["cause"]] = by_cause.get(e["cause"], 0.0) + e["seconds"]
         return {
             "wall_s": wall,
             "useful_step_s": gp["useful_s"],
             "steps_useful": gp["steps_useful"],
             "steps_redone": gp["steps_redone"],
             "goodput": (gp["useful_s"] / wall) if wall > 0 else 0.0,
+            "downtime_s": round(sum(by_cause.values()), 3),
+            "downtime_by_cause": {k: round(v, 3) for k, v in by_cause.items()},
         }
+
+    # -- downtime ledger ----------------------------------------------------
+
+    def downtime_ledger(self) -> List[Dict[str, Any]]:
+        """Closed downtime windows so far, in order. The open window (if
+        any) is included with its running duration — a live dashboard must
+        see the outage it is currently in."""
+        out = [dict(e) for e in self._downtime]
+        if self._open_dt is not None:
+            cur = dict(self._open_dt)
+            cur["seconds"] = round(max(0.0, time.time() - cur["start"]), 3)
+            cur["open"] = True
+            out.append(cur)
+        return out
+
+    def open_downtime(self, cause: str, detail: str = "", start: Optional[float] = None) -> None:
+        """Begin a downtime window; the next dispatch() closes it. Starts
+        at the last progress timestamp unless given explicitly — work done
+        since the last report is unwound by the recovery, so it counts."""
+        if self._open_dt is not None:
+            return  # already in an outage; first cause wins
+        t0 = start if start is not None else (self._last_progress or time.time())
+        self._open_dt = {"cause": cause, "start": t0, "detail": detail}
+
+    def _close_downtime(self, discount_s: float = 0.0) -> None:
+        dt = self._open_dt
+        if dt is None:
+            return
+        self._open_dt = None
+        dt.pop("until_report", None)
+        dt["end"] = time.time()
+        dt["seconds"] = round(
+            max(0.0, dt["end"] - dt["start"] - max(0.0, discount_s)), 3
+        )
+        self._downtime.append(dt)
+        try:
+            _get_metrics()["downtime"].inc(
+                dt["seconds"], tags={"run": self._run_name, "cause": dt["cause"]}
+            )
+        except Exception:
+            pass
+
+    def add_downtime(self, cause: str, seconds: float, detail: str = "") -> None:
+        """Record an already-measured downtime window (checkpoint drains,
+        admission waits — stalls with explicit bounds)."""
+        if seconds <= 0:
+            return
+        end = time.time()
+        self._downtime.append(
+            {
+                "cause": cause,
+                "start": end - seconds,
+                "end": end,
+                "seconds": round(seconds, 3),
+                "detail": detail,
+            }
+        )
+        try:
+            _get_metrics()["downtime"].inc(
+                round(seconds, 3), tags={"run": self._run_name, "cause": cause}
+            )
+        except Exception:
+            pass
+
+    def _dead_cause(self) -> str:
+        """Classify the recovery we are about to pay for: a PREEMPTED
+        cluster event naming this group within the last poll window means
+        the arbitration plane took the worker, not a crash."""
+        if time.monotonic() - self._preempt_seen_at < 10.0:
+            return "preemption"
+        return "recovery"
+
+    def _sched_rpc(self, op: str, args: tuple):
+        from ray_tpu._private.worker import get_runtime
+
+        rt = get_runtime()
+        if hasattr(rt, "scheduler_rpc"):
+            return rt.scheduler_rpc(op, args)
+        return rt.rpc(op, *args)
+
+    def _push_step_records(self) -> None:
+        """Batch-push drained step records into the scheduler's StepIndex
+        (on the publish cadence — per-record pushes would tax the step
+        hot path the records were moved OFF of)."""
+        if not self._step_recs:
+            return
+        recs, self._step_recs = self._step_recs, []
+        try:
+            self._sched_rpc("train_steps_batch", (recs,))
+        except Exception:
+            self._step_recs = recs + self._step_recs  # retry next push
+
+    def _push_run_meta(self, run_name: str, status: str = "running") -> None:
+        """Publish this run's goodput + downtime ledger into the
+        scheduler's StepIndex (state.train_run / dashboard read side)."""
+        self._push_step_records()
+        try:
+            self._sched_rpc(
+                "train_run_meta",
+                (
+                    run_name,
+                    {
+                        "goodput": self.goodput_stats(),
+                        "downtime_ledger": self.downtime_ledger(),
+                        "world_size": self.scaling.num_workers,
+                        "live_world": len(self.workers),
+                        "status": status,
+                    },
+                ),
+            )
+        except Exception:
+            pass
+
+    def _publish_interval_s(self) -> float:
+        try:
+            from ray_tpu._private.worker import get_runtime
+
+            cfg = getattr(get_runtime(), "config", None)
+            return float(
+                getattr(cfg, "train_goodput_publish_interval_s", 5.0) or 5.0
+            )
+        except Exception:
+            return 5.0
+
+    def _maybe_publish(self, run_name: str) -> None:
+        """Live goodput on a periodic cadence: dashboards see the run
+        mid-flight, not only at fit() teardown."""
+        now = time.monotonic()
+        if now - self._last_publish < self._publish_interval_s():
+            return
+        self._last_publish = now
+        self._publish_goodput(run_name)
+        self._push_run_meta(run_name)
 
     def _publish_goodput(self, run_name: str) -> None:
         try:
@@ -371,6 +604,12 @@ class BackendExecutor:
         dead_nodes = set()
         for ev in fresh:
             etype = ev.get("type")
+            if etype == "PREEMPTED" and ev.get("actor_id") in by_actor:
+                # the arbitration plane is taking capacity back FROM THIS
+                # GANG: classify the next detected death as preemption,
+                # not a crash (another job's preemption must not relabel
+                # our crash recovery)
+                self._preempt_seen_at = time.monotonic()
             if etype == "WORKER_DIED" and ev.get("actor_id") in by_actor:
                 rank = by_actor[ev["actor_id"]]
                 dead[rank] = exc.ActorDiedError(
@@ -443,6 +682,7 @@ class BackendExecutor:
         hold ``min_workers`` ranks — the caller's whole-gang restart is
         the fallback."""
         fn_blob = cloudpickle.dumps(train_fn)
+        self._run_name = run_name
         if self._gp["wall_start"] is None:
             self._gp["wall_start"] = time.monotonic()
         self._gp["last_ts"] = None
@@ -475,9 +715,15 @@ class BackendExecutor:
             ranks = range(world) if only_ranks is None else sorted(only_ranks)
             for rank in ranks:
                 ref = self.workers[rank].run.remote(
-                    fn_blob, cfg, self.collector, ckpt, rank, world
+                    fn_blob, cfg, self.collector, ckpt, rank, world, run_name
                 )
                 ref_to_rank[ref] = rank
+            # downtime ledger: the open window (recovery, gang restart)
+            # now runs until the resumed generation's FIRST report lands —
+            # dispatch alone is not recovery (session re-setup and the
+            # survivors' discarded partial steps are still loss)
+            if self._open_dt is not None:
+                self._open_dt["until_report"] = True
 
         dispatch(latest_ckpt)
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -500,8 +746,17 @@ class BackendExecutor:
                         results[rank] = res
                 except _DEATH_ERRORS as e:
                     dead[rank] = e
+            self._maybe_publish(run_name)
             dead.update(self._poll_cluster_events(ref_to_rank))
             if dead:
+                # the goodput gap starts accruing now: everything from the
+                # last drained report to the recovery's re-dispatch is
+                # attributed downtime (the aborted ranks' partial work is
+                # discarded)
+                self.open_downtime(
+                    self._dead_cause(),
+                    detail=f"ranks {sorted(dead)} lost",
+                )
                 gen += 1
                 # progress-aware recovery budget: churn that advances the
                 # run recovers for free, a rank dying deterministically at
@@ -547,7 +802,9 @@ class BackendExecutor:
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("training run timed out")
         self._drain_reports(report_callback)
+        self._close_downtime()  # a window no report ever closed (rare)
         self._publish_goodput(run_name)
+        self._push_run_meta(run_name)
         return [results[rank] for rank in sorted(results)]
 
     # -- recovery -----------------------------------------------------------

@@ -159,9 +159,27 @@ def _preempt_shield():
 
 
 class _Session:
-    def __init__(self, context: TrainContext, collector, latest_checkpoint: Optional[Checkpoint]):
+    def __init__(
+        self,
+        context: TrainContext,
+        collector,
+        latest_checkpoint: Optional[Checkpoint],
+        run_name: str = "train",
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
         self.context = context
         self.collector = collector  # ActorHandle of _ReportCollector (or None)
+        self.run_name = run_name
+        # trainer-attached datasets (JaxTrainer(datasets=...)); consumed via
+        # train.get_dataset_shard — the instrumented ingest seam
+        self.datasets: Dict[str, Any] = dict(datasets or {})
+        # step plane: per-step stage decomposition between report boundaries
+        # (None when train_obs_enabled is off — zero hot-path cost)
+        from ray_tpu._private import stepplane
+
+        self._step_timer = stepplane.make_timer(
+            run_name, context.world_rank, context.world_size
+        )
         # resume continues the step numbering: a restarted attempt must not
         # re-emit checkpoint_000001 over an already-committed step 1 (the
         # overwrite would invalidate its manifest digests)
@@ -210,6 +228,11 @@ class _Session:
 
     def _report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
         self.iteration += 1
+        timer = self._step_timer
+        if timer is not None:
+            # the loop half of the step (data_wait/h2d/compile/compute)
+            # ends here; everything below is the report half
+            timer.mark_pre_report()
         ckpt_path = None
         if checkpoint is not None:
             # checkpoint plane save path: EVERY rank snapshots its shard
@@ -264,14 +287,27 @@ class _Session:
                         os.unlink(os.path.join(dest, mark))
                     except OSError:
                         pass
-            checkpointing.observe_save_seconds(time.monotonic() - t0)
+            elapsed = time.monotonic() - t0
+            checkpointing.observe_save_seconds(elapsed)
+            if timer is not None:
+                # the blocking (local-snapshot) portion only — the upload +
+                # commit ride the checkpoint plane's background queue
+                timer.note_checkpoint_stall(elapsed)
             ckpt_path = dest
         if self.collector is not None:
             import ray_tpu
 
+            # the PREVIOUS step's finalized record rides this report rpc
+            # (zero extra messages on the step hot path); the session's
+            # last record drains via telemetry when the timer deactivates
+            step_rec = timer.pop_pending_record() if timer is not None else None
             resp = ray_tpu.get(
                 self.collector.report.remote(
-                    self.context.world_rank, self.iteration, metrics, ckpt_path
+                    self.context.world_rank,
+                    self.iteration,
+                    metrics,
+                    ckpt_path,
+                    step_rec,
                 )
             )
             # the collector doubles as the executor's control plane: a
@@ -281,6 +317,15 @@ class _Session:
             # can re-form and resume from the last committed step
             if isinstance(resp, int) and not isinstance(resp, bool):
                 raise AttemptAborted(resp)
+        if timer is not None:
+            # close the step at the report boundary (an aborted attempt
+            # never reaches here — its partial step is discarded work and
+            # lands in the executor's downtime ledger instead)
+            from ray_tpu.util import tracing as _tracing
+
+            timer.finalize_step(
+                self.iteration, trace_id=_tracing.current_trace_id()
+            )
 
     # -- elastic state ------------------------------------------------------
 
@@ -341,6 +386,11 @@ def _set_session(session: Optional[_Session]):
     # side thread, where the thread-local is unset — a worker runs one
     # train session at a time, so the fallback is unambiguous there
     _session_fallback = session
+    # step plane: make this session's timer the process's active step so
+    # the data iterator and the jax monitoring listener publish into it
+    from ray_tpu._private import stepplane
+
+    stepplane.activate(session._step_timer if session is not None else None)
 
 
 def _get_session() -> Optional[_Session]:
@@ -367,6 +417,39 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Optional[Checkpoint]:
     s = _get_session()
     return s.latest_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """The :class:`~ray_tpu.data.iterator.DataIterator` over the dataset
+    the trainer attached under ``name`` (``JaxTrainer(datasets=...)``), or
+    None when the trainer attached none. Parity: ``ray.train
+    .get_dataset_shard``. Iteration through it is the instrumented ingest
+    seam: batch-fetch blocking lands in the step plane's ``data_wait``
+    stage (with per-operator stall attribution) and ``iter_jax_batches``'
+    device transfer in ``host_to_device``."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "train.get_dataset_shard() called outside a training session"
+        )
+    ds = s.datasets.get(name)
+    if ds is None:
+        return None
+    from ray_tpu.data.dataset import Dataset
+    from ray_tpu.data.iterator import DataIterator
+
+    world = s.context.world_size
+    if world > 1 and isinstance(ds, Dataset):
+        # per-rank shard: round-robin slice of the SOURCE refs/read tasks
+        # with the operator stages preserved — lazy (no materialize), and
+        # ranks see disjoint data (a rank count above the block count
+        # leaves trailing ranks empty; repartition first for balance)
+        ds = Dataset(
+            ds._block_refs[s.context.world_rank :: world],
+            stages=ds._stages,
+            owned_actors=ds._owned_actors,
+        )
+    return ds if isinstance(ds, DataIterator) else DataIterator(ds)
 
 
 def load_elastic(arrays=None, *, full: bool = False):

@@ -236,6 +236,13 @@ class JaxTrainer:
                 except Exception as e:  # noqa: BLE001
                     error = e
                     attempt += 1
+                    # downtime ledger: the whole teardown -> backoff ->
+                    # restart window is attributed (closed by the restarted
+                    # attempt's first dispatch)
+                    executor.open_downtime(
+                        "gang_restart",
+                        detail=f"attempt {attempt}: {type(e).__name__}",
+                    )
                     executor.shutdown()
                     try:
                         prepare_resume()
@@ -267,7 +274,17 @@ class JaxTrainer:
             # loudly) by the time the Result exists — and a drain that
             # TIMES OUT must never return looking fully committed
             drain_timeout = self.run_config.checkpoint_config.drain_timeout_s
-            if not manager.wait(timeout=drain_timeout):
+            drain_t0 = time.monotonic()
+            drained = manager.wait(timeout=drain_timeout)
+            drain_s = time.monotonic() - drain_t0
+            if drain_s > 0.05:
+                # blocking on uncommitted uploads at teardown is downtime
+                # the goodput ledger must attribute (PR-5 commit spans show
+                # the same window from the storage side)
+                executor.add_downtime(
+                    "checkpoint_drain", drain_s, detail="fit() teardown drain"
+                )
+            if not drained:
                 from ray_tpu.train._backend_executor import _record_event
 
                 undrained = manager.pending_steps()
@@ -291,10 +308,22 @@ class JaxTrainer:
             manager.shutdown(wait=False)
 
         best = manager.latest_checkpoint()
+        # a terminally-failed attempt can leave its gang_restart/recovery
+        # window open (the break skips the dispatch that would close it):
+        # close it now so downtime_s == sum(ledger) in the final stats
+        executor._close_downtime()
+        goodput = executor.goodput_stats()
+        goodput["downtime_ledger"] = executor.downtime_ledger()
+        # final publication: the run's terminal status + complete ledger
+        # land in the scheduler's StepIndex (state.train_run / dashboard)
+        executor._push_run_meta(
+            name, status="failed" if error is not None else "finished"
+        )
+        executor._publish_goodput(name)
         return Result(
             metrics=dict(last),
             checkpoint=best,
             path=trial_dir,
             error=error,
-            goodput=executor.goodput_stats(),
+            goodput=goodput,
         )

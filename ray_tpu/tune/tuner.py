@@ -57,7 +57,11 @@ class _TrialActor:
         # digest-verified committed path (so a trial rescheduled onto
         # another node is not stuck chasing a dead node's local dir)
         initial = checkpointing.load_checkpoint(ckpt_path) if ckpt_path else None
-        session = _Session(ctx, collector, initial)
+        # step-plane records index under the trial id (every trial under
+        # one shared "train" run would be unreadable)
+        session = _Session(
+            ctx, collector, initial, run_name=f"tune:{self.trial_id}"
+        )
         # reports carry the trial id instead of a worker rank
         session.collector = _CollectorProxy(self.trial_id, collector)
         _set_session(session)
@@ -84,7 +88,14 @@ class _CollectorProxy:
         proxy = self
 
         class _M:
-            def remote(self, rank, iteration, metrics, ckpt_path):
+            def remote(self, rank, iteration, metrics, ckpt_path,
+                       step_rec=None):
+                if step_rec is not None:
+                    # no BackendExecutor drains tune trials: step-plane
+                    # records take the telemetry ring to the StepIndex
+                    from ray_tpu._private import telemetry
+
+                    telemetry.record_train_step(step_rec)
                 return proxy.inner.report.remote(
                     proxy.trial_id, iteration, metrics, ckpt_path
                 )

@@ -147,6 +147,15 @@ class Histogram(_Metric):
         self._boundaries = resolve_boundaries(name, boundaries)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self.observe_many((value,), tags)
+
+    def observe_many(self, values, tags: Optional[Dict[str, str]] = None):
+        """Fold a batch of observations in with ONE entry copy + snapshot
+        enqueue (observe() per value pays a json round-trip each — hot
+        per-step callers like the train step plane accumulate locally and
+        flush batches through here)."""
+        if not values:
+            return
         key = self._key(tags)
         with _lock:
             entry = _local[self._name].get(key) or {
@@ -155,14 +164,15 @@ class Histogram(_Metric):
                 "buckets": [0] * (len(self._boundaries) + 1),
             }
             entry = json.loads(json.dumps(entry))  # copy
-        entry["count"] += 1
-        entry["sum"] += value
-        for i, b in enumerate(self._boundaries):
-            if value <= b:
-                entry["buckets"][i] += 1
-                break
-        else:
-            entry["buckets"][-1] += 1
+        for value in values:
+            entry["count"] += 1
+            entry["sum"] += value
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    entry["buckets"][i] += 1
+                    break
+            else:
+                entry["buckets"][-1] += 1
         entry["boundaries"] = self._boundaries
         self._store(key, entry)
 

@@ -21,9 +21,11 @@ from ray_tpu.util.state.api import (
     list_placement_groups,
     list_tasks,
     list_traces,
+    list_train_runs,
     list_workers,
     summarize_objects,
     summarize_tasks,
+    train_run,
 )
 
 __all__ = [
@@ -41,6 +43,8 @@ __all__ = [
     "list_jobs",
     "list_logs",
     "list_traces",
+    "list_train_runs",
+    "train_run",
     "job_latency",
     "get_log",
     "summarize_tasks",

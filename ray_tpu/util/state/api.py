@@ -191,6 +191,29 @@ def job_latency() -> Dict[str, dict]:
     return _rpc("job_latency")
 
 
+def list_train_runs() -> List[dict]:
+    """Training runs in the step plane's bounded index, newest first: one
+    digest per run (world size, steps seen, recompiles, live goodput,
+    attributed downtime seconds, data-wait ratio, max rank skew, status).
+    Drill into one with :func:`train_run` or ``ray_tpu.train_timeline``.
+    Mid-run, step records lag at most one executor publish interval
+    (``train_goodput_publish_interval_s``); a finished fit() has pushed
+    everything."""
+    _flush_for_read(cluster=True)
+    return _rpc("list_train_runs")
+
+
+def train_run(run: str, max_steps: Optional[int] = None) -> Optional[dict]:
+    """One training run's full step-time attribution: per-step per-rank
+    stage records (``data_wait`` / ``host_to_device`` / ``compile`` /
+    ``compute`` / ``collective_wait`` with the straggler rank /
+    ``checkpoint_stall`` / ``other``), run-level stage totals, per-operator
+    ingest stalls, and the executor's goodput + downtime ledger. ``None``
+    when the run is unknown."""
+    _flush_for_read(cluster=True)
+    return _rpc("train_run", run, max_steps)
+
+
 def list_checkpoints(filters=None, limit: int = 10_000) -> List[dict]:
     """Checkpoints of every run registered with the checkpoint plane
     (``ray_tpu.train.checkpointing``): one row per checkpoint prefix with

@@ -3,6 +3,7 @@ cluster from a C++ process (parity: the reference's ``cpp/`` frontend and its
 cluster tests, ``cpp/src/ray/test/``)."""
 
 import os
+import shutil
 import subprocess
 
 import pytest
@@ -12,8 +13,58 @@ import ray_tpu
 CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "ray_tpu", "cpp")
 
 
+def _cheap_skip_reason():
+    """Collection-time checks only (no subprocesses — every pytest run
+    collects this module). The two known baseline reds polluting tier-1
+    (CHANGES PR 4 / PR 11): a missing g++ on slow hosts, and a CPython
+    whose multiprocessing auth predates the sha256 challenge the client
+    implements."""
+    import sys
+
+    if sys.version_info < (3, 12):
+        # CPython < 3.12 deliver_challenge() speaks legacy md5-HMAC with no
+        # {digest} prefix; the C++ client implements the 3.12 sha256
+        # protocol and refuses ("unsupported auth digest md5")
+        return (
+            f"python {sys.version_info.major}.{sys.version_info.minor} "
+            "multiprocessing auth is md5-only (client needs >= 3.12 sha256)"
+        )
+    if shutil.which("g++") is None:
+        return "no g++ on PATH"
+    return None
+
+
+_SKIP_REASON = _cheap_skip_reason()
+pytestmark = pytest.mark.skipif(
+    _SKIP_REASON is not None,
+    reason=f"C++ client tests cannot run here ({_SKIP_REASON})",
+)
+
+
+def _assert_gxx_works():
+    """Run-time (selected-tests-only) probe: a g++ that exists but cannot
+    compile a trivial program skips with the reason; a g++ that works but
+    fails the REAL client build below still FAILS loudly (that would be a
+    build regression, not an environment gap)."""
+    try:
+        proc = subprocess.run(
+            ["g++", "-x", "c++", "-", "-fsyntax-only"],
+            input="int main() { return 0; }\n",
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        pytest.skip(f"g++ not runnable: {e}")
+    if proc.returncode != 0:
+        pytest.skip(
+            f"g++ cannot compile a trivial program: {proc.stderr[:200]}"
+        )
+
+
 @pytest.fixture(scope="module")
 def cpp_demo_binary():
+    _assert_gxx_works()
     proc = subprocess.run(
         ["make", "-C", CPP_DIR], capture_output=True, text=True, timeout=120
     )
