@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net ci clean
 
 all: native cpp
 
@@ -79,6 +79,14 @@ train-obs-demo:
 # (budget <= 1.05). --append writes the row to BENCH_CORE.jsonl
 bench-train-obs:
 	JAX_PLATFORMS=cpu $(PY) bench_train_obs.py --append
+
+# transfer-plane overhead + per-path GiB/s: socket-plane broadcast with the
+# plane toggled in alternating pairs (median per-pair ratio, budget <= 1.05)
+# plus the link ledger's per-path EWMAs and the stage-coverage ratio.
+# --append writes the rows to BENCH_SCALE.jsonl. Fails non-zero on budget
+# violation.
+bench-net:
+	JAX_PLATFORMS=cpu $(PY) bench_netplane.py --append
 
 # multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
 # must not degrade a high-priority job's p99 probe latency beyond 2x its

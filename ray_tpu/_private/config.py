@@ -190,6 +190,40 @@ class Config:
     # (ray_tpu_train_goodput and the train_run_meta push); previously the
     # gauge only appeared at fit() teardown
     train_goodput_publish_interval_s: float = 5.0
+    # --- transfer-plane observability (netplane; see DESIGN_MAP
+    # "Transfer-plane observability") ---
+    # decompose every inter-node transfer (socket fetch / same-host shm
+    # copy / peer-arena read / spill restore) into dial -> request ->
+    # first_byte_wait -> wire -> seal stage records riding EXISTING
+    # messages, keep the scheduler-side per-(src, dst, path) link ledger,
+    # and run the slow-link / stalled-transfer watchdog. Requires
+    # telemetry_enabled; bench-tracked overhead ratio <= 1.05
+    transfer_plane_enabled: bool = True
+    # _InflightRead.wait_covered: how long a downstream relay serve waits
+    # for a byte range to land before raising ObjectTransferStalledError
+    # (was a hardcoded 120s returning a bare False)
+    transfer_coverage_timeout_s: float = 120.0
+    # _InflightRead.wait_serves_drained: how long an aborting receive
+    # waits for downstream serves before LEAKING the buffer (counted in
+    # ray_tpu_transfer_leaked_buffers_total; was a hardcoded 60s)
+    transfer_drain_timeout_s: float = 60.0
+    # watchdog: an in-flight transfer with no observed chunk progress for
+    # this long gets an OBJECT_TRANSFER_STALLED cluster event
+    transfer_stall_warn_s: float = 10.0
+    # watchdog: a link whose throughput EWMA sits below this fraction of
+    # the fleet median (socket/relay links with enough samples) gets a
+    # SLOW_LINK cluster event
+    slow_link_fraction: float = 0.3
+    # transfers below this size don't update a link's throughput EWMA
+    # (dial/framing dominates; they would only add noise)
+    slow_link_min_bytes: int = 1024 * 1024
+    # worker-side read records (peer-arena / spill-restore) below this
+    # size skip the telemetry record — the wire plane is about bulk bytes
+    net_min_record_bytes: int = 256 * 1024
+    # bounds: recent-transfer ring and the link ledger (beyond the cap new
+    # links collapse into an <other> row, never unbounded label growth)
+    net_recent_transfers_max: int = 512
+    net_links_max: int = 4096
     # --- failure forensics (cluster event log, watchdogs) ---
     # bound on the scheduler's structured cluster-event log (WORKER_DIED,
     # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest

@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Dict, Optional, Set, Tuple
 
-from ray_tpu._private import fastcopy, memplane
+from ray_tpu._private import fastcopy, memplane, netplane
 from ray_tpu._private.fastcopy import stage_timer
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, StoreFullError, StorePutMixin
@@ -303,6 +303,7 @@ class NativeStoreClient(StorePutMixin):
                     pass
                 created = False
 
+        t_read0 = time.perf_counter()
         try:
             with stage_timer("store.restore.read"):
                 n = storage.read_into(uri, make_dest)
@@ -322,6 +323,12 @@ class NativeStoreClient(StorePutMixin):
                 if mv is not None:
                     self._external_miss.pop(oid, None)
                     memplane.note_restore(oid, n or 0)
+                    # transfer plane: a spill restore IS a transfer
+                    # (path=spill) — ledger record rides telemetry
+                    netplane.record_read(
+                        "spill", oid, n or 0,
+                        time.perf_counter() - t_read0,
+                    )
                     return mv
             except Exception:
                 _abort_created()
@@ -334,6 +341,9 @@ class NativeStoreClient(StorePutMixin):
             return None
         self._external_miss.pop(oid, None)
         memplane.note_restore(oid, len(data))
+        netplane.record_read(
+            "spill", oid, len(data), time.perf_counter() - t_read0
+        )
         try:
             dest = self.create(oid, len(data))
             fastcopy.copy_into(dest, data)

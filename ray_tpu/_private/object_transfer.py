@@ -19,6 +19,7 @@ from multiprocessing.connection import Client, Listener
 from typing import Optional
 
 from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectTransferStalledError as _StalledError
 
 logger = logging.getLogger(__name__)
 
@@ -57,15 +58,18 @@ class _InflightRead:
     store-and-forwarding whole objects (parity: PushManager's chunked
     concurrent push, push_manager.h:30)."""
 
-    __slots__ = ("view", "total", "cv", "covered", "failed", "serving")
+    __slots__ = ("view", "total", "cv", "covered", "failed", "serving",
+                 "oid_hex", "link")
 
-    def __init__(self, view, total: int):
+    def __init__(self, view, total: int, oid_hex: str = "", link: str = ""):
         self.view = view
         self.total = total
         self.cv = threading.Condition()
         self.covered = []  # merged, sorted (lo, hi) intervals
         self.failed = False
         self.serving = 0  # active downstream serves; abort waits for drain
+        self.oid_hex = oid_hex  # stall-error provenance
+        self.link = link  # upstream source, set by the fetch driver
 
     def mark(self, lo: int, hi: int) -> None:
         with self.cv:
@@ -92,15 +96,37 @@ class _InflightRead:
                 return True
         return False
 
-    def wait_covered(self, lo: int, hi: int, timeout: float = 120.0) -> bool:
-        deadline = time.monotonic() + timeout
+    def wait_covered(
+        self, lo: int, hi: int, timeout: Optional[float] = None
+    ) -> bool:
+        """Block until [lo, hi) has landed. Returns False when the UPSTREAM
+        fetch failed (the downstream re-sources — existing semantics); a
+        coverage TIMEOUT instead raises ObjectTransferStalledError with
+        progress provenance, so a wedged-but-alive upstream surfaces as a
+        named stall, not a generic fetch failure. ``timeout`` defaults to
+        the ``transfer_coverage_timeout_s`` config knob (was a hardcoded
+        120s)."""
+        if timeout is None:
+            from ray_tpu._private import netplane
+
+            timeout = netplane.coverage_timeout_s()
+        start = time.monotonic()
+        deadline = start + timeout
         with self.cv:
             while not self._has(lo, hi):
                 if self.failed:
                     return False
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return False
+                    covered = sum(b - a for a, b in self.covered)
+                    raise _StalledError(
+                        f"receive made no progress past byte {lo}",
+                        object_id=self.oid_hex or None,
+                        link=self.link or None,
+                        covered_bytes=covered,
+                        total_bytes=self.total,
+                        waited_s=time.monotonic() - start,
+                    )
                 self.cv.wait(min(remaining, 1.0))
             return not self.failed
 
@@ -113,11 +139,18 @@ class _InflightRead:
             self.serving -= 1
             self.cv.notify_all()
 
-    def wait_serves_drained(self, timeout: float = 60.0) -> bool:
+    def wait_serves_drained(self, timeout: Optional[float] = None) -> bool:
         """Called before abort() frees the receive buffer: a downstream
         serve mid-send must not read recycled arena memory. Returns False
         if serves are still active at the deadline — the caller must then
-        LEAK the buffer rather than recycle it under a live reader."""
+        LEAK the buffer rather than recycle it under a live reader (the
+        leak is COUNTED: ray_tpu_transfer_leaked_buffers_total). ``timeout``
+        defaults to the ``transfer_drain_timeout_s`` config knob (was a
+        hardcoded 60s)."""
+        if timeout is None:
+            from ray_tpu._private import netplane
+
+            timeout = netplane.drain_timeout_s()
         deadline = time.monotonic() + timeout
         with self.cv:
             while self.serving > 0 and time.monotonic() < deadline:
@@ -149,7 +182,7 @@ class ObjectServer:
     # -- inflight registry (the local fetch driver feeds it) ---------------
 
     def register_inflight(self, oid: ObjectID, view, total: int) -> _InflightRead:
-        tracker = _InflightRead(view, total)
+        tracker = _InflightRead(view, total, oid_hex=oid.hex())
         with self._inflight_lock:
             self._inflight[oid.binary()] = tracker
         return tracker
@@ -244,6 +277,12 @@ class ObjectServer:
                         conn.send_bytes(tracker.view[off:hi])
                 finally:
                     tracker.serve_end()
+        except _StalledError as e:
+            # coverage timeout on a pipelined relay serve: drop the conn so
+            # the downstream re-sources; the stall keeps its provenance in
+            # the log (and the watchdog has already been flagging the
+            # wedged upstream receive via its progress watermark)
+            logger.warning("relay serve stalled: %s", e)
         except (EOFError, OSError, BrokenPipeError):
             pass
         finally:
@@ -282,7 +321,66 @@ def _recv_range(conn, view, start: int, end: int, progress=None) -> None:
         off += n
 
 
-def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest, progress=None) -> Optional[int]:
+class _WireClock:
+    """Per-transfer stage decomposition fed by the recv loops: dial →
+    request → first_byte_wait → wire (bytes, chunks) → seal, written into
+    the caller's stats dict in ms (transfer plane — the record rides the
+    fetch's existing completion message). Thread-safe: stripe recv threads
+    share one clock."""
+
+    __slots__ = ("stats", "_lock", "_t_req_end", "_t_first", "_t_last",
+                 "_chunks", "_bytes")
+
+    def __init__(self, stats: dict):
+        self.stats = stats
+        self._lock = threading.Lock()
+        self._t_req_end = None
+        self._t_first = None
+        self._t_last = None
+        self._chunks = 0
+        self._bytes = 0
+
+    def request_done(self) -> None:
+        self._t_req_end = time.perf_counter()
+
+    def chunk(self, lo: int, hi: int) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+            self._chunks += 1
+            self._bytes += hi - lo
+
+    def finish(self) -> None:
+        s = self.stats
+        if self._t_req_end is not None and self._t_first is not None:
+            s["first_byte_wait_ms"] = max(
+                0.0, (self._t_first - self._t_req_end) * 1e3
+            )
+        if self._t_first is not None and self._t_last is not None:
+            wire_s = max(0.0, self._t_last - self._t_first)
+            s["wire_ms"] = wire_s * 1e3
+            # the socket wire also joins the large-object data path's
+            # per-stage event_stats (count/seconds/bytes -> GiB/s)
+            try:
+                from ray_tpu._private import fastcopy
+
+                fastcopy.record_stage(
+                    "store.fetch.socket_wire", wire_s, self._bytes
+                )
+            except Exception:
+                pass
+        s["chunks"] = self._chunks
+        # bytes = announced size; bytes_received = what actually landed
+        # (the ledger charges a FAILED transfer only its received bytes)
+        s["bytes_received"] = self._bytes
+        s.setdefault("bytes", self._bytes)
+
+
+def fetch_object_into(
+    addr, oid: ObjectID, auth_key, make_dest, progress=None, stats=None
+) -> Optional[int]:
     """Pull one sealed object from a peer directly into a caller-provided
     buffer (``make_dest(size) -> memoryview``), striping large objects over
     several concurrent sockets.
@@ -291,20 +389,41 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest, progress=None) -
     the staging copy the old bytearray path paid (parity: the reference
     receives chunks into plasma-allocated buffers,
     object_buffer_pool.h:41). ``progress(lo, hi)`` fires per received chunk
-    so an in-flight receive can relay onward (pipelined broadcast).
+    so an in-flight receive can relay onward (pipelined broadcast). With
+    ``stats`` (a dict), the transfer's stage decomposition is recorded
+    (dial/request/first_byte_wait/wire ms + bytes/chunks — netplane).
     Returns the object size, or None if missing.
     """
     key = auth_key.encode() if isinstance(auth_key, str) else auth_key
+    clock = _WireClock(stats) if stats is not None else None
+    if clock is not None:
+        base_progress = progress
+
+        def progress(lo, hi, _p=base_progress):  # noqa: F811
+            clock.chunk(lo, hi)
+            if _p is not None:
+                _p(lo, hi)
+
+    t0 = time.perf_counter()
     conn = _dial(addr, key)
+    if stats is not None:
+        stats["dial_ms"] = (time.perf_counter() - t0) * 1e3
     try:
+        t1 = time.perf_counter()
         conn.send(("get_range", oid.binary(), 0, STRIPE_THRESHOLD))
         head = conn.recv()
+        if stats is not None:
+            stats["request_ms"] = (time.perf_counter() - t1) * 1e3
+        if clock is not None:
+            clock.request_done()
         if head[0] != "size":
             return None
         size = head[1]
         view = make_dest(size)
         if view is None:
             return None
+        if stats is not None:
+            stats["bytes"] = size
         first_end = min(size, STRIPE_THRESHOLD)
         _recv_range(conn, view, 0, first_end, progress)
         rest = size - first_end
@@ -347,6 +466,10 @@ def fetch_object_into(addr, oid: ObjectID, auth_key, make_dest, progress=None) -
                 raise errors[0]
         return size
     finally:
+        # finish on failure too: a mid-wire death still reports its
+        # received-byte watermark and partial stage timings
+        if clock is not None:
+            clock.finish()
         try:
             conn.close()
         except OSError:
@@ -451,12 +574,15 @@ def read_peer_pinned(src_shm_dir: str, oid: ObjectID) -> Optional[memoryview]:
     return pinned_view(lib, h, oid.binary(), base, off, size.value)
 
 
-def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
+def fetch_from_same_host(
+    store, src_shm_dir: str, oid: ObjectID, stats=None
+) -> bool:
     """Same-host short-circuit: copy ``oid`` out of a colocated peer node's
     store (shm arena or .obj file) straight into ``store`` — one memcpy, no
     sockets (parity: plasma's everything-on-one-node-is-shared-memory).
     Returns False when the peer copy isn't reachable this way (caller falls
-    back to the socket path)."""
+    back to the socket path). With ``stats``, records the memcpy as the
+    wire stage and the seal (netplane shm_peer record)."""
     import ctypes
     import mmap
 
@@ -470,13 +596,21 @@ def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
             dest = store.create(oid, view.nbytes)
         except ValueError:
             return store.contains(oid)  # concurrent fetch owns/finished it
+        t0 = time.perf_counter()
         try:
             with fastcopy.stage_timer("store.fetch.shm_copy", view.nbytes):
                 fastcopy.copy_into(dest, view)
         except BaseException:
             store.abort(oid)
             raise
+        t1 = time.perf_counter()
         store.seal(oid)
+        if stats is not None:
+            stats["path"] = "shm_peer"
+            stats["bytes"] = view.nbytes
+            stats["chunks"] = 1
+            stats["wire_ms"] = (t1 - t0) * 1e3
+            stats["seal_ms"] = (time.perf_counter() - t1) * 1e3
         return True
 
     # sealed .obj file in the peer's shm dir (file-store backend)
@@ -511,33 +645,53 @@ def fetch_from_same_host(store, src_shm_dir: str, oid: ObjectID) -> bool:
 
 
 def fetch_via_src_info(
-    store, src_info, oid: ObjectID, auth_key, shm_enabled: bool, server=None
+    store,
+    src_info,
+    oid: ObjectID,
+    auth_key,
+    shm_enabled: bool,
+    server=None,
+    stats=None,
 ) -> bool:
     """Shared head/daemon fetch driver: normalize the source descriptor, try
     the same-host shm path when eligible, fall back to the socket plane —
     UNLESS the head marked the transfer shm-only (uncharged against the
     per-source admission cap): then a shm miss is reported as failure so the
     head can re-admit it through the socket plane's cap instead of letting N
-    uncapped socket fetches stampede one origin."""
+    uncapped socket fetches stampede one origin. ``stats`` (a dict) is
+    filled with the transfer's stage decomposition and rides the fetch's
+    completion message back to the scheduler's link ledger (netplane)."""
     if not isinstance(src_info, dict):  # legacy shape: bare address
         src_info = {"addr": src_info, "shm_dir": "", "host_id": ""}
-    if (
-        shm_enabled
-        and src_info.get("shm_dir")
-        and src_info.get("host_id") == machine_id()
-    ):
-        if fetch_from_same_host(store, src_info["shm_dir"], oid):
-            return True
-        if src_info.get("shm_only"):
-            return False
-    if src_info.get("addr"):
-        return fetch_into_local_store(
-            store, src_info["addr"], oid, auth_key, server=server
-        )
-    return False
+    if stats is not None:
+        stats.setdefault("t0", time.time())
+    t_start = time.perf_counter()
+    try:
+        if (
+            shm_enabled
+            and src_info.get("shm_dir")
+            and src_info.get("host_id") == machine_id()
+        ):
+            if fetch_from_same_host(
+                store, src_info["shm_dir"], oid, stats=stats
+            ):
+                return True
+            if src_info.get("shm_only"):
+                return False
+        if src_info.get("addr"):
+            return fetch_into_local_store(
+                store, src_info["addr"], oid, auth_key, server=server,
+                stats=stats,
+            )
+        return False
+    finally:
+        if stats is not None:
+            stats["total_ms"] = (time.perf_counter() - t_start) * 1e3
 
 
-def fetch_into_local_store(store, addr, oid: ObjectID, auth_key, server=None) -> bool:
+def fetch_into_local_store(
+    store, addr, oid: ObjectID, auth_key, server=None, stats=None
+) -> bool:
     """Pull ``oid`` from a peer straight into ``store``: stripes land in the
     create()d buffer (no staging copy), sealed on completion, aborted on
     failure (parity: chunks received into plasma-allocated buffers,
@@ -545,34 +699,69 @@ def fetch_into_local_store(store, addr, oid: ObjectID, auth_key, server=None) ->
     the receive registers as IN FLIGHT so downstream peers stream chunks
     that already landed — the pipelined relay. Returns True when a local
     sealed copy exists afterwards (including via a concurrent fetch winning
-    the create race).
+    the create race). ``stats`` (a dict) collects the stage decomposition
+    and leak accounting for the transfer plane.
     """
+    from ray_tpu._private import netplane
+
     if store.contains(oid):
         return True
+    if stats is not None:
+        stats.setdefault("path", "socket")
     created = False
+    created_size = 0
     tracker = None
+    inflight_key = None
+    received = [0]  # cumulative landed bytes (stall-watchdog watermark)
     try:
 
         def make_dest(size: int):
-            nonlocal created, tracker
+            nonlocal created, created_size, tracker, inflight_key
             try:
                 view = store.create(oid, size)
                 created = True
+                created_size = size
             except ValueError:
                 return None  # a concurrent fetch owns it
             if server is not None:
                 tracker = server.register_inflight(oid, view, size)
+                # upstream source provenance for stall errors raised by
+                # downstream serves off this receive
+                try:
+                    tracker.link = (
+                        f"{addr[0]}:{addr[1]}"
+                        if isinstance(addr, (list, tuple))
+                        else str(addr)
+                    )
+                except Exception:
+                    pass
+            if netplane.enabled():
+                inflight_key = oid.hex()
+                netplane.begin_inflight(inflight_key, size)
             return view
+
+        def progress(lo: int, hi: int) -> None:
+            if tracker is not None:
+                tracker.mark(lo, hi)
+            if inflight_key is not None:
+                # benign under the GIL: stripe threads may lose an update,
+                # the watermark still moves — it only feeds stall detection
+                received[0] += hi - lo
+                netplane.note_progress(inflight_key, received[0])
 
         n = fetch_object_into(
             addr,
             oid,
             auth_key,
             make_dest,
-            progress=(lambda lo, hi: tracker.mark(lo, hi)) if server is not None else None,
+            progress=progress if (server is not None or netplane.enabled()) else None,
+            stats=stats,
         )
         if n is not None and created:
+            t_seal = time.perf_counter()
             store.seal(oid)
+            if stats is not None:
+                stats["seal_ms"] = (time.perf_counter() - t_seal) * 1e3
             created = False
             if tracker is not None:
                 # sealed: the buffer is now the durable copy; late serves
@@ -582,6 +771,8 @@ def fetch_into_local_store(store, addr, oid: ObjectID, auth_key, server=None) ->
             return True
         return store.contains(oid)  # the concurrent fetch finished (or not)
     finally:
+        if inflight_key is not None:
+            netplane.end_inflight(inflight_key)
         if created:
             drained = True
             if tracker is not None:
@@ -592,11 +783,16 @@ def fetch_into_local_store(store, addr, oid: ObjectID, auth_key, server=None) ->
                 # a downstream serve is still mid-send on this buffer (peer
                 # stalled in TCP backpressure): leaking the unsealed create
                 # is strictly better than recycling memory under a live
-                # reader, which would seal silent garbage downstream
+                # reader, which would seal silent garbage downstream. The
+                # leak is COUNTED — it rides this fetch's completion message
+                # into ray_tpu_transfer_leaked_buffers_total + a WARNING
+                # cluster event instead of vanishing into a log line.
                 logger.warning(
                     "leaking unsealed receive buffer for %s: relay serves "
                     "did not drain", oid.hex()[:8]
                 )
+                if stats is not None:
+                    stats["leaked_bytes"] = created_size
                 created = False
         if created:
             try:

@@ -180,6 +180,12 @@ class NodeDaemon:
         # daemon's host so cross-host callers can reach them
         self.config.node_host = self._host
         self._config_blob = pickle.dumps(self.config)
+        # transfer plane: this process has no connected runtime, so the
+        # netplane module (stage capture, coverage/drain timeouts) reads
+        # the head's resolved config installed here
+        from ray_tpu._private import netplane
+
+        netplane.configure(self.config)
 
     def _reconnect(self) -> bool:
         """Head connection lost: keep dialing the head address and re-attach
@@ -274,6 +280,8 @@ class NodeDaemon:
         while not self._stop:
             if time.monotonic() - self._loop_tick < self.LOOP_HUNG_S:
                 try:
+                    from ray_tpu._private import netplane
+
                     stats = collector.collect(
                         store=self.store,
                         extra={
@@ -282,6 +290,14 @@ class NodeDaemon:
                             "lease_running": len(self._lease_running),
                             "lease_epoch": self._lease_epoch,
                             "pid": os.getpid(),
+                            # in-flight receive watermarks ride the beat:
+                            # the head's stall watchdog compares BYTES
+                            # across beats (clocks are process-local)
+                            "transfers": netplane.inflight_snapshot(),
+                            # read records captured daemon-side (spill
+                            # restores in this process have no telemetry
+                            # pipe) drain into the ledger via the beat
+                            "transfer_reads": netplane.drain_pending_reads(),
                         },
                     )
                 except Exception:
@@ -830,9 +846,14 @@ class NodeDaemon:
     # -- object plane ------------------------------------------------------
 
     def _fetch_object(self, oid: ObjectID, src_info):
+        from ray_tpu._private import netplane
         from ray_tpu._private.object_transfer import fetch_via_src_info
 
         ok = False
+        # stage decomposition rides the EXISTING completion message below
+        # (netplane's ride-existing-messages rule): the head correlates it
+        # with the (src, dst, hop) it already tracks in _fetching
+        stats = {} if netplane.enabled() else None
         try:
             ok = fetch_via_src_info(
                 self.store,
@@ -841,11 +862,14 @@ class NodeDaemon:
                 self.auth_key,
                 getattr(self.config, "same_host_shm_transfer", True),
                 server=self.object_server,
+                stats=stats,
             )
-        except Exception:
+        except Exception as e:
+            if stats is not None:
+                stats["error"] = f"{type(e).__name__}: {e}"[:200]
             logger.exception("fetch %s failed", oid.hex()[:8])
         try:
-            self._send(("object_fetched", oid.binary(), ok))
+            self._send(("object_fetched", oid.binary(), ok, stats or None))
         except (OSError, EOFError):
             pass
 

@@ -45,6 +45,7 @@ from ray_tpu._private.ids import (
     TaskID,
     WorkerID,
 )
+from ray_tpu._private import netplane as _netplane
 from ray_tpu._private.object_store import StoreFullError
 from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu._private.resources import quantize
@@ -730,6 +731,45 @@ class Scheduler:
         self._xfer_load: Dict[NodeID, int] = collections.defaultdict(int)
         # oid -> destinations waiting for a source slot
         self._xfer_waiting: Dict[ObjectID, Set[NodeID]] = {}
+        # ---- transfer-plane observability (netplane; see DESIGN_MAP
+        # "Transfer-plane observability") ----
+        # bounded per-(src, dst, path) link ledger: cumulative bytes /
+        # transfers / failures / stalls / throughput EWMA / relay hop
+        # high-water. Beyond net_links_max new links fold into <other>.
+        self._net_links: Dict[Tuple[str, str, str], dict] = {}
+        # bounded ring of completed transfer records (stage decompositions
+        # with trace ids) — the `ray_tpu net transfers` / dashboard feed
+        self._net_recent: Deque[dict] = collections.deque(
+            maxlen=int(getattr(config, "net_recent_transfers_max", 512) or 512)
+        )
+        # (oid, dest) -> {"t0", "t0_mono", "hop", "trace", "src",
+        # "seen_bytes", "seen_t"}: start stamp + relay hop + requester
+        # trace ctx + the stall watchdog's progress watermark
+        self._fetch_meta: Dict[Tuple[ObjectID, NodeID], dict] = {}
+        # oid -> (trace_id, span_id) of the most recent traced requester
+        # (rides the ensure_local rpc; bounded)
+        self._xfer_trace_req: Dict[ObjectID, Tuple[str, str]] = {}
+        # oid -> outstanding fetch count (O(1) requester-ctx GC on the
+        # completion path instead of scanning _fetching per transfer)
+        self._xfer_inflight_by_oid: Dict[ObjectID, int] = {}
+        # per-producing-task-name completed socket-plane bytes: the data
+        # streaming executor's per-operator cross-node byte attribution
+        # (block tasks are name-tagged `data:<stage>`); bounded
+        self._xfer_bytes_by_name: Dict[Tuple[str, str], int] = {}
+        # stage-seconds totals across completed transfers (dial / request /
+        # first_byte_wait / wire / seal) + per-path throughput EWMA
+        self._net_stage_seconds: Dict[str, float] = {}
+        self._net_path_ewma: Dict[str, float] = {}
+        self._net_hop_counts: Dict[int, int] = {}
+        self._xfer_retries_total = 0
+        self._xfer_stalled_total = 0
+        self._xfer_leaked = [0, 0]  # buffers, bytes
+        self._slow_link_events = 0
+        self._xfer_load_peak = 0
+        self._last_netscan = time.monotonic()
+        # event dedup stamps: stall per (oid, dest), slow per link
+        self._net_stall_last_event: Dict[Tuple, float] = {}
+        self._slow_link_last_event: Dict[Tuple, float] = {}
         # head node's own object server address + instance (set by HeadServer)
         self.head_object_addr = None
         self.head_object_server = None
@@ -945,10 +985,13 @@ class Scheduler:
         elif kind == "worker_died":
             self._on_worker_death(WorkerID(msg[1]))
         elif kind == "object_fetched":
-            _, oid_bin, ok = msg
+            # the stage decomposition rides the completion message
+            # (netplane's ride-existing-messages rule)
+            _, oid_bin, ok = msg[:3]
+            stats = msg[3] if len(msg) > 3 else None
             nid = self._daemon_conns.get(conn)
             if nid is not None:
-                self._xfer_complete(ObjectID(oid_bin), nid, ok)
+                self._xfer_complete(ObjectID(oid_bin), nid, ok, stats=stats)
         elif kind == "lease_done":
             nid = self._daemon_conns.get(conn)
             if nid is not None:
@@ -1007,6 +1050,13 @@ class Scheduler:
                 node.last_heartbeat = time.monotonic()
                 if len(msg) > 2 and msg[2]:
                     node.stats = msg[2]  # reporter metrics ride the beat
+                    # daemon-side read records (spill restores) that rode
+                    # the beat land on the link ledger
+                    for trec in node.stats.pop("transfer_reads", None) or ():
+                        try:
+                            self._ingest_transfer_record(trec, dst_node=nid)
+                        except Exception:
+                            logger.exception("heartbeat read record failed")
                     self._reconcile_leases(nid, node)
         elif kind == "stack_samples":
             _, req_id, samples = msg
@@ -1168,7 +1218,13 @@ class Scheduler:
             self._on_telemetry_ack(msg[1])
         elif kind == "rpc":
             _, req_id, op, args = msg
-            if op in ("ensure_local", "same_host_dirs") and len(args) == 1:
+            if op == "ensure_local_traced":
+                # traced variant: (oid, (trace_id, span_id)) — destination
+                # is the calling worker's node, and the requester ctx lets
+                # the transfer's wire span join the task's trace tree
+                op = "ensure_local"
+                args = (args[0], w.node_id) + tuple(args[1:])
+            elif op in ("ensure_local", "same_host_dirs") and len(args) == 1:
                 # destination defaults to the calling worker's node
                 args = (args[0], w.node_id)
             try:
@@ -1329,8 +1385,25 @@ class Scheduler:
             waiting.discard(dest)
         # value: (src, charged) — shm short-circuits don't hold a source slot
         self._fetching[key] = (src, same_host is None)
+        self._xfer_inflight_by_oid[oid] = (
+            self._xfer_inflight_by_oid.get(oid, 0) + 1
+        )
+        # transfer plane: hop tagging (a source that is itself still
+        # RECEIVING makes this a relay hop) + requester trace ctx + the
+        # stall watchdog's start stamp
+        src_meta = self._fetch_meta.get((oid, src))
+        self._fetch_meta[key] = {
+            "t0": time.time(),
+            "t0_mono": time.monotonic(),
+            "hop": (src_meta["hop"] + 1) if src_meta is not None else 0,
+            "trace": self._xfer_trace_req.get(oid),
+            "seen_bytes": -1,
+            "seen_t": time.monotonic(),
+        }
         if same_host is None:
             self._xfer_load[src] += 1
+            if self._xfer_load[src] > self._xfer_load_peak:
+                self._xfer_load_peak = self._xfer_load[src]
         src_node = self.nodes.get(src)
         # shm hints ride along only when the short-circuit is on — daemons
         # gate on their own flag too, but the head's decision must be enough
@@ -1369,12 +1442,29 @@ class Scheduler:
                 if d != dest:
                     self._ensure_local(oid, d)
 
-    def _xfer_complete(self, oid: ObjectID, dest: NodeID, ok: bool) -> None:
+    def _xfer_complete(
+        self, oid: ObjectID, dest: NodeID, ok: bool, stats=None
+    ) -> None:
         """One transfer settled: free its source slot, record the new copy,
-        and restart parked destinations (which can now source from it)."""
+        fold its stage record into the link ledger, and restart parked
+        destinations (which can now source from it)."""
         entry = self._fetching.pop((oid, dest), None)
+        meta = self._fetch_meta.pop((oid, dest), None)
+        if entry is not None:
+            left = self._xfer_inflight_by_oid.get(oid, 1) - 1
+            if left <= 0:
+                self._xfer_inflight_by_oid.pop(oid, None)
+            else:
+                self._xfer_inflight_by_oid[oid] = left
         if entry is not None and entry[1]:
             self._xfer_load[entry[0]] = max(0, self._xfer_load[entry[0]] - 1)
+        if entry is not None:
+            try:
+                self._note_transfer_done(
+                    oid, entry[0], dest, ok, entry[1], stats, meta
+                )
+            except Exception:
+                logger.exception("transfer ledger update failed")
         if ok:
             if entry is not None:
                 # charged == socket path; uncharged == same-host shm read
@@ -1393,18 +1483,22 @@ class Scheduler:
                     )
             self._object_locations[oid].add(dest)
             self._shm_xfer_failed.discard((oid, dest))
+            if oid not in self._xfer_inflight_by_oid:
+                self._xfer_trace_req.pop(oid, None)
         elif entry is not None and not entry[1]:
             # an shm-only read missed (peer spilled it / arena unreadable):
             # remember, so the retry goes through socket admission, and
             # re-drive the fetch now rather than waiting for the consumer's
             # next 2s poll
             self._shm_xfer_failed.add((oid, dest))
+            self._xfer_retries_total += 1
             self._ensure_local(oid, dest)
         elif entry is not None:
             # a socket fetch failed — with pipelined relays this includes a
             # failed UPSTREAM cascading down; re-source immediately (sealed
             # copies are preferred only through load, but a dead relay no
             # longer appears in _fetching, so the retry avoids it)
+            self._xfer_retries_total += 1
             self._ensure_local(oid, dest)
         waiters = self._xfer_waiting.pop(oid, None)
         if waiters:
@@ -1506,9 +1600,11 @@ class Scheduler:
         return True
 
     def _fetch_into_head(self, oid: ObjectID, src_info) -> None:
+        from ray_tpu._private import netplane
         from ray_tpu._private.object_transfer import fetch_via_src_info
 
         ok = False
+        stats = {} if netplane.enabled() else None
         try:
             ok = fetch_via_src_info(
                 self._node.store_client,
@@ -1517,10 +1613,543 @@ class Scheduler:
                 self.config.cluster_auth_key,
                 self.config.same_host_shm_transfer,
                 server=self.head_object_server,
+                stats=stats,
             )
-        except Exception:
+        except Exception as e:
+            if stats is not None:
+                stats["error"] = f"{type(e).__name__}: {e}"[:200]
             logger.exception("fetch of %s into head failed", oid.hex()[:8])
-        self.post(("fetch_done", oid, self._node.head_node_id, ok))
+        self.post(
+            ("fetch_done", oid, self._node.head_node_id, ok, stats or None)
+        )
+
+    # ---- transfer-plane observability (netplane; DESIGN_MAP
+    # "Transfer-plane observability") --------------------------------------
+
+    _NET_STAGE_KEYS = _netplane.STAGE_KEYS
+
+    def _node_label(self, nid: NodeID) -> str:
+        return "head" if nid == self._node.head_node_id else nid.hex()[:12]
+
+    def _link_row(self, src: str, dst: str, path: str) -> dict:
+        """Get-or-create one link-ledger row; beyond ``net_links_max`` new
+        links collapse into a per-path <other> row (bounded cardinality)."""
+        key = (src, dst, path)
+        row = self._net_links.get(key)
+        if row is None:
+            cap = int(getattr(self.config, "net_links_max", 4096) or 4096)
+            if len(self._net_links) >= cap:
+                key = ("<other>", "<other>", path)
+                row = self._net_links.get(key)
+                if row is not None:
+                    return row
+            row = self._net_links[key] = {
+                "src": key[0],
+                "dst": key[1],
+                "path": path,
+                "bytes": 0,
+                "transfers": 0,
+                "failures": 0,
+                "stalls": 0,
+                "samples": 0,
+                "ewma_gib_per_s": None,
+                "max_hop": 0,
+                "last_t": 0.0,
+                "slow": False,
+            }
+        return row
+
+    def _fold_link_throughput(
+        self, row: dict, path: str, nbytes: int, wire_s: float
+    ) -> Optional[float]:
+        """Fold one completed transfer's measured rate into the link's and
+        the path's throughput EWMA (transfers under ``slow_link_min_bytes``
+        skip the EWMA — dial/framing dominates them). Returns the raw
+        GiB/s, or None when unmeasurable."""
+        if wire_s <= 0 or not nbytes:
+            return None
+        gibps = nbytes / 2**30 / wire_s
+        if nbytes >= int(
+            getattr(self.config, "slow_link_min_bytes", 1 << 20) or 0
+        ):
+            prev = row["ewma_gib_per_s"]
+            row["ewma_gib_per_s"] = (
+                gibps if prev is None else 0.3 * gibps + 0.7 * prev
+            )
+            row["samples"] += 1
+            pp = self._net_path_ewma.get(path)
+            self._net_path_ewma[path] = (
+                gibps if pp is None else 0.3 * gibps + 0.7 * pp
+            )
+        return gibps
+
+    def _note_xfer_requester(self, oid: ObjectID, ctx, dest=None) -> None:
+        """A traced consumer asked for this object (ensure_local rpc): keep
+        its (trace_id, span_id) so the transfer's wire span can join the
+        request's trace tree as a child of the task's arg_fetch. Fetches
+        usually start from the PULL path before the consumer's traced rpc
+        lands, so the ctx is also backfilled into the already-in-flight
+        fetch toward the requester's node."""
+        try:
+            trace_id, span_id = ctx[0], ctx[1]
+        except (TypeError, IndexError):
+            return
+        if not trace_id:
+            return
+        if oid not in self._xfer_trace_req and len(self._xfer_trace_req) >= 2048:
+            self._xfer_trace_req.pop(next(iter(self._xfer_trace_req)))
+        self._xfer_trace_req[oid] = (trace_id, span_id)
+        if dest is not None:
+            meta = self._fetch_meta.get((oid, self._loc_node(dest)))
+            if meta is not None and not meta.get("trace"):
+                meta["trace"] = (trace_id, span_id)
+
+    def _note_transfer_done(
+        self, oid: ObjectID, src: NodeID, dest: NodeID, ok: bool,
+        charged: bool, stats, meta,
+    ) -> None:
+        """Fold one settled transfer into the link ledger: per-(src, dst,
+        path) bytes / counts / throughput EWMA, relay hop tags, stage
+        seconds, leak accounting, the recent-transfer ring, and — when the
+        requester was traced — a wire child span in its trace tree."""
+        if not getattr(self.config, "transfer_plane_enabled", True):
+            return
+        stats = stats or {}
+        meta = meta or {}
+        hop = int(meta.get("hop") or 0)
+        path = stats.get("path") or ("socket" if charged else "shm_peer")
+        if path == "socket" and hop > 0:
+            path = "relay"  # the source was itself still receiving
+        announced = int(
+            stats.get("bytes") or self._object_sizes.get(oid, 0) or 0
+        )
+        # a FAILED transfer only moved its received watermark — charging
+        # the full announced size would double-count after the retry
+        nbytes = (
+            announced if ok else int(stats.get("bytes_received") or 0)
+        )
+        src_l, dst_l = self._node_label(src), self._node_label(dest)
+        row = self._link_row(src_l, dst_l, path)
+        row["transfers"] += 1
+        row["bytes"] += nbytes
+        row["last_t"] = time.time()
+        if hop > row["max_hop"]:
+            row["max_hop"] = hop
+        if ok:  # hop counter documents COMPLETED transfers
+            self._net_hop_counts[hop] = self._net_hop_counts.get(hop, 0) + 1
+        else:
+            row["failures"] += 1
+        for k in self._NET_STAGE_KEYS:
+            v = stats.get(k)
+            if v:
+                stage = k[:-3]  # strip _ms
+                self._net_stage_seconds[stage] = (
+                    self._net_stage_seconds.get(stage, 0.0) + float(v) / 1e3
+                )
+        wire_s = float(stats.get("wire_ms") or 0.0) / 1e3
+        gibps = (
+            self._fold_link_throughput(row, path, nbytes, wire_s)
+            if ok
+            else None
+        )
+        leaked = int(stats.get("leaked_bytes") or 0)
+        if leaked:
+            # a relay serve outlived the drain window and the receive
+            # buffer was deliberately leaked (object_transfer.py): count
+            # it — recycled-arena leakage must be visible, not silent
+            self._xfer_leaked[0] += 1
+            self._xfer_leaked[1] += leaked
+            self.record_cluster_event(
+                "TRANSFER_BUFFER_LEAKED",
+                f"receive buffer for {oid.hex()[:16]} ({leaked} bytes) "
+                f"leaked on {dst_l}: relay serves did not drain within "
+                "transfer_drain_timeout_s",
+                severity="WARNING",
+                object_id=oid.hex(),
+                link=f"{src_l}->{dst_l}",
+                leaked_bytes=leaked,
+            )
+        # per-producing-task-name socket bytes: the data executor's
+        # per-operator cross-node attribution (block tasks are name-tagged
+        # `data:<stage>`) — the counter ROADMAP item 3's shuffle quotes
+        if ok and nbytes:
+            if oid.is_put():
+                name = "<put>"
+            else:
+                rec_t = self.tasks.get(oid.task_id())
+                name = (
+                    rec_t.spec.name if rec_t is not None else None
+                ) or "<unknown>"
+            nk = (name, path)
+            if nk in self._xfer_bytes_by_name or len(self._xfer_bytes_by_name) < 1024:
+                self._xfer_bytes_by_name[nk] = (
+                    self._xfer_bytes_by_name.get(nk, 0) + nbytes
+                )
+        trace = meta.get("trace")
+        rec = {
+            "object_id": oid.hex(),
+            "src": src_l,
+            "dst": dst_l,
+            "path": path,
+            "hop": hop,
+            "bytes": nbytes,
+            "chunks": stats.get("chunks"),
+            "ok": bool(ok),
+            "gib_per_s": round(gibps, 4) if gibps is not None else None,
+            "stages_ms": {
+                k: round(float(stats[k]), 3)
+                for k in self._NET_STAGE_KEYS
+                if stats.get(k) is not None
+            },
+            "total_ms": round(float(stats["total_ms"]), 3)
+            if stats.get("total_ms") is not None
+            else None,
+            "t0": stats.get("t0") or meta.get("t0"),
+            "job": oid.binary()[20:24].hex(),
+            "trace_id": trace[0] if trace else None,
+            "error": stats.get("error"),
+        }
+        self._net_recent.append(rec)
+        if trace:
+            self._emit_wire_span(rec, trace)
+
+    def _emit_wire_span(self, rec: dict, trace) -> None:
+        """Join a completed transfer to the requesting task's trace tree as
+        a ``wire:<path>`` child span (the transfer ran in another process;
+        the requester ctx rode the ensure_local rpc)."""
+        total_ms = rec.get("total_ms") or rec["stages_ms"].get("wire_ms")
+        if not total_ms:
+            return
+        t0 = rec.get("t0") or (time.time() - total_ms / 1e3)
+        extra = {
+            "trace_id": trace[0],
+            "span_id": os.urandom(8).hex(),
+            "parent_id": trace[1],
+            "link": f"{rec['src']}->{rec['dst']}",
+            "path": rec["path"],
+            "bytes": rec["bytes"],
+            "object_id": rec["object_id"],
+        }
+        if rec.get("gib_per_s") is not None:
+            extra["gib_per_s"] = rec["gib_per_s"]
+        if rec.get("hop"):
+            extra["hop"] = rec["hop"]
+        self._append_profile_span(
+            {
+                "event": f"wire:{rec['path']}",
+                "start": t0,
+                "end": t0 + total_ms / 1e3,
+                "duration_ms": total_ms,
+                "extra": extra,
+            }
+        )
+
+    def _ingest_transfer_record(self, rec, holder=None, dst_node=None) -> None:
+        """One read record off the telemetry ring (worker zero-copy peer
+        reads, driver/worker spill restores) or a daemon heartbeat
+        (daemon-side spill restores, which have no telemetry pipe).
+        Compact positional tuple — see ``netplane.record_read``."""
+        try:
+            path, oid_bin, nbytes, wire_s, t0, src_shm_dir, trace_id = rec
+        except (TypeError, ValueError):
+            return
+        if dst_node is not None:
+            dst = dst_node
+        elif holder is not None:
+            w = self.workers.get(holder)
+            dst = (
+                self._loc_node(w.node_id)
+                if w is not None
+                else self._node.head_node_id
+            )
+        else:
+            dst = self._node.head_node_id
+        dst_l = self._node_label(dst)
+        src_l = "disk" if path == "spill" else "<peer>"
+        if src_shm_dir:
+            for nid, n in self.nodes.items():
+                if n.shm_dir == src_shm_dir:
+                    src_l = self._node_label(nid)
+                    break
+        nbytes = int(nbytes or 0)
+        wire_s = float(wire_s or 0.0)
+        row = self._link_row(src_l, dst_l, str(path))
+        row["transfers"] += 1
+        row["bytes"] += nbytes
+        row["last_t"] = time.time()
+        # rate only for spill restores (a real disk read): a zero-copy
+        # peer MAPPING moves no bytes, so its duration is not a wire
+        gibps = (
+            self._fold_link_throughput(row, str(path), nbytes, wire_s)
+            if path == "spill"
+            else None
+        )
+        try:
+            job = oid_bin[20:24].hex()
+            oid_hex = oid_bin.hex()
+        except Exception:
+            job, oid_hex = "unknown", "?"
+        self._net_recent.append(
+            {
+                "object_id": oid_hex,
+                "src": src_l,
+                "dst": dst_l,
+                "path": str(path),
+                "hop": 0,
+                "bytes": nbytes,
+                "chunks": None,
+                "ok": True,
+                "gib_per_s": round(gibps, 4) if gibps is not None else None,
+                "stages_ms": {"wire_ms": round(wire_s * 1e3, 3)},
+                "total_ms": round(wire_s * 1e3, 3),
+                "t0": t0,
+                "job": job,
+                "trace_id": trace_id,
+                "error": None,
+            }
+        )
+
+    def _maybe_net_scan(self) -> None:
+        if not getattr(self.config, "transfer_plane_enabled", True) or not (
+            getattr(self.config, "telemetry_enabled", True)
+        ):
+            return
+        now = time.monotonic()
+        if now - self._last_netscan < 1.0:
+            return
+        self._last_netscan = now
+        self._net_watchdog_scan()
+
+    def _net_watchdog_scan(self) -> None:
+        """1 Hz transfer watchdog: (1) in-flight transfers whose received-
+        byte watermark stopped moving for ``transfer_stall_warn_s`` get an
+        ``OBJECT_TRANSFER_STALLED`` event (progress watermarks ride daemon
+        heartbeats; the head's own fetches are read from the local
+        registry); (2) socket/relay links whose throughput EWMA sits below
+        ``slow_link_fraction`` x the fleet median get a ``SLOW_LINK`` event
+        with exemplar oids and trace ids."""
+        from ray_tpu._private import netplane
+
+        now_m = time.monotonic()
+        warn_s = float(
+            getattr(self.config, "transfer_stall_warn_s", 10.0) or 10.0
+        )
+        head_inflight = netplane.inflight_snapshot()
+        for key, meta in list(self._fetch_meta.items()):
+            entry = self._fetching.get(key)
+            if entry is None:
+                self._fetch_meta.pop(key, None)
+                continue
+            if not entry[1]:
+                # uncharged same-host shm fetch: one local memcpy/disk read
+                # with no progress watermark (fetch_from_same_host) and a
+                # bounded failure mode (a miss re-admits via sockets) — a
+                # long-but-progressing copy must not read as stalled
+                continue
+            oid, dest = key
+            if dest == self._node.head_node_id:
+                prog = head_inflight.get(oid.hex())
+            else:
+                node = self.nodes.get(dest)
+                prog = (
+                    ((node.stats or {}).get("transfers") or {}).get(oid.hex())
+                    if node is not None
+                    else None
+                )
+            cur = int(prog["bytes"]) if prog else 0
+            if cur != meta["seen_bytes"]:
+                # bytes moved since the last scan: not stalled. Clocks are
+                # process-local, so progress is judged by BYTES only.
+                meta["seen_bytes"] = cur
+                meta["seen_t"] = now_m
+                continue
+            stalled_for = now_m - meta["seen_t"]
+            if stalled_for < warn_s:
+                continue
+            last = self._net_stall_last_event.get(key, 0.0)
+            if now_m - last < 30.0:
+                continue
+            self._net_stall_last_event[key] = now_m
+            self._xfer_stalled_total += 1
+            src_l = self._node_label(entry[0])
+            dst_l = self._node_label(dest)
+            path = "relay" if meta.get("hop") else "socket"
+            self._link_row(src_l, dst_l, path)["stalls"] += 1
+            trace = meta.get("trace")
+            total = prog.get("total") if prog else None
+            self.record_cluster_event(
+                "OBJECT_TRANSFER_STALLED",
+                f"transfer of {oid.hex()[:16]} over {src_l}->{dst_l} "
+                f"({path}) made no progress for {stalled_for:.1f}s "
+                f"({cur}/{total if total is not None else '?'} bytes)",
+                severity="WARNING",
+                object_id=oid.hex(),
+                link=f"{src_l}->{dst_l}",
+                path=path,
+                bytes_received=cur,
+                total_bytes=total,
+                stalled_s=round(stalled_for, 1),
+                trace_id=trace[0] if trace else None,
+            )
+        for k in [
+            k
+            for k, t in self._net_stall_last_event.items()
+            if k not in self._fetching and now_m - t > 300.0
+        ]:
+            del self._net_stall_last_event[k]
+        # slow links: EWMA vs fleet median over socket/relay links with
+        # enough samples. Needs >= 2 comparable links — a single link has
+        # no fleet to be slower than (calm clusters stay silent).
+        frac = float(getattr(self.config, "slow_link_fraction", 0.3) or 0.3)
+        candidates = [
+            (key, row)
+            for key, row in self._net_links.items()
+            if row["path"] in ("socket", "relay")
+            and row["samples"] >= 3
+            and row["ewma_gib_per_s"]
+        ]
+        if len(candidates) < 2:
+            return
+        import statistics
+
+        med = statistics.median(r["ewma_gib_per_s"] for _, r in candidates)
+        for key, row in candidates:
+            slow = med > 0 and row["ewma_gib_per_s"] < frac * med
+            row["slow"] = slow
+            if not slow:
+                continue
+            last = self._slow_link_last_event.get(key, 0.0)
+            if now_m - last < 60.0:
+                continue
+            self._slow_link_last_event[key] = now_m
+            self._slow_link_events += 1
+            exemplars = [
+                r
+                for r in reversed(self._net_recent)
+                if r["src"] == row["src"] and r["dst"] == row["dst"]
+            ][:3]
+            self.record_cluster_event(
+                "SLOW_LINK",
+                f"link {row['src']}->{row['dst']} ({row['path']}) EWMA "
+                f"{row['ewma_gib_per_s']:.4f} GiB/s sits below "
+                f"{frac:g}x the fleet median {med:.4f} GiB/s",
+                severity="WARNING",
+                link=f"{row['src']}->{row['dst']}",
+                path=row["path"],
+                gib_per_s=round(row["ewma_gib_per_s"], 4),
+                fleet_median_gib_per_s=round(med, 4),
+                exemplar_object_ids=[r["object_id"] for r in exemplars],
+                exemplar_trace_ids=[
+                    r["trace_id"] for r in exemplars if r.get("trace_id")
+                ],
+            )
+
+    def _net_link_rows(self, limit: int = 10_000) -> List[dict]:
+        # live in-flight counts joined once (O(links + inflight), not a
+        # _fetching scan per row — this serves the dashboard's 2s poll),
+        # keyed per PATH so a socket row doesn't also claim relay work
+        inflight: Dict[Tuple[str, str, str], int] = {}
+        for key, (s, charged) in self._fetching.items():
+            meta = self._fetch_meta.get(key) or {}
+            path = (
+                "relay"
+                if (charged and meta.get("hop"))
+                else ("socket" if charged else "shm_peer")
+            )
+            k = (self._node_label(s), self._node_label(key[1]), path)
+            inflight[k] = inflight.get(k, 0) + 1
+        rows = sorted(self._net_links.values(), key=lambda r: -r["bytes"])
+        out = []
+        for r in rows[: int(limit)]:
+            d = dict(r)
+            if d["ewma_gib_per_s"] is not None:
+                d["ewma_gib_per_s"] = round(d["ewma_gib_per_s"], 4)
+            d["inflight"] = inflight.get((r["src"], r["dst"], r["path"]), 0)
+            out.append(d)
+        return out
+
+    def _net_summarize(self, group_by: str, limit: int = 50) -> dict:
+        """Server-side transfer grouping: by link (src->dst with per-path
+        split), path (fleet totals + stage seconds), job (the per-owning-
+        job ledger), or task (producing task name — per-operator bytes for
+        ray_tpu.data)."""
+        header = {
+            "group_by": group_by,
+            "inflight": len(self._fetching),
+            "retries": self._xfer_retries_total,
+            "stalled": self._xfer_stalled_total,
+            "leaked_buffers": self._xfer_leaked[0],
+            "leaked_bytes": self._xfer_leaked[1],
+            "slow_link_events": self._slow_link_events,
+            "stage_seconds": {
+                k: round(v, 4) for k, v in self._net_stage_seconds.items()
+            },
+        }
+        groups: Dict[str, dict] = {}
+        if group_by == "link":
+            for r in self._net_links.values():
+                g = groups.setdefault(
+                    f"{r['src']}->{r['dst']}",
+                    {"bytes": 0, "transfers": 0, "failures": 0, "stalls": 0,
+                     "paths": {}, "slow": False, "max_hop": 0},
+                )
+                g["bytes"] += r["bytes"]
+                g["transfers"] += r["transfers"]
+                g["failures"] += r["failures"]
+                g["stalls"] += r["stalls"]
+                g["paths"][r["path"]] = g["paths"].get(r["path"], 0) + r["bytes"]
+                g["slow"] = g["slow"] or r["slow"]
+                g["max_hop"] = max(g["max_hop"], r["max_hop"])
+                if r["ewma_gib_per_s"] is not None:
+                    # pessimistic across the link's paths: the SLOWEST
+                    # rate is the one worth surfacing (a fast spill row
+                    # must not mask a slow socket)
+                    cur = g.get("gib_per_s")
+                    rate = round(r["ewma_gib_per_s"], 4)
+                    g["gib_per_s"] = rate if cur is None else min(cur, rate)
+        elif group_by == "path":
+            for r in self._net_links.values():
+                g = groups.setdefault(
+                    r["path"],
+                    {"bytes": 0, "transfers": 0, "failures": 0, "stalls": 0},
+                )
+                g["bytes"] += r["bytes"]
+                g["transfers"] += r["transfers"]
+                g["failures"] += r["failures"]
+                g["stalls"] += r["stalls"]
+            for p, v in self._net_path_ewma.items():
+                groups.setdefault(
+                    p, {"bytes": 0, "transfers": 0, "failures": 0, "stalls": 0}
+                )["gib_per_s"] = round(v, 4)
+        elif group_by == "job":
+            for (job, path), nbytes in self._xfer_bytes_by_job.items():
+                g = groups.setdefault(job, {"bytes": 0, "paths": {}})
+                g["bytes"] += nbytes
+                # the pre-existing per-job ledger says "shm"; this API's
+                # path vocabulary says "shm_peer" — translate for display
+                # so filters join across groupings
+                if path == "shm":
+                    path = "shm_peer"
+                g["paths"][path] = g["paths"].get(path, 0) + nbytes
+        elif group_by == "task":
+            for (name, path), nbytes in self._xfer_bytes_by_name.items():
+                g = groups.setdefault(name, {"bytes": 0, "paths": {}})
+                g["bytes"] += nbytes
+                g["paths"][path] = g["paths"].get(path, 0) + nbytes
+        else:
+            raise ValueError(
+                f"summarize_transfers: unknown group_by {group_by!r} "
+                "(want link | path | job | task)"
+            )
+        rows = [
+            {"group": k, **v}
+            for k, v in sorted(
+                groups.items(), key=lambda kv: -kv[1]["bytes"]
+            )
+        ]
+        header["truncated"] = len(rows) > int(limit)
+        header["rows"] = rows[: int(limit)]
+        return header
 
     # ---- command handling ------------------------------------------------
 
@@ -1614,8 +2243,10 @@ class Scheduler:
             self._lease_budget_sent.pop(ns.node_id, None)
             self._retry_pending_pgs()
         elif kind == "fetch_done":
-            _, oid, nid, ok = cmd
-            self._xfer_complete(oid, nid, ok)
+            _, oid, nid, ok = cmd[:4]
+            self._xfer_complete(
+                oid, nid, ok, stats=cmd[4] if len(cmd) > 4 else None
+            )
         elif kind == "kill_actor":
             _, actor_id, no_restart = cmd
             self._kill_actor(actor_id, no_restart)
@@ -2573,6 +3204,11 @@ class Scheduler:
             self._maybe_memory_scan()
         except Exception:
             logger.exception("memory watchdog scan failed")
+        # transfer plane: 1 Hz slow-link / stalled-transfer watchdog
+        try:
+            self._maybe_net_scan()
+        except Exception:
+            logger.exception("net watchdog scan failed")
         # multi-tenant job plane: drain the admission queue while backlog
         # allows, then scan for starved high-priority work to preempt for
         # (both rate-limit themselves; see DESIGN_MAP "Multi-tenant job
@@ -4272,6 +4908,12 @@ class Scheduler:
         # complete (free their source slots); it can't be a waiter either
         for key in [k for k in self._fetching if k[1] == node_id]:
             src, charged = self._fetching.pop(key)
+            self._fetch_meta.pop(key, None)
+            left = self._xfer_inflight_by_oid.get(key[0], 1) - 1
+            if left <= 0:
+                self._xfer_inflight_by_oid.pop(key[0], None)
+            else:
+                self._xfer_inflight_by_oid[key[0]] = left
             if charged:
                 self._xfer_load[src] = max(0, self._xfer_load[src] - 1)
         self._xfer_load.pop(node_id, None)
@@ -4686,14 +5328,34 @@ class Scheduler:
             return self._apply_limit(rows, args)
         if op == "ensure_local":
             # start a transfer of oid toward node (default: head) and return
-            # whether a local copy already exists there
+            # whether a local copy already exists there; an optional third
+            # arg carries the requester's (trace_id, span_id)
             oid = args[0]
-            dest = args[1] if len(args) > 1 else self._node.head_node_id
+            dest = (
+                args[1]
+                if len(args) > 1 and args[1] is not None
+                else self._node.head_node_id
+            )
+            if len(args) > 2 and args[2]:
+                self._note_xfer_requester(oid, args[2], dest=dest)
             locs = self._object_locations.get(oid, set())
             if dest in locs:
                 return True
             self._ensure_local(oid, dest)
             return False
+        if op == "list_links":
+            # transfer plane: the per-(src, dst, path) link ledger
+            return self._net_link_rows(
+                args[0] if args and isinstance(args[0], int) else 10_000
+            )
+        if op == "list_transfers":
+            # recent completed transfers (stage decompositions), newest first
+            limit = args[0] if args and isinstance(args[0], int) else 100
+            return list(self._net_recent)[-int(limit):][::-1]
+        if op == "summarize_transfers":
+            group_by = args[0] if args else "link"
+            limit = args[1] if len(args) > 1 and args[1] else 50
+            return self._net_summarize(group_by, limit)
         if op == "object_locations":
             return [n.hex() for n in self._object_locations.get(args[0], set())]
         if op == "same_host_dirs":
@@ -5717,6 +6379,11 @@ class Scheduler:
                 self._train_index.ingest(srec)
             except Exception:
                 logger.exception("train step record ingest failed")
+        for trec in batch.get("transfers") or ():
+            try:
+                self._ingest_transfer_record(trec, holder=holder)
+            except Exception:
+                logger.exception("transfer read record ingest failed")
         for name, (kind, description, data) in (batch.get("metrics") or {}).items():
             try:
                 self._merge_metric(name, kind, description, data, proc)
@@ -6369,6 +7036,105 @@ class Scheduler:
                 lk(outcome="hit"): self._locality_hits,
                 lk(outcome="miss"): self._locality_misses,
             },
+        )
+        # transfer plane (netplane): link ledger + watchdog series
+        add(
+            "ray_tpu_transfer_path_gib_per_s",
+            "gauge",
+            "fleet throughput EWMA per transfer path "
+            "(socket | shm_peer | spill | relay)",
+            {lk(path=p): round(v, 4) for p, v in self._net_path_ewma.items()}
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_transfers_inflight",
+            "gauge",
+            "inter-node transfers currently in flight (the scheduler's "
+            "fetch table)",
+            {lk(): len(self._fetching)},
+        )
+        add(
+            "ray_tpu_transfer_stage_seconds_total",
+            "counter",
+            "cumulative seconds per transfer stage "
+            "(dial | request | first_byte_wait | wire | seal)",
+            {
+                lk(stage=s): round(v, 4)
+                for s, v in sorted(self._net_stage_seconds.items())
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_transfer_retries_total",
+            "counter",
+            "failed transfers re-sourced by the scheduler (dead relays, "
+            "shm misses re-admitted through the socket plane)",
+            {lk(): self._xfer_retries_total},
+        )
+        add(
+            "ray_tpu_transfer_stalled_total",
+            "counter",
+            "OBJECT_TRANSFER_STALLED flags: in-flight transfers whose "
+            "received-byte watermark stopped moving past "
+            "transfer_stall_warn_s",
+            {lk(): self._xfer_stalled_total},
+        )
+        add(
+            "ray_tpu_transfer_leaked_buffers_total",
+            "counter",
+            "receive buffers deliberately leaked because relay serves did "
+            "not drain within transfer_drain_timeout_s",
+            {lk(): self._xfer_leaked[0]},
+        )
+        add(
+            "ray_tpu_transfer_leaked_bytes_total",
+            "counter",
+            "bytes held by deliberately-leaked receive buffers "
+            "(recycled-arena protection, now visible instead of silent)",
+            {lk(): self._xfer_leaked[1]},
+        )
+        add(
+            "ray_tpu_slow_link_events_total",
+            "counter",
+            "SLOW_LINK flags: links whose throughput EWMA sat below "
+            "slow_link_fraction x the fleet median",
+            {lk(): self._slow_link_events},
+        )
+        add(
+            "ray_tpu_link_bytes_total",
+            "counter",
+            "cumulative transferred bytes per (src, dst, path) link "
+            "(bounded: beyond net_links_max new links fold into <other>)",
+            {
+                lk(src=r["src"], dst=r["dst"], path=r["path"]): r["bytes"]
+                for r in self._net_links.values()
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_link_throughput_gib_per_s",
+            "gauge",
+            "per-link throughput EWMA (socket-plane links with enough "
+            "samples; the slow-link watchdog's input)",
+            {
+                lk(src=r["src"], dst=r["dst"], path=r["path"]): round(
+                    r["ewma_gib_per_s"], 4
+                )
+                for r in self._net_links.values()
+                if r["ewma_gib_per_s"] is not None
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_transfer_relay_hops_total",
+            "counter",
+            "completed transfers by relay hop depth (hop 0 = pulled from a "
+            "sealed origin copy; hop k = pipelined off a hop k-1 receiver)",
+            {
+                lk(hop=str(h)): n
+                for h, n in sorted(self._net_hop_counts.items())
+            }
+            or {lk(): 0},
         )
         by_state: Dict[str, int] = {}
         for t in self.tasks.values():

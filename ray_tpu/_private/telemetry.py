@@ -89,6 +89,10 @@ class TelemetryBuffer:
         # train.report boundary — see _private/stepplane.py); merged into
         # the scheduler's bounded per-run StepIndex on flush
         self._train_steps: collections.deque = collections.deque()
+        # transfer-plane read records (peer-arena reads / spill restores —
+        # paths with no completion message to ride; see
+        # _private/netplane.py); merged into the scheduler's link ledger
+        self._transfers: collections.deque = collections.deque()
         # name -> (kind, description, data snapshot): last writer wins, so
         # N records within one interval flush as ONE write per metric
         self._metrics: Dict[str, Tuple[str, str, dict]] = {}
@@ -168,6 +172,16 @@ class TelemetryBuffer:
                 return
             self._train_steps.append(rec)
 
+    def record_transfer(self, rec) -> None:
+        """One (path, oid_bin, bytes, wire_s, t0, src_shm_dir, trace_id)
+        read record (transfer plane; size-floored by the caller)."""
+        with self._lock:
+            if len(self._transfers) >= self._capacity():
+                self._dropped_pending += 1
+                self._dropped_total += 1
+                return
+            self._transfers.append(rec)
+
     def record_metric(self, name: str, kind: str, description: str, data: dict) -> None:
         with self._lock:
             self._metrics[name] = (kind, description, data)
@@ -206,6 +220,7 @@ class TelemetryBuffer:
                 or self._cluster_events
                 or self._objects
                 or self._train_steps
+                or self._transfers
                 or self._metrics
                 or self._samples
                 or self._dropped_pending
@@ -223,6 +238,10 @@ class TelemetryBuffer:
                 list(self._train_steps),
                 collections.deque(),
             )
+            transfers, self._transfers = (
+                list(self._transfers),
+                collections.deque(),
+            )
             metrics, self._metrics = dict(self._metrics), {}
             samples, self._samples = (
                 [(k, v) for k, v in self._samples.items()],
@@ -237,6 +256,7 @@ class TelemetryBuffer:
             "cluster_events": cluster_events,
             "objects": objects,
             "train_steps": train_steps,
+            "transfers": transfers,
             "metrics": metrics,
             "samples": samples,
             "dropped": dropped,
@@ -260,6 +280,7 @@ class TelemetryBuffer:
             + len(batch["cluster_events"])
             + len(batch.get("objects") or ())
             + len(batch.get("train_steps") or ())
+            + len(batch.get("transfers") or ())
             # per-SAMPLE, not per-stack-key (matches record_samples and the
             # scheduler-side accounting)
             + sum(n for _k, n in batch.get("samples") or ())

@@ -254,6 +254,14 @@ class Trace:
                 extra += f"  {key.replace('_ms', '')}={span.extra[key]:g}ms"
         if span.extra.get("status") is not None:
             extra += f"  status={span.extra['status']}"
+        # transfer plane: wire spans name the link they crossed and the
+        # measured rate (which link a slow arg_fetch paid for)
+        if span.extra.get("link"):
+            extra += f"  link={span.extra['link']}"
+        if span.extra.get("gib_per_s") is not None:
+            extra += f"  {span.extra['gib_per_s']:g}GiB/s"
+        if span.extra.get("hop"):
+            extra += f"  hop={span.extra['hop']}"
         if span.stages.get("arg_bytes"):
             paths = span.stages.get("arg_paths") or {}
             path_str = ",".join(f"{p}:{n}" for p, n in sorted(paths.items()))

@@ -492,8 +492,14 @@ class DriverRuntime:
             # poll while periodically asking the scheduler to transfer — or
             # lineage-reconstruct — it into the head store. The wait honors
             # the caller's get() timeout (capped at 60s).
+            from ray_tpu._private import netplane
+
             budget = 60.0 if timeout is None else min(float(timeout), 60.0)
             deadline = time.monotonic() + budget
+            path = "shm"
+            peer_dir = ""
+            peer_dur = 0.0  # the peer READ alone, polls excluded
+            t_wall0, t_perf0 = time.time(), time.perf_counter()
             mv = self.store.get(oid, timeout=0.05)
             if mv is None and self._direct is not None:
                 # a direct actor-call return stored on the executing worker's
@@ -502,19 +508,48 @@ class DriverRuntime:
                 if d:
                     from ray_tpu._private.object_transfer import read_peer_pinned
 
+                    t_peer = time.perf_counter()
                     mv = read_peer_pinned(d, oid)
+                    if mv is not None:
+                        path, peer_dir = "shm_peer", d
+                        peer_dur = time.perf_counter() - t_peer
             if mv is None:
+                t_peer = time.perf_counter()
                 mv = self._read_same_host_peer(oid)
+                if mv is not None:
+                    path = "shm_peer"
+                    peer_dur = time.perf_counter() - t_peer
+            xfer_ctx = None
             while mv is None:
                 if time.monotonic() >= deadline:
                     return exc.ObjectLostError(f"object {oid.hex()} lost from store"), True
                 try:
-                    self.rpc("ensure_local", oid)
+                    if xfer_ctx is None and netplane.enabled():
+                        from ray_tpu.util import tracing
+
+                        ctx = tracing.get_current_context()
+                        xfer_ctx = (
+                            (ctx.trace_id, ctx.span_id) if ctx else False
+                        )
+                    if xfer_ctx:
+                        # None dest = head (this driver's node); the ctx
+                        # lets the wire span join this request's trace
+                        self.rpc("ensure_local", oid, None, xfer_ctx)
+                    else:
+                        self.rpc("ensure_local", oid)
                 except Exception:
                     pass
+                path = "transfer"
                 mv = self.store.get(oid, timeout=2.0)
                 if mv is None:
+                    t_peer = time.perf_counter()
                     mv = self._read_same_host_peer(oid)
+                    if mv is not None:
+                        path = "shm_peer"
+                        peer_dur = time.perf_counter() - t_peer
+            netplane.finish_blocked_read(
+                path, mv.nbytes, t_wall0, t_perf0, peer_dur, peer_dir, oid
+            )
             return self.serde.deserialize_from(mv), False
         if kind == "error":
             err = pickle.loads(entry[1])

@@ -500,27 +500,49 @@ class WorkerRuntime:
             # try a zero-copy read out of a colocated peer node's store
             # first, then poll the local store while periodically asking the
             # scheduler to transfer — or lineage-reconstruct — it
+            from ray_tpu._private import netplane
+
             deadline = time.monotonic() + (timeout if timeout is not None else 60.0)
             path = "shm"
+            peer_dir = ""
+            peer_dur = 0.0  # the peer READ alone, polls excluded
+            t_wall0, t_perf0 = time.time(), time.perf_counter()
             mv = self.store.get(oid, timeout=0.05)
             if mv is None and len(entry) > 1:
                 # zero-copy dirs rode the pull reply: map the peer store now
                 from ray_tpu._private.object_transfer import read_peer_pinned
 
+                t_peer = time.perf_counter()
                 for d in entry[1]:
                     mv = read_peer_pinned(d, oid)
                     if mv is not None:
-                        path = "shm_peer"
+                        path, peer_dir = "shm_peer", d
                         break
+                peer_dur = time.perf_counter() - t_peer
             if mv is None:
+                t_peer = time.perf_counter()
                 mv = self._read_same_host_peer(oid)
                 if mv is not None:
                     path = "shm_peer"
+                    peer_dur = time.perf_counter() - t_peer
+            # trace context travels with the transfer request so the
+            # scheduler can hang the wire span under this task's arg_fetch
+            xfer_ctx = None
             while mv is None:
                 if time.monotonic() >= deadline or self._stopped.is_set():
                     return exc.ObjectLostError(f"object {oid.hex()} not in store"), True
                 try:
-                    self.rpc("ensure_local", oid)
+                    if xfer_ctx is None and netplane.enabled():
+                        from ray_tpu.util import tracing
+
+                        ctx = tracing.get_current_context()
+                        xfer_ctx = (
+                            (ctx.trace_id, ctx.span_id) if ctx else False
+                        )
+                    if xfer_ctx:
+                        self.rpc("ensure_local_traced", oid, xfer_ctx)
+                    else:
+                        self.rpc("ensure_local", oid)
                 except Exception:
                     pass
                 # landed via the scheduler's transfer plane: a socket copy
@@ -528,8 +550,15 @@ class WorkerRuntime:
                 path = "transfer"
                 mv = self.store.get(oid, timeout=2.0)
                 if mv is None:
+                    t_peer = time.perf_counter()
                     mv = self._read_same_host_peer(oid)
+                    if mv is not None:
+                        path = "shm_peer"
+                        peer_dur = time.perf_counter() - t_peer
             self._acct_fetch(path, mv.nbytes)
+            netplane.finish_blocked_read(
+                path, mv.nbytes, t_wall0, t_perf0, peer_dur, peer_dir, oid
+            )
             return self.serde.deserialize_from(mv), False
         return exc.RayTpuError(f"bad entry {kind}"), True
 

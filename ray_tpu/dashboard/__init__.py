@@ -163,6 +163,25 @@ def start_dashboard(port: int = 8765) -> int:
                         ) or {}
                     else:
                         body = get_driver().rpc("list_train_runs")
+                elif urlparse(self.path).path == "/api/net":
+                    # transfer plane: link ledger + recent transfer stage
+                    # records + fleet summary (network tab). Local flush
+                    # only — 2s UI polling (the /api/trace rule); worker
+                    # read records lag at most one telemetry interval
+                    from ray_tpu._private import telemetry as _tele
+                    from ray_tpu._private.worker import get_driver
+
+                    _tele.flush()
+                    q = parse_qs(urlparse(self.path).query)
+                    drv = get_driver()
+                    body = {
+                        "links": drv.rpc("list_links", 200),
+                        "transfers": drv.rpc(
+                            "list_transfers",
+                            int(q.get("limit", ["50"])[0]),
+                        ),
+                        "summary": drv.rpc("summarize_transfers", "path", 20),
+                    }
                 elif self.path == "/api/job_latency":
                     # per-job sliding-window p50/p95/p99 + exemplar traces
                     from ray_tpu._private.worker import get_driver

@@ -56,8 +56,9 @@ PAGE = """<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
-              "memory","placement_groups","serve","jobs","train","logs",
-              "events","event_stats","traces","latency","stacks","profile"];
+              "memory","network","placement_groups","serve","jobs","train",
+              "logs","events","event_stats","traces","latency","stacks",
+              "profile"];
 // hash may carry a selection suffix, e.g. "#traces:<trace_id>"
 let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
 window.addEventListener("hashchange", () => {
@@ -150,6 +151,58 @@ const RENDER = {
         table(leaks, ["callsite","live","mb","growth_mb","window_s"]) : "") +
       `<h2>by creation callsite</h2>` +
       table(rows, ["callsite","count","mb","leak","classes","jobs","exemplars"]);
+  },
+  async network() {
+    // transfer plane: per-link ledger matrix, relay topology (recent
+    // transfers grouped by object, hop-indented), fleet path summary
+    const s = await j("/api/net");
+    const mb = (n)=> ((n||0)/1e6).toFixed(1);
+    const sum = s.summary || {};
+    const head = `<p>${sum.inflight||0} in flight · ` +
+      `${sum.retries||0} retries · ${sum.stalled||0} stalls · ` +
+      `${sum.leaked_buffers||0} leaked buffers (${mb(sum.leaked_bytes)} MB) · ` +
+      `${sum.slow_link_events||0} slow-link events</p>`;
+    const paths = table((sum.rows||[]).map(r => ({
+      path: r.group, mb: mb(r.bytes), transfers: r.transfers,
+      "GiB/s": r.gib_per_s == null ? "" : r.gib_per_s,
+      failures: r.failures, stalls: r.stalls,
+    })));
+    const links = table((s.links||[]).map(r => ({
+      state: r.slow ? "SLOW" : "ok", src: r.src, dst: r.dst, path: r.path,
+      mb: mb(r.bytes), xfers: r.transfers, fail: r.failures,
+      stall: r.stalls, infl: r.inflight,
+      "GiB/s": r.ewma_gib_per_s == null ? "" : r.ewma_gib_per_s,
+      hop: r.max_hop,
+    })), ["state","src","dst","path","mb","xfers","fail","stall","infl",
+          "GiB/s","hop"]);
+    // relay topology: recent transfers of one object rendered as a tree
+    // of hops (hop 0 = pull off the sealed origin)
+    const byObj = {};
+    (s.transfers||[]).forEach(t => {
+      (byObj[t.object_id] = byObj[t.object_id] || []).push(t);
+    });
+    const relays = Object.entries(byObj)
+      .filter(([,ts]) => ts.length > 1 || ts.some(t => t.hop > 0))
+      .slice(0, 8).map(([oid, ts]) =>
+        `<h2>object ${esc(oid.slice(0,16))} — relay tree</h2>` +
+        ts.sort((a,b)=>(a.hop-b.hop)).map(t =>
+          `<div style="margin-left:${(t.hop||0)*18}px">` +
+          `hop ${t.hop||0}: ${esc(t.src)} → ${esc(t.dst)} ` +
+          `<span class="meta">${t.path} ${mb(t.bytes)} MB` +
+          `${t.gib_per_s != null ? " @ " + t.gib_per_s + " GiB/s" : ""}` +
+          `${t.ok ? "" : " FAILED"}</span></div>`).join("")
+      ).join("");
+    const recent = table((s.transfers||[]).slice(0, 30).map(t => ({
+      state: t.ok ? "ok" : "FAILED", object: t.object_id.slice(0,14),
+      link: `${t.src}→${t.dst}`, path: t.path, hop: t.hop,
+      mb: mb(t.bytes), "GiB/s": t.gib_per_s == null ? "" : t.gib_per_s,
+      stages: Object.entries(t.stages_ms||{})
+        .map(([k,v])=>`${k.replace("_ms","")}=${v}`).join(" "),
+      trace: t.trace_id || "",
+    })), ["state","object","link","path","hop","mb","GiB/s","stages","trace"]);
+    return head + "<h2>by path</h2>" + paths +
+      "<h2>link matrix</h2>" + links + relays +
+      "<h2>recent transfers</h2>" + recent;
   },
   async placement_groups() { return table(await j("/api/placement_groups")); },
   async serve() {

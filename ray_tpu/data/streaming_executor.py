@@ -56,9 +56,12 @@ class ReadTask:
     supports_columns: bool = False
 
     def submit(self):
+        # name-tagged so the transfer plane's by-task-name ledger rows give
+        # per-operator cross-node bytes (summarize_transfers group_by=task)
+        fn = self.fn.options(name="data:source")
         if self.columns is not None:
-            return self.fn.remote(*self.args, columns=self.columns)
-        return self.fn.remote(*self.args)
+            return fn.remote(*self.args, columns=self.columns)
+        return fn.remote(*self.args)
 
 
 def _window() -> int:
@@ -176,10 +179,14 @@ class TaskMapStage:
     def stream(self, upstream: Iterator, collector: Optional[List] = None) -> Iterator:
         from ray_tpu.data.dataset import _exec_block
 
+        # name-tagged per stage: the link ledger attributes cross-node
+        # bytes pulled by these block tasks to `data:map[...]` rows
+        stage_name = f"map[{len(self.ops)} ops]"
+        fn = _exec_block.options(name=f"data:{stage_name}")
         return _windowed(
-            (_exec_block.remote(ref, self.ops) for ref in upstream),
+            (fn.remote(ref, self.ops) for ref in upstream),
             _window(),
-            name=f"map[{len(self.ops)} ops]",
+            name=stage_name,
             collector=collector,
         )
 

@@ -145,6 +145,66 @@ class ObjectLostError(RayTpuError):
     """Object was evicted/lost and could not be reconstructed."""
 
 
+class ObjectTransferStalledError(RayTpuError):
+    """An in-flight inter-node transfer made no chunk progress for the
+    configured window (``transfer_coverage_timeout_s``). Carries the link
+    and coverage provenance so a relay stall names its transfer instead of
+    surfacing as a generic fetch failure (transfer-plane observability)."""
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        object_id: str | None = None,
+        link: str | None = None,
+        covered_bytes: int | None = None,
+        total_bytes: int | None = None,
+        waited_s: float | None = None,
+    ):
+        self.object_id = object_id
+        self.link = link
+        self.covered_bytes = covered_bytes
+        self.total_bytes = total_bytes
+        self.waited_s = waited_s
+        parts = [
+            f"{k}={v}"
+            for k, v in (
+                ("object", object_id),
+                ("link", link),
+                ("covered", covered_bytes),
+                ("total", total_bytes),
+                ("waited_s", None if waited_s is None else round(waited_s, 3)),
+            )
+            if v is not None
+        ]
+        where = f" ({', '.join(parts)})" if parts else ""
+        super().__init__((message or "object transfer stalled") + where)
+
+    def __reduce__(self):
+        return (
+            _rebuild_transfer_stalled,
+            (
+                self.args[0] if self.args else "",
+                self.object_id,
+                self.link,
+                self.covered_bytes,
+                self.total_bytes,
+                self.waited_s,
+            ),
+        )
+
+
+def _rebuild_transfer_stalled(msg, object_id, link, covered, total, waited):
+    err = ObjectTransferStalledError.__new__(ObjectTransferStalledError)
+    RayTpuError.__init__(err, msg)
+    err.object_id = object_id
+    err.link = link
+    err.covered_bytes = covered
+    err.total_bytes = total
+    err.waited_s = waited
+    return err
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """``get()`` exceeded its timeout."""
 

@@ -493,6 +493,112 @@ def cmd_train(args):
     raise SystemExit(f"unknown train subcommand {sub!r}")
 
 
+def cmd_net(args):
+    """Transfer plane ("where did the wire go"): per-link ledger, recent
+    transfer stage decompositions, and heaviest-traffic groupings."""
+    from ray_tpu.util import state
+
+    _init(args)
+    sub = args.net_cmd
+    if sub == "links":
+        rows = state.list_links(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        if not rows:
+            print("no transfers recorded (is transfer_plane_enabled on?)")
+            return
+        print(
+            f"{'SRC':<14} {'DST':<14} {'PATH':<9} {'MB':>10} {'XFERS':>6} "
+            f"{'FAIL':>5} {'STALL':>5} {'INFL':>5} {'GiB/s':>8} "
+            f"{'HOP':>4}  FLAGS"
+        )
+        for r in rows:
+            ew = r.get("ewma_gib_per_s")
+            print(
+                f"{r['src']:<14} {r['dst']:<14} {r['path']:<9} "
+                f"{r['bytes'] / 1e6:>10.1f} {r['transfers']:>6} "
+                f"{r['failures']:>5} {r['stalls']:>5} "
+                f"{r.get('inflight', 0):>5} "
+                f"{'?' if ew is None else f'{ew:.4f}':>8} "
+                f"{r.get('max_hop', 0):>4}  "
+                f"{'SLOW' if r.get('slow') else '-'}"
+            )
+        return
+    if sub == "transfers":
+        rows = state.list_transfers(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        if not rows:
+            print("no transfers recorded (is transfer_plane_enabled on?)")
+            return
+        print(
+            f"{'OBJECT':<18} {'LINK':<26} {'PATH':<9} {'MB':>8} "
+            f"{'GiB/s':>8} {'HOP':>4} {'OK':<4} STAGES"
+        )
+        for r in rows:
+            stages = "  ".join(
+                f"{k.replace('_ms', '')}={v:g}ms"
+                for k, v in (r.get("stages_ms") or {}).items()
+            )
+            gp = r.get("gib_per_s")
+            print(
+                f"{r['object_id'][:16]:<18} "
+                f"{r['src'] + '->' + r['dst']:<26} {r['path']:<9} "
+                f"{r['bytes'] / 1e6:>8.1f} "
+                f"{'?' if gp is None else f'{gp:.4f}':>8} "
+                f"{r.get('hop', 0):>4} {'ok' if r['ok'] else 'FAIL':<4} "
+                f"{stages}"
+                + (f"  trace={r['trace_id']}" if r.get("trace_id") else "")
+                + (f"  err={r['error']}" if r.get("error") else "")
+            )
+        return
+    if sub == "top":
+        summary = state.summarize_transfers(
+            group_by=args.group_by, limit=args.limit
+        )
+        if args.json:
+            print(json.dumps(summary, indent=2, default=str))
+            return
+        print(
+            f"== transfers: {summary['inflight']} in flight, "
+            f"{summary['retries']} retries, {summary['stalled']} stalls, "
+            f"{summary['leaked_buffers']} leaked buffers "
+            f"({summary['leaked_bytes'] / 1e6:.1f} MB), "
+            f"{summary['slow_link_events']} slow-link events =="
+        )
+        stages = summary.get("stage_seconds") or {}
+        if stages:
+            print(
+                "stage seconds: "
+                + "  ".join(f"{k}={v:g}s" for k, v in stages.items())
+            )
+        print(f"{'MB':>10} {'GiB/s':>8}  {args.group_by.upper()}")
+        for g in summary["rows"]:
+            gp = g.get("gib_per_s")
+            paths = g.get("paths")
+            path_s = (
+                " ("
+                + ",".join(
+                    f"{p}:{n / 1e6:.1f}MB" for p, n in sorted(paths.items())
+                )
+                + ")"
+                if paths
+                else ""
+            )
+            print(
+                f"{g['bytes'] / 1e6:>10.1f} "
+                f"{'?' if gp is None else f'{gp:.4f}':>8}  "
+                f"{g['group']}{path_s}"
+                + ("  [SLOW]" if g.get("slow") else "")
+            )
+        if not summary["rows"]:
+            print("  (no transfers recorded)")
+        return
+    raise SystemExit(f"unknown net subcommand {sub!r}")
+
+
 def cmd_profile(args):
     """Continuous-profiling plane: record (boost the samplers) and export
     collapsed-stack / speedscope flame graphs with per-task attribution."""
@@ -811,6 +917,29 @@ def main(argv=None):
         "(.txt = collapsed stacks, else speedscope JSON)",
     )
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "net",
+        help="transfer plane (where did the wire go): "
+        "links | transfers | top",
+    )
+    p.add_argument(
+        "net_cmd",
+        choices=["links", "transfers", "top"],
+        help="links = per-(src,dst,path) ledger; transfers = recent stage "
+        "decompositions; top = heaviest groups",
+    )
+    p.add_argument(
+        "--group-by",
+        dest="group_by",
+        choices=["link", "path", "job", "task"],
+        default="link",
+        help="grouping for `top` (task = producing task name, e.g. the "
+        "data executor's data:<stage> operators)",
+    )
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_net)
 
     p = sub.add_parser(
         "profile",

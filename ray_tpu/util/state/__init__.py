@@ -14,6 +14,7 @@ from ray_tpu.util.state.api import (
     list_checkpoints,
     list_cluster_events,
     list_jobs,
+    list_links,
     list_logs,
     list_nodes,
     list_objects,
@@ -22,9 +23,11 @@ from ray_tpu.util.state.api import (
     list_tasks,
     list_traces,
     list_train_runs,
+    list_transfers,
     list_workers,
     summarize_objects,
     summarize_tasks,
+    summarize_transfers,
     train_run,
 )
 
@@ -44,6 +47,9 @@ __all__ = [
     "list_logs",
     "list_traces",
     "list_train_runs",
+    "list_links",
+    "list_transfers",
+    "summarize_transfers",
     "train_run",
     "job_latency",
     "get_log",

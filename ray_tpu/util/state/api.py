@@ -214,6 +214,41 @@ def train_run(run: str, max_steps: Optional[int] = None) -> Optional[dict]:
     return _rpc("train_run", run, max_steps)
 
 
+def list_links(filters=None, limit: int = 10_000) -> List[dict]:
+    """Transfer plane: the scheduler's per-(src, dst, path) link ledger —
+    one row per link with cumulative ``bytes`` / ``transfers`` /
+    ``failures`` / ``stalls``, live ``inflight`` count, throughput
+    ``ewma_gib_per_s``, relay ``max_hop``, and the watchdog's ``slow``
+    flag. Paths: ``socket`` | ``shm_peer`` | ``spill`` | ``relay``.
+    Flushes worker-side read records first (same freshness contract as
+    :func:`list_transfers`)."""
+    _flush_for_read(cluster=True)
+    return _filtered(_rpc("list_links", limit), filters)[:limit]
+
+
+def list_transfers(limit: int = 100) -> List[dict]:
+    """Recent completed transfers (bounded ring), newest first: one record
+    per transfer with its stage decomposition (``dial`` → ``request`` →
+    ``first_byte_wait`` → ``wire`` → ``seal`` in ms), bytes/chunks,
+    GiB/s, relay hop, owning job, and the requester's trace id (drill in
+    with ``ray_tpu.trace``). Flushes worker-side read records first."""
+    _flush_for_read(cluster=True)
+    return _rpc("list_transfers", int(limit))
+
+
+def summarize_transfers(
+    group_by: str = "link", limit: int = 50, *, cluster_flush: bool = True
+) -> dict:
+    """Server-side transfer grouping (transfer plane): ``link`` (src->dst
+    with per-path byte split + throughput), ``path`` (fleet totals +
+    stage-seconds), ``job`` (per-owning-job inter-node bytes), or ``task``
+    (producing task name — ``data:<stage>`` rows give ray_tpu.data its
+    per-operator cross-node bytes). The header carries fleet counters:
+    inflight / retries / stalled / leaked buffers / slow-link events."""
+    _flush_for_read(cluster=cluster_flush)
+    return _rpc("summarize_transfers", group_by, limit)
+
+
 def list_checkpoints(filters=None, limit: int = 10_000) -> List[dict]:
     """Checkpoints of every run registered with the checkpoint plane
     (``ray_tpu.train.checkpointing``): one row per checkpoint prefix with

@@ -123,7 +123,9 @@ def test_dataset_feeds_jax_trainer(ray_start_regular, tmp_path):
     ds = rd.range(64)
 
     def loop(config):
-        it = config["__datasets__"]["train"]
+        # PR-14 routes `datasets=` through the instrumented shard API and
+        # pops __datasets__ from the user config
+        it = train.get_dataset_shard("train")
         total = sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=16))
         train.report({"total": total})
 
