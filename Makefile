@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net bench-launch ci clean
 
 all: native cpp
 
@@ -87,6 +87,13 @@ bench-train-obs:
 # violation.
 bench-net:
 	JAX_PLATFORMS=cpu $(PY) bench_netplane.py --append
+
+# control-plane (actor-launch) observability: launch-rate overhead with the
+# plane toggled in alternating pairs (budget <= 1.05) plus the 1000-actor
+# per-stage launch decomposition and stage-coverage ratio. --append writes
+# the rows to BENCH_SCALE.jsonl. Fails non-zero on budget violation.
+bench-launch:
+	JAX_PLATFORMS=cpu $(PY) bench_launch_obs.py --append
 
 # multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
 # must not degrade a high-priority job's p99 probe latency beyond 2x its

@@ -1,0 +1,192 @@
+"""Control-plane (actor-launch) observability bench.
+
+Two measurements, recorded as BENCH_SCALE.jsonl rows with --append:
+
+1. launch_obs_overhead_ratio — actor launch rate with the launch plane
+   ON vs OFF (``launch_obs_enabled``), interleaved toggles inside ONE
+   cluster (bench_memplane methodology), median of per-pair ratios
+   (round-7 host caveats: absolute rates are unresolvable on these noisy
+   boxes, and fresh-cluster launch-rate pairs are dominated by spawn-path
+   drift; the flag is read live by the head every pass, so same-cluster
+   toggles cancel both). Budget: <= 1.05.
+
+2. launch_stage_decomposition_1000 — the "where did the ACTOR go"
+   acceptance row: 1000 creations (launched in bounded waves so the
+   process count stays sane on one box), per-stage mean/p95 from
+   ``state.launch_profile()``, plus launch_stage_coverage — the median
+   per-creation (submit+placement+worker_spawn+execute)/total, which must
+   stay within 10% of the wall (same bar test_launch_obs.py asserts).
+
+Run: python bench_launch_obs.py [--quick] [--append]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+import ray_tpu
+
+
+def emit(row: dict) -> str:
+    line = json.dumps(row)
+    print(line, flush=True)
+    return line
+
+
+def _launch_wave_rate(n_actors: int, wave: int) -> float:
+    """Launch n_actors in waves of `wave` (create, prove ready with one
+    round-trip, kill) — measures the creation control path, bounding the
+    number of live dedicated workers."""
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    launched = 0
+    while launched < n_actors:
+        k = min(wave, n_actors - launched)
+        actors = [Member.remote() for _ in range(k)]
+        assert ray_tpu.get(
+            [a.ping.remote() for a in actors], timeout=600
+        ) == [1] * k
+        for a in actors:
+            ray_tpu.kill(a)
+        launched += k
+    return n_actors / (time.perf_counter() - t0)
+
+
+def overhead_ratio(pairs: int, seg_actors: int, wave: int):
+    """ON/OFF launch-rate ratio via one-cluster interleaved toggles
+    (bench_memplane methodology): `launch_obs_enabled` is read live by the
+    head on every pass, so alternating ON/OFF segments inside ONE cluster
+    cancel the worker-pool / page-cache / host drift that dominates
+    cluster-to-cluster launch-rate comparisons."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    from ray_tpu._private.worker import get_runtime
+
+    cfg = get_runtime().node.scheduler.config
+    _launch_wave_rate(20, wave)  # settle the initial pool out of the bench
+    ratios = []
+    try:
+        for _ in range(pairs):
+            cfg.launch_obs_enabled = True
+            on = _launch_wave_rate(seg_actors, wave)
+            cfg.launch_obs_enabled = False
+            off = _launch_wave_rate(seg_actors, wave)
+            ratios.append(off / on)  # >1: the plane slowed launches down
+    finally:
+        cfg.launch_obs_enabled = True
+    return statistics.median(ratios), ratios
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--wave", type=int, default=100)
+    ap.add_argument("--pairs", type=int, default=5)
+    ap.add_argument("--pair-actors", type=int, default=100)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--append",
+        action="store_true",
+        help="append result rows to BENCH_SCALE.jsonl",
+    )
+    args = ap.parse_args()
+    if args.quick:
+        args.actors, args.pairs, args.pair_actors = 150, 3, 60
+
+    rows = []
+
+    # --- 1. overhead ratio (one-cluster interleaved toggles) --------------
+    ratio, ratios = overhead_ratio(args.pairs, args.pair_actors, args.wave)
+    ratio = round(ratio, 4)
+    rows.append(
+        emit(
+            {
+                "metric": "launch_obs_overhead_ratio",
+                "value": ratio,
+                "unit": "x",
+                "pairs": [round(r, 4) for r in ratios],
+                "note": "actor launch rate, plane-on/plane-off interleaved "
+                "toggles inside one cluster (median of per-pair ratios; "
+                "per round-7 caveats fresh-cluster pairs are dominated by "
+                "spawn-path drift); budget <= 1.05",
+            }
+        )
+    )
+
+    # --- 2. per-stage decomposition at scale ------------------------------
+    ray_tpu.shutdown()
+    ray_tpu.init(
+        num_cpus=4,
+        ignore_reinit_error=True,
+        _system_config={"launch_obs_enabled": True, "launch_recent_max": 1024},
+    )
+    rate = _launch_wave_rate(args.actors, args.wave)
+    from ray_tpu.util import state
+
+    prof = state.launch_profile(limit=1024)
+    stages = {
+        k: {"mean_ms": v["mean_ms"], "p95_ms": v["p95_ms"]}
+        for k, v in prof["stages"].items()
+    }
+    coverage = []
+    head = ("submit_ms", "placement_ms", "worker_spawn_ms", "execute_ms")
+    for entry in prof["recent"]:
+        total = entry["stages"].get("total_ms")
+        if total:
+            coverage.append(
+                sum(entry["stages"].get(k, 0.0) for k in head) / total
+            )
+    cov = round(statistics.median(coverage), 4) if coverage else None
+    rows.append(
+        emit(
+            {
+                "metric": f"launch_stage_decomposition_{args.actors}",
+                "value": stages,
+                "unit": "ms",
+                "launch_rate": round(rate, 2),
+                "launched_total": prof["launched_total"],
+                "total_mean_ms": prof["total"]["mean_ms"],
+                "total_p95_ms": prof["total"]["p95_ms"],
+                "note": "per-stage launch decomposition over the profile "
+                "window (submit/placement/worker_spawn/execute head stages "
+                "partition the wall; runtime_env/actor_class_load are "
+                "worker-measured refinements of execute's lead-in)",
+            }
+        )
+    )
+    rows.append(
+        emit(
+            {
+                "metric": "launch_stage_coverage",
+                "value": cov,
+                "unit": "stage_sum/wall",
+                "creations": len(coverage),
+                "note": "median per-creation "
+                "(submit+placement+worker_spawn+execute)/total — "
+                "acceptance: within 10% of wall",
+            }
+        )
+    )
+    ray_tpu.shutdown()
+
+    assert ratio <= 1.05, f"launch plane overhead {ratio} > 1.05 budget"
+    assert cov is not None and abs(cov - 1.0) <= 0.10, (
+        f"stage coverage {cov} outside 10% of wall"
+    )
+
+    if args.append:
+        with open("BENCH_SCALE.jsonl", "a") as fh:
+            for line in rows:
+                fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -224,6 +224,30 @@ class Config:
     # links collapse into an <other> row, never unbounded label growth)
     net_recent_transfers_max: int = 512
     net_links_max: int = 4096
+    # --- control-plane observability (actor-launch lifecycle tracing,
+    # worker-pool telemetry, decision flight recorder; see DESIGN_MAP
+    # "Control-plane observability") ---
+    # decompose every Actor.remote() into submit -> placement ->
+    # worker_spawn -> runtime_env -> class_load -> __init__ execute stage
+    # records riding EXISTING messages (spawn_worker cmd / worker ready
+    # ack / creation FINISHED event), keep the launch-profile ring, and
+    # record scheduler placement + autoscaler decisions into the bounded
+    # flight recorder. Requires telemetry_enabled; bench-tracked overhead
+    # ratio <= 1.05 (bench_launch_obs.py)
+    launch_obs_enabled: bool = True
+    # watchdog: an actor creation stuck in one lifecycle stage past this
+    # many seconds gets an ACTOR_LAUNCH_STALLED cluster event (stage,
+    # node, runtime_env digest, trace id); 0 disables
+    actor_launch_warn_s: float = 30.0
+    # bound on the decision flight recorder ring (placement + autoscaler
+    # decisions; oldest evicted)
+    decision_log_max: int = 1024
+    # completed actor-creation stage decompositions kept for the
+    # launch-profile aggregate (oldest evicted)
+    launch_recent_max: int = 512
+    # consecutive spawn failures on one node before pending actor
+    # creations targeting it fail fast with the spawn provenance chained
+    spawn_fail_fast_threshold: int = 3
     # --- failure forensics (cluster event log, watchdogs) ---
     # bound on the scheduler's structured cluster-event log (WORKER_DIED,
     # TASK_FAILED, STRAGGLER, ...); overflow drops the oldest

@@ -139,6 +139,15 @@ class NodeDaemon:
         cpu_total = self._total_resources.get("CPU", 1.0)
         self._lease_worker_cap = max(4, int(2 * cpu_total))
         self._lease_last_reap = time.monotonic()
+        # worker-pool telemetry (control-plane observability): lease
+        # dispatches served by an already-warm idle worker (hit) vs forced
+        # to spawn (miss), plus spawn-latency sums — all ride the EXISTING
+        # heartbeat stats dict into the head's metric series
+        self._prestart_hits = 0
+        self._prestart_misses = 0
+        self._spawn_started_at: Dict[WorkerID, float] = {}
+        self._spawn_lat_sum = 0.0
+        self._spawn_lat_count = 0
         # pending stack-dump aggregations: req_id -> {texts, expect, deadline}
         self._stack_reqs: Dict[str, dict] = {}
 
@@ -290,6 +299,16 @@ class NodeDaemon:
                             "lease_running": len(self._lease_running),
                             "lease_epoch": self._lease_epoch,
                             "pid": os.getpid(),
+                            # worker-pool telemetry (control-plane
+                            # observability): pool occupancy + prestart
+                            # hit/miss + spawn latency ride the beat into
+                            # ray_tpu_lease_pool / ray_tpu_prestart_total
+                            "lease_idle": len(self._lease_idle),
+                            "lease_starting": self._lease_starting,
+                            "prestart_hits": self._prestart_hits,
+                            "prestart_misses": self._prestart_misses,
+                            "spawn_lat_sum": round(self._spawn_lat_sum, 4),
+                            "spawn_lat_count": self._spawn_lat_count,
                             # in-flight receive watermarks ride the beat:
                             # the head's stall watchdog compares BYTES
                             # across beats (clocks are process-local)
@@ -684,7 +703,12 @@ class NodeDaemon:
                     if cand in self.workers:
                         wid = cand
                         break
+                if wid is not None:
+                    # warm-pool hit: a prestarted/kept-warm worker takes
+                    # the task with zero spawn wait
+                    self._prestart_hits += 1
                 if wid is None:
+                    self._prestart_misses += 1
                     self._instances().free(accel)
                     # no idle worker: spawn only what the queue can actually
                     # use (starting workers already count toward demand —
@@ -765,6 +789,7 @@ class NodeDaemon:
         wid = WorkerID.from_random()
         self._lease_wids.add(wid)
         self._lease_starting += 1
+        self._spawn_started_at[wid] = time.monotonic()
         # registration must reach the head BEFORE any relayed traffic from
         # this worker (same socket => FIFO), so its pulls/rpcs resolve
         try:
@@ -777,6 +802,10 @@ class NodeDaemon:
         kind = msg[0]
         if kind == "ready":
             self._lease_starting = max(0, self._lease_starting - 1)
+            started = self._spawn_started_at.pop(wid, None)
+            if started is not None:
+                self._spawn_lat_sum += time.monotonic() - started
+                self._spawn_lat_count += 1
             self._lease_mark_idle(wid)
         elif kind == "task_done":
             _, task_id, results = msg
@@ -809,6 +838,7 @@ class NodeDaemon:
         self._lease_wids.discard(wid)
         self._lease_blocked.discard(wid)
         self._lease_idle_since.pop(wid, None)
+        self._spawn_started_at.pop(wid, None)
         try:
             self._lease_idle.remove(wid)
         except ValueError:

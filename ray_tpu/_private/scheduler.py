@@ -19,6 +19,7 @@ node (top-k random to avoid herding).
 from __future__ import annotations
 
 import collections
+import hashlib
 import itertools
 import json
 import logging
@@ -331,6 +332,18 @@ class ActorState:
     # next death spares the restart budget (preemption is the cluster's
     # fault, not the actor's)
     preempted: bool = False
+    # ---- launch lifecycle (control-plane observability) ----
+    # coarse creation stage for list_actors / the launch watchdog:
+    # submitted -> placing -> spawning -> executing -> ready (-> dead);
+    # stage_ts stamps each transition (wall clock), lifecycle_ms holds the
+    # completed decomposition once the creation settles
+    launch_stage: str = "submitted"
+    stage_ts: Dict[str, float] = field(default_factory=dict)
+    lifecycle_ms: Dict[str, float] = field(default_factory=dict)
+    # wall timestamp of the first settled ACTOR_TASK (first_method ready)
+    first_method_ts: Optional[float] = None
+    # creation trace id (from the spec's trace ctx) for event provenance
+    launch_trace: Optional[str] = None
 
 
 @dataclass
@@ -770,6 +783,55 @@ class Scheduler:
         # event dedup stamps: stall per (oid, dest), slow per link
         self._net_stall_last_event: Dict[Tuple, float] = {}
         self._slow_link_last_event: Dict[Tuple, float] = {}
+        # ---- control-plane observability (actor-launch lifecycle +
+        # worker-pool telemetry + decision flight recorder; see DESIGN_MAP
+        # "Control-plane observability") ----
+        # decision flight recorder: bounded ring of placement + autoscaler
+        # decision records ({seq, t, kind, ...}); appended from the loop
+        # (placement) and the autoscaler's record_decision rpc
+        self._decisions: Deque[dict] = collections.deque(
+            maxlen=int(getattr(config, "decision_log_max", 1024) or 1024)
+        )
+        self._decision_seq = 0
+        self._decision_counts: Dict[str, int] = {}
+        # guards seq/ring: autoscaler rpcs land off-loop
+        self._decision_lock = threading.Lock()
+        # completed actor-creation stage decompositions (launch-profile
+        # aggregate feed); oldest evicted
+        self._launch_recent: Deque[dict] = collections.deque(
+            maxlen=int(getattr(config, "launch_recent_max", 512) or 512)
+        )
+        # spawn accounting: wid -> (node_id, monotonic spawn start) for
+        # head-spawned workers whose ready ack has not arrived; feeds the
+        # spawn-latency histogram and WORKER_SPAWN_FAILED forensics
+        self._spawn_started: Dict[WorkerID, Tuple[NodeID, float]] = {}
+        self._spawn_total = 0
+        self._spawn_failed_total = 0
+        # consecutive spawn failures per node (reset on any success):
+        # crossing spawn_fail_fast_threshold fails pending creations fast
+        self._spawn_fail_streak: Dict[NodeID, int] = collections.defaultdict(int)
+        # spawn latency histogram (metrics.py Histogram data shape)
+        self._spawn_boundaries = [
+            0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+        ]
+        self._spawn_hist = {
+            "count": 0,
+            "sum": 0.0,
+            "buckets": [0] * (len(self._spawn_boundaries) + 1),
+            "boundaries": list(self._spawn_boundaries),
+        }
+        # per-creation-stage seconds totals across completed launches
+        # (launch-profile aggregate + ray_tpu_actor_launch_stage_seconds)
+        self._launch_stage_seconds: Dict[str, float] = {}
+        # worker boot-stage seconds (import / store_connect / serve_bind)
+        # riding the ready ack's optional third element
+        self._worker_boot_stage_seconds: Dict[str, float] = {}
+        self._launch_done_total = 0
+        # launch watchdog: (actor hex, stage) pairs already flagged so a
+        # stuck creation fires ACTOR_LAUNCH_STALLED at most once per stage
+        self._launch_flagged: Set[Tuple[str, str]] = set()
+        self._launch_stalled_total = 0
+        self._last_launch_scan = time.monotonic()
         # head node's own object server address + instance (set by HeadServer)
         self.head_object_addr = None
         self.head_object_server = None
@@ -1108,6 +1170,30 @@ class Scheduler:
             if len(msg) > 1:
                 w.direct_addr = msg[1]
             self._starting_count[w.node_id] = max(0, self._starting_count[w.node_id] - 1)
+            # worker-pool telemetry: spawn settled — fold the fork->ready
+            # latency into the spawn histogram (stamped when the head
+            # issued spawn_worker) and clear the node's failure streak
+            spawn = self._spawn_started.pop(wid, None)
+            if spawn is not None:
+                lat = time.monotonic() - spawn[1]
+                h = self._spawn_hist
+                h["count"] += 1
+                h["sum"] += lat
+                for i, b in enumerate(self._spawn_boundaries):
+                    if lat <= b:
+                        h["buckets"][i] += 1
+                        break
+                else:
+                    h["buckets"][-1] += 1
+            self._spawn_fail_streak.pop(w.node_id, None)
+            # optional worker boot-stage split rides the SAME ready message
+            # as a third element (older workers send two — both accepted)
+            if len(msg) > 2 and isinstance(msg[2], dict):
+                for k, v in msg[2].items():
+                    self._worker_boot_stage_seconds[k] = (
+                        self._worker_boot_stage_seconds.get(k, 0.0)
+                        + float(v) / 1000.0
+                    )
             if w.actor_id is None:
                 self._idle_by_node[w.node_id].append(wid)
             # an active profiler-boost window covers late-spawned workers
@@ -2425,6 +2511,12 @@ class Scheduler:
                     max_task_retries=spec.max_task_retries,
                 )
                 self.actors[spec.actor_id] = st
+            # launch lifecycle: root stamp (the creation trace id joins the
+            # ctx minted by Actor.remote(), so ray_tpu.trace sees one tree)
+            st.launch_stage = "submitted"
+            st.stage_ts["submitted"] = self._pass_now or time.time()
+            if spec.trace_ctx:
+                st.launch_trace = spec.trace_ctx[0]
             if spec.actor_name:
                 self.gcs.claim_actor_name(st.namespace, spec.actor_name, spec.actor_id)
         if spec.task_type == TaskType.ACTOR_TASK:
@@ -3139,6 +3231,11 @@ class Scheduler:
         # deps resolved, entering the dispatch queue: the QUEUED->DISPATCHED
         # gap in the timeline is pure scheduler queueing delay
         self._record_event(rec.spec, "QUEUED", ts=self._pass_now)
+        if rec.spec.task_type == TaskType.ACTOR_CREATION:
+            st = self.actors.get(rec.spec.actor_id)
+            if st is not None and "placing" not in st.stage_ts:
+                st.launch_stage = "placing"
+                st.stage_ts["placing"] = self._pass_now or time.time()
         if rec.spec.task_type == TaskType.ACTOR_TASK:
             self._dispatch_actor_task(rec)
         else:
@@ -3209,6 +3306,11 @@ class Scheduler:
             self._maybe_net_scan()
         except Exception:
             logger.exception("net watchdog scan failed")
+        # control plane: 1 Hz stalled-actor-launch watchdog
+        try:
+            self._maybe_launch_scan()
+        except Exception:
+            logger.exception("launch watchdog scan failed")
         # multi-tenant job plane: drain the admission queue while backlog
         # allows, then scan for starved high-priority work to preempt for
         # (both rate-limit themselves; see DESIGN_MAP "Multi-tenant job
@@ -3682,6 +3784,14 @@ class Scheduler:
             return self._lease_to(node, rec, acquired=True)
         wid = self._acquire_worker(node, spec)
         if wid is None:
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                # launch lifecycle: placement is decided, the creation now
+                # waits on a worker spawn — the placing->spawning boundary
+                # splits queue_wait into placement_ms / worker_spawn_ms
+                st = self.actors.get(spec.actor_id)
+                if st is not None and "spawning" not in st.stage_ts:
+                    st.launch_stage = "spawning"
+                    st.stage_ts["spawning"] = self._pass_now or time.time()
             return False
         w = self.workers[wid]
         accel: Dict[str, list] = {}
@@ -3766,7 +3876,10 @@ class Scheduler:
         cap = max(4, min(32, self._ready_count))
         if self._starting_count[node.node_id] < cap:
             self._starting_count[node.node_id] += 1
-            self._node.spawn_worker(node.node_id)
+            new_wid = self._node.spawn_worker(node.node_id)
+            if new_wid is not None:
+                self._spawn_total += 1
+                self._spawn_started[new_wid] = (node.node_id, time.monotonic())
         return None
 
     def _send_exec(self, wid: WorkerID, rec: TaskRecord):
@@ -3778,11 +3891,13 @@ class Scheduler:
         self._job_note_dispatch(rec, rec.spec.resources)
         self._running_watch.add(rec.spec.task_id)
         w.current_task = rec.spec.task_id
+        launch_stages = None
         if rec.spec.task_type == TaskType.ACTOR_CREATION:
             actor = self.actors[rec.spec.actor_id]
             actor.worker_id = wid
             w.actor_id = rec.spec.actor_id
-        self._record_event(rec.spec, "DISPATCHED")
+            launch_stages = self._note_creation_dispatch(actor, rec, w.node_id)
+        self._record_event(rec.spec, "DISPATCHED", stages=launch_stages)
         self._record_event(rec.spec, "RUNNING")
         try:
             if w.accel_alloc:
@@ -3791,6 +3906,271 @@ class Scheduler:
                 w.conn.send(("exec", rec.spec))
         except (OSError, EOFError):
             self._on_worker_death(wid)
+
+    # ---- control-plane observability helpers (launch lifecycle +
+    # decision flight recorder; see DESIGN_MAP "Control-plane
+    # observability") ----------------------------------------------------
+
+    def _launch_obs_on(self) -> bool:
+        return bool(
+            getattr(self.config, "telemetry_enabled", True)
+            and getattr(self.config, "launch_obs_enabled", True)
+        )
+
+    def _note_creation_dispatch(
+        self, actor: ActorState, rec: TaskRecord, node_id: NodeID
+    ) -> Optional[dict]:
+        """Stamp the placing/spawning -> executing transition and return the
+        head-side queue-wait split (placement_ms / worker_spawn_ms) to ride
+        the creation's DISPATCHED event — build_trace merges event stages
+        from any source, so the split lands in the span tree without a new
+        message."""
+        if not self._launch_obs_on():
+            actor.launch_stage = "executing"
+            return None
+        now = self._pass_now or time.time()
+        ts = actor.stage_ts
+        actor.launch_stage = "executing"
+        ts["executing"] = now
+        queued = ts.get("placing", ts.get("submitted", now))
+        spawn_since = ts.get("spawning")
+        stages = {}
+        if spawn_since is not None:
+            stages["placement_ms"] = max(0.0, (spawn_since - queued) * 1000.0)
+            stages["worker_spawn_ms"] = max(0.0, (now - spawn_since) * 1000.0)
+        else:
+            # never waited on a spawn: an idle worker served the creation
+            stages["placement_ms"] = max(0.0, (now - queued) * 1000.0)
+            stages["worker_spawn_ms"] = 0.0
+        self._record_decision(
+            "placement",
+            actor=actor.actor_id.hex(),
+            name=rec.spec.name,
+            node=node_id.hex()[:12],
+            reason="spawned_worker" if spawn_since is not None else "idle_worker",
+            nodes_alive=sum(1 for n in self.nodes.values() if n.alive),
+            queue_wait_ms=round((now - queued) * 1000.0, 3),
+            trace=actor.launch_trace,
+        )
+        return {k: round(v, 3) for k, v in stages.items()}
+
+    def _record_decision(self, kind: str, **fields) -> None:
+        """Append one record to the decision flight recorder (bounded ring;
+        callable from any thread — autoscaler decisions arrive via rpc)."""
+        with self._decision_lock:
+            self._decision_seq += 1
+            self._decision_counts[kind] = self._decision_counts.get(kind, 0) + 1
+            rec = {"seq": self._decision_seq, "t": time.time(), "kind": kind}
+            rec.update({k: v for k, v in fields.items() if v is not None})
+            self._decisions.append(rec)
+
+    def _finish_creation_profile(self, actor: ActorState, ev_stages: Optional[dict]) -> None:
+        """Fold the settled creation's stage stamps + worker-side stage dict
+        into the per-actor decomposition, the launch-profile ring, and the
+        per-stage aggregates."""
+        if not self._launch_obs_on():
+            return
+        now = self._pass_now or time.time()
+        ts = actor.stage_ts
+        actor.launch_stage = "ready"
+        ts["ready"] = now
+        sub = ts.get("submitted", now)
+        queued = ts.get("placing", sub)
+        spawn_since = ts.get("spawning")
+        disp = ts.get("executing", now)
+        ms = actor.lifecycle_ms
+        ms["submit_ms"] = max(0.0, (queued - sub) * 1000.0)
+        if spawn_since is not None:
+            ms["placement_ms"] = max(0.0, (spawn_since - queued) * 1000.0)
+            ms["worker_spawn_ms"] = max(0.0, (disp - spawn_since) * 1000.0)
+        else:
+            ms["placement_ms"] = max(0.0, (disp - queued) * 1000.0)
+            ms["worker_spawn_ms"] = 0.0
+        ms["execute_ms"] = max(0.0, (now - disp) * 1000.0)
+        # worker-side creation stages ride the FINISHED event's stage dict
+        # (runtime_env_ms, actor_class_load_ms, init stages); they decompose
+        # execute_ms, so they are kept alongside, never double-summed
+        for k in ("runtime_env_ms", "actor_class_load_ms"):
+            if ev_stages and k in ev_stages:
+                ms[k] = float(ev_stages[k])
+        ms["total_ms"] = max(0.0, (now - sub) * 1000.0)
+        for k, v in ms.items():
+            if k != "total_ms":
+                self._launch_stage_seconds[k] = (
+                    self._launch_stage_seconds.get(k, 0.0) + v / 1000.0
+                )
+        self._launch_done_total += 1
+        spec = actor.creation_spec
+        self._launch_recent.append(
+            {
+                "actor": actor.actor_id.hex(),
+                "name": spec.name if spec else None,
+                "node": actor.worker_id and self.workers.get(actor.worker_id)
+                and self.workers[actor.worker_id].node_id.hex()[:12],
+                "trace": actor.launch_trace,
+                "t": now,
+                "stages": {k: round(v, 3) for k, v in ms.items()},
+            }
+        )
+        # the watchdog's per-stage dedup entries are dead now
+        ahex = actor.actor_id.hex()
+        self._launch_flagged = {
+            kf for kf in self._launch_flagged if kf[0] != ahex
+        }
+
+    _CREATION_WORKER_STAGES = ("runtime_env_ms", "actor_class_load_ms")
+
+    def _merge_creation_worker_stages(self, ev: dict) -> None:
+        """Worker-side creation stages lag the head's settle by up to one
+        telemetry flush: merge them into the actor's decomposition, the
+        launch-profile ring entry, and the per-stage aggregates."""
+        if not self._launch_obs_on():
+            return
+        ahex = ev.get("actor_id")
+        if not ahex:
+            return
+        picked = {
+            k: float(v)
+            for k, v in ev["stages"].items()
+            if k in self._CREATION_WORKER_STAGES
+        }
+        if not picked:
+            return
+        try:
+            actor = self.actors.get(ActorID.from_hex(ahex))
+        except (ValueError, TypeError):
+            actor = None
+        if actor is not None:
+            for k, v in picked.items():
+                if k not in actor.lifecycle_ms:
+                    self._launch_stage_seconds[k] = (
+                        self._launch_stage_seconds.get(k, 0.0) + v / 1000.0
+                    )
+                actor.lifecycle_ms[k] = v
+        for entry in reversed(self._launch_recent):
+            if entry["actor"] == ahex:
+                entry["stages"].update(
+                    {k: round(v, 3) for k, v in picked.items()}
+                )
+                break
+
+    def _note_spawn_failure(self, w: WorkerState, wid: WorkerID, pid) -> None:
+        """A head-spawned worker died before its ready ack: emit the typed
+        WORKER_SPAWN_FAILED event with the provenance at hand (exit code,
+        persisted stderr tail) and fail pending actor creations fast once
+        the node's consecutive-failure streak crosses the threshold."""
+        spawn = self._spawn_started.pop(wid, None)
+        self._spawn_failed_total += 1
+        self._spawn_fail_streak[w.node_id] += 1
+        streak = self._spawn_fail_streak[w.node_id]
+        exitcode = getattr(w.proc, "exitcode", None)
+        tail = self._worker_stderr_tail(wid, pid)
+        self.record_cluster_event(
+            "WORKER_SPAWN_FAILED",
+            f"worker {wid.hex()[:12]} died before ready on node "
+            f"{w.node_id.hex()[:12]}"
+            + (f" (exit code {exitcode})" if exitcode is not None else "")
+            + (f": {tail.splitlines()[-1]}" if tail else ""),
+            severity="ERROR",
+            worker_id=wid.hex(),
+            node_id=w.node_id.hex(),
+            pid=pid,
+            exitcode=exitcode,
+            stderr_tail=tail or None,
+            spawn_elapsed_s=(
+                round(time.monotonic() - spawn[1], 3) if spawn else None
+            ),
+            consecutive_failures=streak,
+        )
+        threshold = int(
+            getattr(self.config, "spawn_fail_fast_threshold", 3) or 0
+        )
+        if threshold and streak >= threshold:
+            self._fail_fast_pending_creations(w.node_id, exitcode, tail)
+
+    def _worker_stderr_tail(self, wid: WorkerID, pid, max_bytes: int = 2048) -> str:
+        """Tail of the dead worker's persisted stderr, if the log plane
+        wrote one (worker-<wid8>-<pid>.err under <session>/logs)."""
+        if pid is None or not getattr(self.config, "persist_worker_logs", True):
+            return ""
+        path = os.path.join(
+            self._node.session_dir, "logs", f"worker-{wid.hex()[:8]}-{pid}.err"
+        )
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - max_bytes))
+                return fh.read().decode("utf-8", errors="replace").strip()
+        except OSError:
+            return ""
+
+    def _fail_fast_pending_creations(self, node_id: NodeID, exitcode, tail) -> None:
+        """Consecutive spawn failures mean creations parked in the spawning
+        stage would wait out the full startup timeout for workers that keep
+        dying — fail them now with the spawn provenance chained."""
+        provenance = (
+            f"{self._spawn_fail_streak[node_id]} consecutive worker spawn "
+            f"failures on node {node_id.hex()[:12]}"
+            + (f" (last exit code {exitcode})" if exitcode is not None else "")
+            + (f"; stderr tail: {tail}" if tail else "")
+        )
+        for actor in list(self.actors.values()):
+            if actor.state != "PENDING" or actor.launch_stage != "spawning":
+                continue
+            spec = actor.creation_spec
+            if spec is None:
+                continue
+            rec = self.tasks.get(spec.task_id)
+            if rec is None or rec.state not in ("PENDING", "SCHEDULED"):
+                continue
+            actor.state = "DEAD"
+            actor.launch_stage = "dead"
+            actor.stage_ts["dead"] = time.time()
+            actor.death_cause = f"worker spawn failed: {provenance}"
+            self._ready_remove(spec)
+            self._fail_task(
+                rec, exc.WorkerCrashedError(f"actor creation failed: {provenance}")
+            )
+            self._drain_actor_queue(actor)
+
+    def _launch_profile_summary(self, limit: int = 50) -> dict:
+        """Aggregate the launch-profile ring: per-stage count/mean/p50/p95
+        across recently settled creations plus the most recent rows — the
+        `ray_tpu actors launch-profile` feed (ROADMAP item 2's 'where does
+        the 75ms/actor go' baseline)."""
+        recent = list(self._launch_recent)
+        by_stage: Dict[str, List[float]] = {}
+        for entry in recent:
+            for k, v in entry["stages"].items():
+                if k != "total_ms":
+                    by_stage.setdefault(k, []).append(v)
+        totals = [e["stages"].get("total_ms", 0.0) for e in recent]
+        def _stats(vals: List[float]) -> dict:
+            ordered = sorted(vals)
+            n = len(ordered)
+            return {
+                "count": n,
+                "mean_ms": round(sum(ordered) / n, 3) if n else 0.0,
+                "p50_ms": round(ordered[n // 2], 3) if n else 0.0,
+                "p95_ms": round(ordered[min(n - 1, int(0.95 * n))], 3) if n else 0.0,
+                "max_ms": round(ordered[-1], 3) if n else 0.0,
+            }
+        return {
+            "launched_total": self._launch_done_total,
+            "window": len(recent),
+            "total": _stats(totals),
+            "stages": {k: _stats(v) for k, v in sorted(by_stage.items())},
+            "stage_seconds_total": {
+                k: round(v, 3)
+                for k, v in sorted(self._launch_stage_seconds.items())
+            },
+            "worker_boot_stage_seconds": {
+                k: round(v, 3)
+                for k, v in sorted(self._worker_boot_stage_seconds.items())
+            },
+            "recent": recent[-max(0, int(limit)):],
+        }
 
     # ---- lease dispatch (head half; parity: spillback to raylet local
     # queues, cluster_task_manager.cc:44 → local_task_manager.cc:74) -------
@@ -4444,9 +4824,38 @@ class Scheduler:
                 creation_failed = True
                 actor.state = "DEAD"
                 actor.death_cause = "actor __init__ failed"
+                actor.launch_stage = "dead"
+                actor.stage_ts["dead"] = self._pass_now or time.time()
+                # a runtime_env apply failure is a SPAWN failure, not an
+                # application bug: surface it as the typed event with the
+                # exception text chained (the error result itself already
+                # fails the creation fast)
+                err_text = ""
+                try:
+                    err_text = str(pickle.loads(results[0][1]))
+                except Exception:
+                    pass
+                if "runtime_env" in err_text or "runtime env" in err_text:
+                    actor.death_cause = "runtime_env apply failed"
+                    self.record_cluster_event(
+                        "WORKER_SPAWN_FAILED",
+                        f"runtime_env apply failed for actor "
+                        f"{spec.name or spec.actor_id.hex()[:12]}: "
+                        f"{err_text[:400]}",
+                        severity="ERROR",
+                        worker_id=wid.hex(),
+                        node_id=w.node_id.hex(),
+                        actor_id=spec.actor_id.hex(),
+                        stderr_tail=err_text[:2048],
+                        trace_id=actor.launch_trace,
+                    )
                 self._drain_actor_queue(actor)
             else:
                 actor.state = "ALIVE"
+                try:
+                    self._finish_creation_profile(actor, None)
+                except Exception:
+                    logger.exception("launch profile fold failed")
                 while actor.pending_calls:
                     pending_spec = actor.pending_calls.popleft()
                     prec = self.tasks[pending_spec.task_id]
@@ -4645,6 +5054,10 @@ class Scheduler:
         actor = self.actors.get(actor_id)
         if actor is None:
             return
+        if actor.first_method_ts is None:
+            # launch lifecycle: first settled method call == "actor is
+            # actually serving" (the launch-profile first_method boundary)
+            actor.first_method_ts = self._pass_now or time.time()
         actor.outstanding = max(0, actor.outstanding - 1)
         if (
             actor.pending_kill
@@ -4660,6 +5073,7 @@ class Scheduler:
         w = self.workers.get(wid)
         if w is None or w.state == "dead":
             return
+        spawn_failed = w.state == "starting" and not graceful
         if w.state == "starting":
             # died before "ready": un-count it from the spawn throttle or the
             # node wedges at the 4-starting cap with nothing ever arriving
@@ -4687,6 +5101,17 @@ class Scheduler:
             task_id=w.current_task.hex() if w.current_task else None,
             graceful=graceful,
         )
+        if spawn_failed:
+            # the spawn never produced a ready worker: typed event with
+            # whatever provenance exists (exit code, persisted stderr
+            # tail), then fail-fast pending creations once the node's
+            # failure streak crosses the threshold
+            try:
+                self._note_spawn_failure(w, wid, dead_pid)
+            except Exception:
+                logger.exception("spawn failure forensics failed")
+        else:
+            self._spawn_started.pop(wid, None)
         if self._conn_to_worker.pop(w.conn, None) is not None:
             self._sel_unregister(w.conn)
         try:
@@ -5193,9 +5618,42 @@ class Scheduler:
                             else None
                         ),
                         "node_id": w.node_id.hex() if w is not None else None,
+                        # launch lifecycle (control-plane observability):
+                        # which creation stage the actor is in / blocked
+                        # at, the per-stage wall timestamps, and the
+                        # settled decomposition
+                        "launch_stage": a.launch_stage,
+                        "stage_ts": dict(a.stage_ts),
+                        "lifecycle_ms": {
+                            k: round(v, 3) for k, v in a.lifecycle_ms.items()
+                        },
+                        "first_method_ts": a.first_method_ts,
+                        "trace_id": a.launch_trace,
                     }
                 )
             return self._apply_limit(rows, args)
+        if op == "list_decisions":
+            # decision flight recorder: newest-last rows, optional
+            # kind filter pushed server-side
+            limit = args[0] if args and isinstance(args[0], int) else 1000
+            kind = args[1] if len(args) > 1 else None
+            with self._decision_lock:
+                rows = list(self._decisions)
+            if kind:
+                rows = [r for r in rows if r.get("kind") == kind]
+            return rows[-limit:]
+        if op == "record_decision":
+            # autoscaler (off-loop) decision push; tolerant of malformed
+            # records — the flight recorder is observability, never control
+            dec = args[0] if args else None
+            if isinstance(dec, dict):
+                kind = dec.pop("kind", "autoscaler")
+                self._record_decision(kind, **dec)
+            return True
+        if op == "launch_profile":
+            return self._launch_profile_summary(
+                args[0] if args and isinstance(args[0], int) else 50
+            )
         if op == "list_workers":
             rows = [
                 {
@@ -5920,7 +6378,9 @@ class Scheduler:
             self.submit(spec)
         return len(specs)
 
-    def _record_event(self, spec: TaskSpec, state: str, ts: float = None):
+    def _record_event(
+        self, spec: TaskSpec, state: str, ts: float = None, stages: dict = None
+    ):
         if not getattr(self.config, "telemetry_enabled", True):
             return
         ev = {
@@ -5931,6 +6391,11 @@ class Scheduler:
             "time": ts if ts is not None else time.time(),
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         }
+        if stages:
+            # head-attached stage decomposition (e.g. the actor-creation
+            # placement/worker_spawn split on DISPATCHED): build_trace
+            # merges event stage dicts from any source into the span
+            ev["stages"] = stages
         t = getattr(spec, "trace_ctx", None)
         if t is not None:
             # head-side half of the task's span (the worker records the
@@ -6166,6 +6631,60 @@ class Scheduler:
                 if tid in self._running_watch
             }
 
+    def _maybe_launch_scan(self) -> None:
+        """Launch watchdog: an actor creation stuck in ONE lifecycle stage
+        past actor_launch_warn_s gets an ACTOR_LAUNCH_STALLED event (stage,
+        node, runtime_env digest, trace id) — once per (actor, stage); runs
+        on the loop, rate-limited to 1 Hz."""
+        warn_s = float(getattr(self.config, "actor_launch_warn_s", 30.0) or 0.0)
+        if not warn_s or not self._launch_obs_on():
+            return
+        now = time.monotonic()
+        if now - self._last_launch_scan < 1.0:
+            return
+        self._last_launch_scan = now
+        wall = time.time()
+        for actor in self.actors.values():
+            if actor.state != "PENDING" or not actor.stage_ts:
+                continue
+            stage = actor.launch_stage
+            since = actor.stage_ts.get(stage)
+            if since is None or wall - since <= warn_s:
+                continue
+            key = (actor.actor_id.hex(), stage)
+            if key in self._launch_flagged:
+                continue
+            self._launch_flagged.add(key)
+            self._launch_stalled_total += 1
+            spec = actor.creation_spec
+            w = self.workers.get(actor.worker_id) if actor.worker_id else None
+            env = spec.runtime_env if spec is not None else None
+            env_digest = (
+                hashlib.sha1(repr(env).encode()).hexdigest()[:12] if env else None
+            )
+            self.record_cluster_event(
+                "ACTOR_LAUNCH_STALLED",
+                f"actor {(spec.name if spec else None) or actor.actor_id.hex()[:12]} "
+                f"stuck in stage '{stage}' for {wall - since:.1f}s",
+                severity="WARNING",
+                actor_id=actor.actor_id.hex(),
+                name=spec.name if spec else None,
+                stage=stage,
+                stalled_s=round(wall - since, 1),
+                node_id=w.node_id.hex() if w is not None else None,
+                runtime_env_digest=env_digest,
+                trace_id=actor.launch_trace,
+            )
+        if len(self._launch_flagged) > 256:
+            live = {
+                a.actor_id.hex()
+                for a in self.actors.values()
+                if a.state == "PENDING"
+            }
+            self._launch_flagged = {
+                kf for kf in self._launch_flagged if kf[0] in live
+            }
+
     def hung_get_digest(self, oid_hexes: List[str]) -> str:
         """Forensic digest for a blocked get(): each pending object's
         producing task chain with states/workers (driver watchdog; runs on
@@ -6349,6 +6868,34 @@ class Scheduler:
                 # per-event noting is skipped — loop budget (see
                 # _record_event)
                 self._trace_note(tid, ev)
+            if (
+                ev.get("type") == "ACTOR_CREATION"
+                and ev.get("state") == "FINISHED"
+                and ev.get("stages")
+            ):
+                # worker-side creation stages (runtime_env_ms /
+                # actor_class_load_ms) arrive one flush interval after the
+                # head settled the creation: late-merge into the profile
+                try:
+                    self._merge_creation_worker_stages(ev)
+                except Exception:
+                    logger.exception("creation stage merge failed")
+            elif (
+                ev.get("type") == "ACTOR_TASK"
+                and ev.get("state") == "FINISHED"
+                and ev.get("actor_id")
+            ):
+                # direct actor calls never touch the head: the worker's
+                # FINISHED event is the only signal for the first_method
+                # launch boundary
+                try:
+                    actor = self.actors.get(ActorID.from_hex(ev["actor_id"]))
+                except (ValueError, TypeError):
+                    actor = None
+                if actor is not None and actor.first_method_ts is None:
+                    actor.first_method_ts = float(
+                        ev.get("time") or time.time()
+                    )
             self._task_events.append(ev)
         for span in spans:
             self._append_profile_span(span, pid=pid)
@@ -7153,6 +7700,120 @@ class Scheduler:
             "gauge",
             "worker processes by state",
             {lk(state=s): n for s, n in sorted(by_wstate.items())},
+        )
+        # ---- control-plane observability: worker-pool telemetry +
+        # launch lifecycle + decision flight recorder ----
+        pool: Dict[str, int] = {}
+        for w in self.workers.values():
+            if w.state == "dead":
+                continue
+            key = lk(node=w.node_id.hex()[:12], state=w.state)
+            pool[key] = pool.get(key, 0) + 1
+        add(
+            "ray_tpu_worker_pool",
+            "gauge",
+            "head-managed worker-pool occupancy per (node, state) "
+            "(starting | idle | busy | blocked)",
+            pool or {lk(): 0},
+        )
+        add(
+            "ray_tpu_worker_spawns_total",
+            "counter",
+            "head-initiated worker spawns by outcome (ready ack received "
+            "vs died before ready)",
+            {
+                lk(outcome="ok"): self._spawn_total - self._spawn_failed_total,
+                lk(outcome="failed"): self._spawn_failed_total,
+            },
+        )
+        add(
+            "ray_tpu_worker_spawn_seconds",
+            "histogram",
+            "worker spawn latency: spawn_worker issue to ready ack",
+            {lk(): json.loads(json.dumps(self._spawn_hist))},
+        )
+        lease_pool: Dict[str, int] = {}
+        prestart: Dict[str, int] = {}
+        for nid, node in self.nodes.items():
+            stats = node.stats or {}
+            if not node.alive or not isinstance(stats, dict):
+                continue
+            nh = nid.hex()[:12]
+            for st_key, st_label in (
+                ("lease_idle", "idle"),
+                ("lease_starting", "starting"),
+                ("lease_running", "busy"),
+            ):
+                if st_key in stats:
+                    lease_pool[lk(node=nh, state=st_label)] = int(
+                        stats.get(st_key) or 0
+                    )
+            if "prestart_hits" in stats or "prestart_misses" in stats:
+                prestart[lk(node=nh, outcome="hit")] = int(
+                    stats.get("prestart_hits") or 0
+                )
+                prestart[lk(node=nh, outcome="miss")] = int(
+                    stats.get("prestart_misses") or 0
+                )
+        add(
+            "ray_tpu_lease_pool",
+            "gauge",
+            "daemon-local lease-worker pool occupancy per (node, state), "
+            "riding heartbeat stats",
+            lease_pool or {lk(): 0},
+        )
+        add(
+            "ray_tpu_prestart_total",
+            "counter",
+            "daemon lease dispatches served by a prestarted idle worker "
+            "(hit) vs forced to spawn (miss) — the warm-pool baseline",
+            prestart or {lk(): 0},
+        )
+        add(
+            "ray_tpu_actor_launches_total",
+            "counter",
+            "actor creations settled with a full lifecycle decomposition",
+            {lk(): self._launch_done_total},
+        )
+        add(
+            "ray_tpu_actor_launch_stage_seconds_total",
+            "counter",
+            "cumulative seconds per actor-creation lifecycle stage "
+            "(submit | placement | worker_spawn | execute | runtime_env | "
+            "actor_class_load)",
+            {
+                lk(stage=s.replace("_ms", "")): round(v, 4)
+                for s, v in sorted(self._launch_stage_seconds.items())
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_worker_boot_stage_seconds_total",
+            "counter",
+            "cumulative seconds per worker boot stage riding the ready "
+            "ack (import | store_connect | runtime_init | serve_bind)",
+            {
+                lk(stage=s.replace("_ms", "")): round(v, 4)
+                for s, v in sorted(self._worker_boot_stage_seconds.items())
+            }
+            or {lk(): 0},
+        )
+        add(
+            "ray_tpu_actor_launch_stalled_total",
+            "counter",
+            "ACTOR_LAUNCH_STALLED flags: creations stuck in one lifecycle "
+            "stage past actor_launch_warn_s",
+            {lk(): self._launch_stalled_total},
+        )
+        with self._decision_lock:
+            dec_counts = dict(self._decision_counts)
+        add(
+            "ray_tpu_decisions_total",
+            "counter",
+            "decision flight-recorder records by kind "
+            "(placement | autoscaler)",
+            {lk(kind=k): n for k, n in sorted(dec_counts.items())}
+            or {lk(): 0},
         )
         # multi-tenant job plane: per-job arbitration series
         jobs_sorted = sorted(self._jobs.values(), key=lambda j: j.seq)

@@ -11,6 +11,11 @@ cross-process span tree and decomposes end-to-end latency into
     submit -> queue_wait -> dispatch -> arg_fetch (bytes + transfer path)
     -> execute -> result_put -> stream_yield (with TTFT for streaming)
 
+Actor-creation spans (control-plane observability) refine this: the
+scheduler ships a placement/worker_spawn split that partitions
+queue_wait, and the worker reports runtime_env apply and actor-class
+load (import) as measured stages ahead of ``__init__`` execution.
+
 Surfaces: ``ray_tpu.trace(trace_id)`` (returns :class:`Trace`), the
 ``ray_tpu trace`` CLI, and the dashboard's ``/api/trace`` tab.
 """
@@ -26,13 +31,22 @@ _GAPS = [
     ("dispatch_ms", ("DISPATCHED", "RUNNING")),
 ]
 
-# measured worker-side stages in presentation order
+# measured worker-side stages in presentation order. runtime_env apply and
+# actor-class load run before the execute timer starts, so they are additive
+# (non-overlapping) with execute_ms and belong in the covered sum.
 _MEASURED = [
+    "runtime_env_ms",
+    "actor_class_load_ms",
     "arg_fetch_ms",
     "execute_ms",
     "result_put_ms",
     "stream_yield_ms",
 ]
+
+# head-measured partition of an actor creation's queue_wait (scheduler
+# stamps: QUEUED -> node/slot chosen -> worker process ready); when present
+# these REPLACE the coarse queue_wait_ms gap — same wall, finer cut.
+_QUEUE_SPLIT = ("placement_ms", "worker_spawn_ms")
 
 
 class Span:
@@ -86,7 +100,16 @@ class Span:
         Inter-state gaps come from event timestamps, worker stages from the
         FINISHED event's measured durations."""
         out: Dict[str, float] = {}
+        split = any(k in self.stages for k in _QUEUE_SPLIT)
         for key, (a, b) in _GAPS:
+            if key == "queue_wait_ms" and split:
+                # actor creation: the scheduler's placement/worker_spawn
+                # stamps partition this gap — swap in the finer cut in place
+                for sk in _QUEUE_SPLIT:
+                    v = self.stages.get(sk)
+                    if v is not None:
+                        out[sk] = float(v)
+                continue
             if a in self.states and b in self.states:
                 out[key] = max(0.0, (self.states[b] - self.states[a]) * 1e3)
         for key in _MEASURED:

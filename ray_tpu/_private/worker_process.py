@@ -925,7 +925,13 @@ class WorkerRuntime:
             # bad zip, rpc timeout) must surface as a TaskError, not kill the
             # worker loop (parity: RuntimeEnvSetupError)
             if spec.runtime_env:
+                t_env = time.perf_counter()
                 saved_env = self._apply_runtime_env(spec)
+                # launch lifecycle: runtime_env apply cost rides the
+                # FINISHED event's stage dict (decomposes execute_ms)
+                self._tls.stages["runtime_env_ms"] = (
+                    time.perf_counter() - t_env
+                ) * 1e3
                 if spec.task_type == TaskType.ACTOR_CREATION:
                     # a dedicated actor worker keeps its runtime env for the
                     # actor's whole lifetime (parity: runtime envs are
@@ -934,7 +940,12 @@ class WorkerRuntime:
                     # every subsequent method call
                     saved_env = {}
             if spec.task_type == TaskType.ACTOR_CREATION:
+                t_load = time.perf_counter()
                 cls = cloudpickle.loads(spec.function)
+                # class unpickle = import cost of the actor's module graph
+                self._tls.stages["actor_class_load_ms"] = (
+                    time.perf_counter() - t_load
+                ) * 1e3
                 args, kwargs = self._resolve_args(spec)
                 self._actor_instance = cls(*args, **kwargs)
                 self._note_execute_done()
@@ -1211,6 +1222,12 @@ class _TeeStream:
 
 def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, config_blob: bytes):
     """Entry point for spawned worker processes."""
+    t_boot = time.perf_counter()
+    # boot-stage decomposition (control-plane observability): stamps ride
+    # the EXISTING ready ack as an optional third element, splitting the
+    # head-observed spawn latency into import / store_connect /
+    # runtime_init / serve_bind (the fork gap is the remainder)
+    boot_stages: Dict[str, float] = {}
     if os.environ.get("RAY_TPU_BOOT_TRACE"):
         import sys as _sys
 
@@ -1224,6 +1241,8 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
     worker_id = WorkerID(worker_id_bin)
     from ray_tpu._private import external_storage as _xstorage
 
+    boot_stages["import_ms"] = (time.perf_counter() - t_boot) * 1e3
+    t_mark = time.perf_counter()
     store = create_store_client(
         shm_dir,
         fallback_dir,
@@ -1234,6 +1253,8 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
             else ""
         ),
     )
+    boot_stages["store_connect_ms"] = (time.perf_counter() - t_mark) * 1e3
+    t_mark = time.perf_counter()
     rt = WorkerRuntime(conn, worker_id, store, config)
     # node identity for same-node checks (e.g. compiled-DAG channel
     # placement): workers on one node share this shm dir
@@ -1305,6 +1326,8 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
 
         _sampler_mod.ensure_running(config)
 
+    boot_stages["runtime_init_ms"] = (time.perf_counter() - t_mark) * 1e3
+    t_mark = time.perf_counter()
     # direct actor-call listener (this worker as CALLEE); its address rides
     # the ready message into the head's worker table for resolve_actors
     direct_server = None
@@ -1315,11 +1338,18 @@ def worker_main(conn, worker_id_bin: bytes, shm_dir: str, fallback_dir: str, con
             )
         except Exception:
             direct_server = None
+    boot_stages["serve_bind_ms"] = (time.perf_counter() - t_mark) * 1e3
     if os.environ.get("RAY_TPU_BOOT_TRACE"):
         import sys as _sys
 
         _sys.stderr.write(f"BOOT ready {time.monotonic():.4f}\n")
-    conn.send(("ready", direct_server.address if direct_server else None))
+    conn.send(
+        (
+            "ready",
+            direct_server.address if direct_server else None,
+            {k: round(v, 3) for k, v in boot_stages.items()},
+        )
+    )
 
     pool: Optional[ThreadPoolExecutor] = None
 

@@ -75,6 +75,17 @@ class ClusterStateSource:
             out[n["node_id"]] = max(fracs) if fracs else 0.0
         return out
 
+    def record_decision(self, dec: dict) -> None:
+        """Ship one reconcile decision to the head's flight recorder
+        (``state.list_decisions`` / ``ray_tpu decisions``). Best-effort:
+        an unreachable head must never break the reconcile loop."""
+        try:
+            from ray_tpu._private.worker import get_driver
+
+            get_driver().scheduler_rpc("record_decision", (dec,))
+        except Exception:
+            pass
+
 
 def _shape_fits(shape: Dict[str, float], resources: Dict[str, float]) -> bool:
     return all(resources.get(k, 0.0) >= v for k, v in shape.items())
@@ -140,6 +151,8 @@ class Autoscaler:
         launched = 0
         terminated = 0
         now = time.monotonic()
+        # decision flight recorder: why this pass did (or didn't) scale
+        reasons: List[str] = []
 
         # 1. satisfy min_workers
         for nt in self.config.node_types:
@@ -148,6 +161,8 @@ class Autoscaler:
                 self.provider.create_node(nt.name, nt.resources)
                 have += 1
                 launched += 1
+        if launched:
+            reasons.append("min_workers")
 
         # 2. bin-pack backlog demand onto hypothetical new nodes
         to_launch: Dict[str, int] = {}
@@ -174,6 +189,10 @@ class Autoscaler:
             for _ in range(min(count, cap)):
                 self.provider.create_node(nt.name, nt.resources)
                 launched += 1
+            if count > cap:
+                reasons.append("upscaling_speed_cap")
+        if to_launch:
+            reasons.append("backlog_demand")
         if launched:
             self._last_scale_up = now
 
@@ -201,6 +220,12 @@ class Autoscaler:
                 else:
                     self._idle_since.pop(nid, None)
             if cooldown_active or serves_backlog:
+                # drain suppressed: attribute the no-op so flapping (or the
+                # absence of an expected drain) is explainable after the fact
+                if any(n["node_id"] in self._idle_since for n in mine):
+                    reasons.append(
+                        "cooldown_active" if cooldown_active else "serves_backlog"
+                    )
                 continue
             idle_long = [
                 n
@@ -214,5 +239,30 @@ class Autoscaler:
                 self.provider.terminate_node(n["node_id"])
                 self._idle_since.pop(n["node_id"], None)
                 terminated += 1
+        if terminated:
+            reasons.append("idle_timeout")
+
+        # record a decision whenever there was something to explain — an
+        # action taken, demand seen, or a drain explicitly suppressed. Pure
+        # no-op passes stay out of the (bounded) ring.
+        if launched or terminated or demand or reasons:
+            rec = getattr(self.state, "record_decision", None)
+            if rec is not None:
+                try:
+                    rec(
+                        {
+                            "kind": "autoscaler",
+                            "demand": len(demand),
+                            "backlog_shapes": len(
+                                self._backlogged_shapes(backlog)
+                            ),
+                            "to_launch": dict(to_launch),
+                            "launched": launched,
+                            "terminated": terminated,
+                            "reasons": sorted(set(reasons)),
+                        }
+                    )
+                except Exception:
+                    pass
 
         return {"launched": launched, "terminated": terminated}

@@ -11,6 +11,8 @@ stdlib HTTP server in the driver serves a dependency-free single-page UI
   /api/tasks            task table            /api/actors     actor table
   /api/objects          object store          /api/jobs       job table
   /api/events           cluster event log (failure forensics)
+  /api/launch           actor-launch lifecycle profile (control plane)
+  /api/decisions        scheduler/autoscaler decision flight recorder
   /api/stacks           thread stacks of driver + every node daemon
                         (the reporter-agent py-spy role)
   /api/profiler/start|stop   jax.profiler XPlane device traces
@@ -182,6 +184,31 @@ def start_dashboard(port: int = 8765) -> int:
                         ),
                         "summary": drv.rpc("summarize_transfers", "path", 20),
                     }
+                elif urlparse(self.path).path == "/api/decisions":
+                    # decision flight recorder: scheduler placement +
+                    # autoscaler reconcile decisions (head-side ring, no
+                    # worker flush needed)
+                    from ray_tpu._private.worker import get_driver
+
+                    q = parse_qs(urlparse(self.path).query)
+                    body = get_driver().rpc(
+                        "list_decisions",
+                        int(q.get("limit", ["200"])[0]),
+                        q.get("kind", [None])[0],
+                    )
+                elif urlparse(self.path).path == "/api/launch":
+                    # actor-launch lifecycle profile. Local flush only —
+                    # 2s UI polling (the /api/trace rule); worker-side
+                    # creation stages lag at most one telemetry interval
+                    from ray_tpu._private import telemetry as _tele
+                    from ray_tpu._private.worker import get_driver
+
+                    _tele.flush()
+                    q = parse_qs(urlparse(self.path).query)
+                    body = get_driver().rpc(
+                        "launch_profile",
+                        int(q.get("limit", ["50"])[0]),
+                    )
                 elif self.path == "/api/job_latency":
                     # per-job sliding-window p50/p95/p99 + exemplar traces
                     from ray_tpu._private.worker import get_driver

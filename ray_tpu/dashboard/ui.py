@@ -55,10 +55,10 @@ PAGE = """<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const TABS = ["overview","node_stats","metrics","tasks","actors","objects",
-              "memory","network","placement_groups","serve","jobs","train",
-              "logs","events","event_stats","traces","latency","stacks",
-              "profile"];
+const TABS = ["overview","node_stats","metrics","tasks","actors","launch",
+              "decisions","objects","memory","network","placement_groups",
+              "serve","jobs","train","logs","events","event_stats","traces",
+              "latency","stacks","profile"];
 // hash may carry a selection suffix, e.g. "#traces:<trace_id>"
 let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
 window.addEventListener("hashchange", () => {
@@ -121,6 +121,51 @@ const RENDER = {
       "<h2>latest</h2>" + table(rows.slice(-200).reverse());
   },
   async actors() { return table(await j("/api/actors")); },
+  async launch() {
+    // control plane: actor-launch lifecycle profile — per-stage
+    // latency stats over recent creations + the recent-launch ring
+    const p = await j("/api/launch?limit=30");
+    const head = `<p>${p.launched_total||0} launches total · ` +
+      `${p.window||0} in window` +
+      (p.total && p.total.count ?
+        ` · total mean ${p.total.mean_ms}ms p95 ${p.total.p95_ms}ms` : "") +
+      `</p>`;
+    const stages = table(Object.entries(p.stages||{}).map(([k,v]) => ({
+      stage: k.replace("_ms",""), count: v.count, "mean ms": v.mean_ms,
+      "p50 ms": v.p50_ms, "p95 ms": v.p95_ms, "max ms": v.max_ms,
+    })));
+    const boot = Object.entries(p.worker_boot_stage_seconds||{})
+      .map(([k,v])=>`${k.replace("_ms","")}=${v}s`).join(" · ");
+    const recent = table((p.recent||[]).slice().reverse().map(r => ({
+      actor: (r.actor||"").slice(0,14), name: r.name||"",
+      node: (r.node||"").slice(0,8),
+      stages: Object.entries(r.stages||{})
+        .filter(([k])=>k!=="total_ms")
+        .map(([k,v])=>`${k.replace("_ms","")}=${v}`).join(" "),
+      "total ms": (r.stages||{}).total_ms,
+      trace: r.trace || "",
+    })));
+    return head + "<h2>stage latency</h2>" + stages +
+      (boot ? `<h2>worker boot (cumulative s)</h2><p>${boot}</p>` : "") +
+      "<h2>recent launches</h2>" + recent;
+  },
+  async decisions() {
+    // decision flight recorder: placement + autoscaler rows, newest first
+    const rows = await j("/api/decisions?limit=200");
+    const by = {};
+    rows.forEach(r => { by[r.kind] = (by[r.kind]||0)+1; });
+    const shaped = rows.slice().reverse().map(r => ({
+      seq: r.seq, kind: r.kind,
+      detail: Object.entries(r).filter(([k]) =>
+        !["seq","t","kind"].includes(k))
+        .map(([k,v]) => `${k}=${v!==null&&typeof v==="object"?JSON.stringify(v):v}`)
+        .join(" "),
+    }));
+    return "<h2>by kind</h2><p>" +
+      Object.entries(by).map(([k,v])=>`${k}: ${v}`).join(" · ") + "</p>" +
+      "<h2>decisions (newest first)</h2>" +
+      table(shaped, ["seq","kind","detail"]);
+  },
   async objects() {
     const rows = await j("/api/objects");
     const total = rows.reduce((a,r)=>a+(r.size_bytes||0), 0);

@@ -259,6 +259,115 @@ def cmd_events(args):
         print("no cluster events recorded")
 
 
+def cmd_actors(args):
+    """Actor fleet view (control-plane observability): one row per actor
+    with its launch lifecycle stage; ``--pending`` narrows to creations
+    still in flight and shows the stage each is blocked in;
+    ``launch-profile`` prints the per-stage launch-latency decomposition
+    (the ROADMAP item-2 'where does the 75ms/actor go' baseline)."""
+    import time as _time
+
+    from ray_tpu.util import state
+
+    _init(args)
+    if args.actors_cmd == "launch-profile":
+        prof = state.launch_profile(limit=args.limit)
+        if args.json:
+            print(json.dumps(prof, indent=2, default=str))
+            return
+        total = prof.get("total") or {}
+        print(
+            f"actor launches: {prof.get('launched_total', 0)} total, "
+            f"{prof.get('window', 0)} in window  "
+            f"(total mean={total.get('mean_ms', 0):g}ms "
+            f"p95={total.get('p95_ms', 0):g}ms)"
+        )
+        stages = prof.get("stages") or {}
+        if not stages:
+            print("no completed actor launches recorded")
+            return
+        print(
+            f"  {'stage':<22} {'count':>6} {'mean':>10} "
+            f"{'p50':>10} {'p95':>10} {'max':>10}"
+        )
+        for name, row in stages.items():
+            print(
+                f"  {name.replace('_ms', ''):<22} {row['count']:>6} "
+                f"{row['mean_ms']:>8.1f}ms {row['p50_ms']:>8.1f}ms "
+                f"{row['p95_ms']:>8.1f}ms {row['max_ms']:>8.1f}ms"
+            )
+        boot = prof.get("worker_boot_stage_seconds") or {}
+        if boot:
+            print(
+                "worker boot (cumulative): "
+                + "  ".join(
+                    f"{k.replace('_ms', '')}={v:g}s"
+                    for k, v in boot.items()
+                )
+            )
+        return
+    rows = state.list_actors(limit=args.limit)
+    if args.pending:
+        rows = [r for r in rows if r.get("state") == "PENDING"]
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    now = _time.time()
+    for r in rows:
+        stage = r.get("launch_stage") or "?"
+        line = (
+            f"{r['actor_id'][:16]}  {r.get('state', '?'):<10} "
+            f"stage={stage:<10} "
+            f"{(r.get('class_name') or r.get('name') or '-'):<24}"
+        )
+        if r.get("node_id"):
+            line += f"  node={r['node_id'][:8]}"
+        if args.pending:
+            # how long the creation has been stuck in its current stage
+            ts = (r.get("stage_ts") or {}).get(stage)
+            if ts:
+                line += f"  blocked {now - ts:.1f}s in {stage}"
+            if r.get("trace_id"):
+                line += f"  trace={r['trace_id']}"
+        elif r.get("lifecycle_ms"):
+            lc = r["lifecycle_ms"]
+            line += "  [" + "  ".join(
+                f"{k.replace('_ms', '')}={v:g}ms"
+                for k, v in lc.items()
+                if k != "total_ms"
+            ) + f"]  total={lc.get('total_ms', 0):g}ms"
+        print(line)
+    if not rows:
+        print("no pending actor creations" if args.pending else "no actors")
+
+
+def cmd_decisions(args):
+    """Decision flight recorder: the bounded ring of scheduler placement
+    decisions and autoscaler reconcile decisions, oldest first — why each
+    actor landed where it did, and why the fleet did (or didn't) scale."""
+    import time as _time
+
+    from ray_tpu.util import state
+
+    _init(args)
+    rows = state.list_decisions(limit=args.limit, kind=args.kind or "")
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    for d in rows:
+        stamp = _time.strftime(
+            "%H:%M:%S", _time.localtime(d.get("t", 0))
+        )
+        rest = " ".join(
+            f"{k}={d[k]}"
+            for k in sorted(d)
+            if k not in ("seq", "t", "kind") and d[k] is not None
+        )
+        print(f"#{d.get('seq', '?'):<6} {stamp} {d.get('kind', '?'):<11} {rest}")
+    if not rows:
+        print("no decisions recorded")
+
+
 def cmd_ckpt(args):
     """Checkpoint plane: list/inspect/verify/GC committed checkpoints
     (``ray_tpu.train.checkpointing``). With ``--storage`` the commands work
@@ -917,6 +1026,43 @@ def main(argv=None):
         "(.txt = collapsed stacks, else speedscope JSON)",
     )
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "actors",
+        help="actor fleet + launch lifecycle (control-plane "
+        "observability): list | launch-profile",
+    )
+    p.add_argument(
+        "actors_cmd",
+        nargs="?",
+        choices=["list", "launch-profile"],
+        default="list",
+        help="list = one row per actor with launch stage; launch-profile "
+        "= per-stage launch-latency decomposition",
+    )
+    p.add_argument(
+        "--pending",
+        action="store_true",
+        help="only creations still in flight, with the stage each is "
+        "blocked in and for how long",
+    )
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_actors)
+
+    p = sub.add_parser(
+        "decisions",
+        help="scheduler/autoscaler decision flight recorder (why did "
+        "the fleet scale / where did the actor land)",
+    )
+    p.add_argument(
+        "--kind",
+        choices=["placement", "autoscaler"],
+        help="only one decision kind",
+    )
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_decisions)
 
     p = sub.add_parser(
         "net",

@@ -10,9 +10,11 @@ from ray_tpu.util.state.api import (
     backlog_summary,
     get_log,
     job_latency,
+    launch_profile,
     list_actors,
     list_checkpoints,
     list_cluster_events,
+    list_decisions,
     list_jobs,
     list_links,
     list_logs,
@@ -43,6 +45,7 @@ __all__ = [
     "list_workers",
     "list_placement_groups",
     "list_cluster_events",
+    "list_decisions",
     "list_jobs",
     "list_logs",
     "list_traces",
@@ -52,6 +55,7 @@ __all__ = [
     "summarize_transfers",
     "train_run",
     "job_latency",
+    "launch_profile",
     "get_log",
     "summarize_tasks",
 ]

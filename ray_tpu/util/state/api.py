@@ -178,6 +178,32 @@ def list_jobs(filters=None, limit: int = 10_000) -> List[dict]:
     return _list("list_jobs", filters, limit)
 
 
+def list_decisions(filters=None, limit: int = 1000, kind: str = "") -> List[dict]:
+    """Control-plane decision flight recorder (bounded ring): scheduler
+    placement decisions (actor, winning node, reason, queue wait) and
+    autoscaler reconcile decisions (demand seen, to_launch delta,
+    launched/terminated, why — ``backlog_demand`` / ``cooldown_active`` /
+    ``serves_backlog`` / ``upscaling_speed_cap`` / ``idle_timeout``), in
+    record order with monotonically increasing ``seq``. ``kind=`` keeps
+    only ``placement`` or ``autoscaler`` rows (server-side); client-side
+    ``filters`` then apply."""
+    return _filtered(_rpc("list_decisions", limit, kind or None), filters)[
+        :limit
+    ]
+
+
+def launch_profile(limit: int = 50) -> dict:
+    """Actor-launch lifecycle profile (control-plane observability):
+    per-stage count/mean/p50/p95/max over the recent-launch ring
+    (``submit`` → ``placement`` → ``worker_spawn`` → ``execute`` plus
+    worker-reported ``runtime_env`` / ``actor_class_load``), cumulative
+    stage-seconds, worker boot-stage seconds, and the most recent
+    ``limit`` launch records with their trace ids. Flushes telemetry
+    first so worker-side creation stages are read-your-writes."""
+    _flush_for_read(cluster=True)
+    return _rpc("launch_profile", int(limit))
+
+
 def list_traces(limit: int = 100) -> List[dict]:
     """Recent request traces (tracing plane), newest first: one digest per
     trace id (``first_time`` / ``last_time`` / ``root`` / ``events``).
