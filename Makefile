@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net bench-launch ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net bench-launch bench-incidents bench-gate ci clean
 
 all: native cpp
 
@@ -42,7 +42,7 @@ test-fast: native
 chaos:
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py \
 		tests/test_elastic_chaos.py tests/test_preempt_chaos.py \
-		tests/test_serve_chaos.py -m slow -q
+		tests/test_serve_chaos.py tests/test_incident_chaos.py -m slow -q
 
 # serve-plane churn suite: replica + controller SIGKILLs under sustained
 # mixed unary/streaming load, graceful-redeploy zero-drop proof. Seeded via
@@ -94,6 +94,19 @@ bench-net:
 # the rows to BENCH_SCALE.jsonl. Fails non-zero on budget violation.
 bench-launch:
 	JAX_PLATFORMS=cpu $(PY) bench_launch_obs.py --append
+
+# incident/alerting-plane overhead: small-task rate with the plane (1 Hz
+# SLO scan + event intake) toggled live in alternating pairs, 3 SLOs
+# registered while ON (budget <= 1.05). --append writes the row to
+# BENCH_CORE.jsonl.
+bench-incidents:
+	JAX_PLATFORMS=cpu $(PY) bench_incidents.py --append
+
+# bench regression gate: re-reads the BENCH_*.jsonl ledgers and fails
+# non-zero if the newest row of any *_overhead_ratio metric exceeds its
+# budget (default 1.05) or any *_stage_coverage row is below 0.9.
+bench-gate:
+	$(PY) tools/bench_check.py
 
 # multi-tenant acceptance: a noisy-neighbor job (task spam + large puts)
 # must not degrade a high-priority job's p99 probe latency beyond 2x its

@@ -287,6 +287,31 @@ class Config:
     # checkpoint-commit protect window)
     preemption_enabled: bool = True
     preemption_wait_s: float = 3.0
+    # --- alerting & incident-forensics plane (SLO burn-rate evaluation
+    # + cross-plane root-cause digests; see DESIGN_MAP "Alerting &
+    # incidents"). Evaluation rides the scheduler's existing 1 Hz
+    # maintenance pass; bench_incidents.py proves ratio <= 1.05.
+    incident_plane_enabled: bool = True
+    # bound on the incident table (closed incidents evicted oldest-first)
+    incident_max: int = 256
+    # an open incident closes once its condition cleared AND no trigger
+    # merged into it for this long (recovery hysteresis)
+    incident_quiet_close_s: float = 120.0
+    # half-width of the time window digests use to correlate cluster
+    # events / decisions / launches around an incident
+    incident_event_window_s: float = 120.0
+    # WORKER_DIED burst gate: this many deaths on one node inside
+    # incident_burst_window_s collapse into ONE WORKER_KILL_STORM
+    # incident (a single death is routine churn, never an incident)
+    incident_worker_died_burst: int = 3
+    incident_burst_window_s: float = 30.0
+    # declarative SLOs loaded at startup: a JSON list of SLO specs
+    # ({name, kind, target, budget, threshold, fast_window_s,
+    # slow_window_s, subject, severity, params}), or "@/path/to/file.json"
+    slo_config: str = ""
+    # comma-separated alert sinks: "file:<path>" (one JSON line per
+    # alert) and/or "webhook:<url>" (POST from a daemon thread)
+    alert_sinks: str = ""
     # --- misc ---
     session_dir_root: str = "/tmp/ray_tpu_sessions"
     log_to_driver: bool = True

@@ -626,10 +626,12 @@ class Scheduler:
         # threads (memory monitor, driver watchdog rpcs)
         self._cluster_event_lock = threading.Lock()
         # per-function completed runtimes (bounded) feeding the straggler
-        # watchdog's p95; flagged set keyed (task_id, attempt) so a retry
+        # watchdog's p95; dedup gate keyed (task_id, attempt) so a retry
         # can be re-flagged but one attempt fires at most once
+        from ray_tpu._private.telemetry import EventDeduper as _EventDeduper
+
         self._func_runtimes: Dict[str, Deque[float]] = {}
-        self._straggler_flagged: Set[Tuple[TaskID, int]] = set()
+        self._straggler_dedup = _EventDeduper(rearm_s=None, max_keys=1024)
         # tasks that entered RUNNING and have not been observed settled:
         # the straggler scan walks THIS set (pruning settled ids lazily),
         # not the never-pruned self.tasks table — O(running), not O(ever)
@@ -672,12 +674,12 @@ class Scheduler:
         self._prov_dropped = 0
         # leak watchdog: per-callsite (count, bytes) history over the last
         # `leak_watchdog_window` scans; callsites currently flagged; event
-        # dedup stamps so one leaking site emits at most one
+        # dedup gate so one leaking site emits at most one
         # OBJECT_LEAK_SUSPECT per re-arm period
         self._leak_history: Dict[str, Deque[Tuple[int, int]]] = {}
         self._leak_suspects: Dict[str, dict] = {}
         self._leak_events_total = 0
-        self._leak_last_event: Dict[str, float] = {}
+        self._leak_dedup = _EventDeduper(rearm_s=60.0, max_keys=1024)
         # object classification from the last scan (IN_USE /
         # PINNED_BY_DEAD_OWNER / CAPTURED_IN_ACTOR / LEAK_SUSPECT):
         # oid hex -> class, plus the aggregate per-class counts
@@ -780,9 +782,9 @@ class Scheduler:
         self._slow_link_events = 0
         self._xfer_load_peak = 0
         self._last_netscan = time.monotonic()
-        # event dedup stamps: stall per (oid, dest), slow per link
-        self._net_stall_last_event: Dict[Tuple, float] = {}
-        self._slow_link_last_event: Dict[Tuple, float] = {}
+        # event dedup gates: stall per (oid, dest), slow per link
+        self._net_stall_dedup = _EventDeduper(rearm_s=30.0, max_keys=2048)
+        self._slow_link_dedup = _EventDeduper(rearm_s=60.0, max_keys=1024)
         # ---- control-plane observability (actor-launch lifecycle +
         # worker-pool telemetry + decision flight recorder; see DESIGN_MAP
         # "Control-plane observability") ----
@@ -829,9 +831,20 @@ class Scheduler:
         self._launch_done_total = 0
         # launch watchdog: (actor hex, stage) pairs already flagged so a
         # stuck creation fires ACTOR_LAUNCH_STALLED at most once per stage
-        self._launch_flagged: Set[Tuple[str, str]] = set()
+        self._launch_dedup = _EventDeduper(rearm_s=None, max_keys=1024)
         self._launch_stalled_total = 0
         self._last_launch_scan = time.monotonic()
+        # ---- alerting & incident-forensics plane (SLO burn-rate
+        # evaluation + cross-plane root-cause digests; see DESIGN_MAP
+        # "Alerting & incidents") ----
+        self._incident_mgr = None
+        if getattr(config, "incident_plane_enabled", True) and getattr(
+            config, "telemetry_enabled", True
+        ):
+            from ray_tpu._private.incidents import IncidentManager
+
+            self._incident_mgr = IncidentManager(self, config)
+        self._last_incident_scan = time.monotonic()
         # head node's own object server address + instance (set by HeadServer)
         self.head_object_addr = None
         self.head_object_server = None
@@ -2052,10 +2065,8 @@ class Scheduler:
             stalled_for = now_m - meta["seen_t"]
             if stalled_for < warn_s:
                 continue
-            last = self._net_stall_last_event.get(key, 0.0)
-            if now_m - last < 30.0:
+            if not self._net_stall_dedup.should_fire(key, now_m):
                 continue
-            self._net_stall_last_event[key] = now_m
             self._xfer_stalled_total += 1
             src_l = self._node_label(entry[0])
             dst_l = self._node_label(dest)
@@ -2077,12 +2088,9 @@ class Scheduler:
                 stalled_s=round(stalled_for, 1),
                 trace_id=trace[0] if trace else None,
             )
-        for k in [
-            k
-            for k, t in self._net_stall_last_event.items()
-            if k not in self._fetching and now_m - t > 300.0
-        ]:
-            del self._net_stall_last_event[k]
+        self._net_stall_dedup.prune(
+            keep=lambda k: k in self._fetching, stale_s=300.0, now=now_m
+        )
         # slow links: EWMA vs fleet median over socket/relay links with
         # enough samples. Needs >= 2 comparable links — a single link has
         # no fleet to be slower than (calm clusters stay silent).
@@ -2104,10 +2112,8 @@ class Scheduler:
             row["slow"] = slow
             if not slow:
                 continue
-            last = self._slow_link_last_event.get(key, 0.0)
-            if now_m - last < 60.0:
+            if not self._slow_link_dedup.should_fire(key, now_m):
                 continue
-            self._slow_link_last_event[key] = now_m
             self._slow_link_events += 1
             exemplars = [
                 r
@@ -3311,6 +3317,12 @@ class Scheduler:
             self._maybe_launch_scan()
         except Exception:
             logger.exception("launch watchdog scan failed")
+        # alerting plane: 1 Hz SLO burn-rate evaluation + incident
+        # lifecycle (open/merge/close + digest assembly)
+        try:
+            self._maybe_incident_scan()
+        except Exception:
+            logger.exception("incident scan failed")
         # multi-tenant job plane: drain the admission queue while backlog
         # allows, then scan for starved high-priority work to preempt for
         # (both rate-limit themselves; see DESIGN_MAP "Multi-tenant job
@@ -4014,9 +4026,7 @@ class Scheduler:
         )
         # the watchdog's per-stage dedup entries are dead now
         ahex = actor.actor_id.hex()
-        self._launch_flagged = {
-            kf for kf in self._launch_flagged if kf[0] != ahex
-        }
+        self._launch_dedup.prune(keep=lambda kf: kf[0] != ahex)
 
     _CREATION_WORKER_STAGES = ("runtime_env_ms", "actor_class_load_ms")
 
@@ -5995,6 +6005,21 @@ class Scheduler:
             rows = list(self._cluster_events)
             limit = args[0] if args and isinstance(args[0], int) else None
             job_hex = args[1] if len(args) > 1 else None
+            # server-side tail cursor (events --follow): only events with
+            # id beyond the caller's horizon / newer than since_ts — the
+            # executor's internal event-id polling, exposed
+            after_event_id = args[2] if len(args) > 2 else None
+            since_ts = args[3] if len(args) > 3 else None
+            if after_event_id is not None:
+                rows = [
+                    ev
+                    for ev in rows
+                    if ev.get("event_id", 0) > int(after_event_id)
+                ]
+            if since_ts is not None:
+                rows = [
+                    ev for ev in rows if ev.get("time", 0) >= float(since_ts)
+                ]
             if job_hex:
                 # job attribution filter: explicit job_id field, or the
                 # job nested in the event's task/actor id (ids.py layout)
@@ -6059,6 +6084,40 @@ class Scheduler:
             )
         if op == "hung_get_digest":
             return self.hung_get_digest(list(args[0]))
+        if op == "list_incidents":
+            # alerting plane: bounded incident summaries, newest first,
+            # state/kind filters pushed server-side
+            if self._incident_mgr is None:
+                return []
+            limit = args[0] if args and isinstance(args[0], int) else None
+            state = args[1] if len(args) > 1 else None
+            kind = args[2] if len(args) > 2 else None
+            return self._incident_mgr.list_incidents(limit, state, kind)
+        if op == "incident":
+            # one incident's full record incl. the cross-plane digest
+            # (re-joined live for open incidents)
+            if self._incident_mgr is None:
+                return None
+            return self._incident_mgr.get(str(args[0]))
+        if op == "list_slos":
+            return (
+                [] if self._incident_mgr is None
+                else self._incident_mgr.list_slos()
+            )
+        if op == "register_slo":
+            if self._incident_mgr is None:
+                raise ValueError("incident plane disabled")
+            return self._incident_mgr.register_slo(dict(args[0]))
+        if op == "remove_slo":
+            if self._incident_mgr is None:
+                return False
+            return self._incident_mgr.remove_slo(str(args[0]))
+        if op == "doctor":
+            # one-shot cluster health digest (`ray_tpu doctor`)
+            if self._incident_mgr is None:
+                return {"healthy": None, "open_incidents": [], "slos": [],
+                        "error": "incident plane disabled"}
+            return self._incident_mgr.doctor_digest()
         raise ValueError(f"unknown rpc {op}")
 
     @staticmethod
@@ -6495,6 +6554,13 @@ class Scheduler:
                 self._cluster_event_counts.get(etype, 0) + 1
             )
             self._cluster_events.append(ev)
+        # incident-plane trigger intake: a bounded any-thread enqueue (the
+        # heavy join happens on the loop's 1 Hz incident scan)
+        if self._incident_mgr is not None:
+            try:
+                self._incident_mgr.note_event(ev)
+            except Exception:
+                pass
         if ev.get("severity") == "ERROR":
             logger.warning(
                 "cluster event %s: %s", etype, ev.get("message", "")
@@ -6594,7 +6660,7 @@ class Scheduler:
                 self._running_watch.discard(tid)  # settled since: lazy prune
                 continue
             key = (rec.spec.task_id, rec.attempt)
-            if key in self._straggler_flagged:
+            if key in self._straggler_dedup:
                 continue
             hist = self._func_runtimes.get(rec.spec.name or "unnamed")
             if hist is None or len(hist) < min_samples:
@@ -6605,7 +6671,7 @@ class Scheduler:
             elapsed = now - rec.start_time
             if elapsed <= threshold:
                 continue
-            self._straggler_flagged.add(key)
+            self._straggler_dedup.mark(key, now)
             self._straggler_count += 1
             w = self.workers.get(rec.worker_id) if rec.worker_id else None
             self.record_cluster_event(
@@ -6623,13 +6689,10 @@ class Scheduler:
                 pid=w.proc.pid if w is not None and w.proc is not None else None,
             )
         # flagged entries for settled tasks can't fire again; prune so the
-        # set tracks live suspicion, not history
-        if len(self._straggler_flagged) > 256:
-            self._straggler_flagged = {
-                (tid, att)
-                for tid, att in self._straggler_flagged
-                if tid in self._running_watch
-            }
+        # gate tracks live suspicion, not history
+        self._straggler_dedup.prune(
+            keep=lambda k: k[0] in self._running_watch, now=now, over=256
+        )
 
     def _maybe_launch_scan(self) -> None:
         """Launch watchdog: an actor creation stuck in ONE lifecycle stage
@@ -6652,9 +6715,9 @@ class Scheduler:
             if since is None or wall - since <= warn_s:
                 continue
             key = (actor.actor_id.hex(), stage)
-            if key in self._launch_flagged:
+            if key in self._launch_dedup:
                 continue
-            self._launch_flagged.add(key)
+            self._launch_dedup.mark(key)
             self._launch_stalled_total += 1
             spec = actor.creation_spec
             w = self.workers.get(actor.worker_id) if actor.worker_id else None
@@ -6675,15 +6738,28 @@ class Scheduler:
                 runtime_env_digest=env_digest,
                 trace_id=actor.launch_trace,
             )
-        if len(self._launch_flagged) > 256:
+        if len(self._launch_dedup) > 256:
             live = {
                 a.actor_id.hex()
                 for a in self.actors.values()
                 if a.state == "PENDING"
             }
-            self._launch_flagged = {
-                kf for kf in self._launch_flagged if kf[0] in live
-            }
+            self._launch_dedup.prune(keep=lambda kf: kf[0] in live)
+
+    def _maybe_incident_scan(self) -> None:
+        """Alerting plane: 1 Hz SLO burn-rate evaluation + incident
+        open/merge/close with cross-plane digest assembly.  Runs ON the
+        loop inside the existing maintenance pass, so every plane read
+        (latency windows, link ledger, step index, provenance) is
+        race-free; trigger events arrive through the bounded note_event
+        queue."""
+        if self._incident_mgr is None:
+            return
+        now = time.monotonic()
+        if now - self._last_incident_scan < 1.0:
+            return
+        self._last_incident_scan = now
+        self._incident_mgr.scan()
 
     def hung_get_digest(self, oid_hexes: List[str]) -> str:
         """Forensic digest for a blocked get(): each pending object's
@@ -7164,9 +7240,7 @@ class Scheduler:
                 ),
             }
             suspects[cs] = info
-            last = self._leak_last_event.get(cs, 0.0)
-            if now_w - last >= 60.0:
-                self._leak_last_event[cs] = now_w
+            if self._leak_dedup.should_fire(cs, now_w):
                 self._leak_events_total += 1
                 self.record_cluster_event(
                     "OBJECT_LEAK_SUSPECT",
@@ -8144,6 +8218,85 @@ class Scheduler:
             "head-committed task results",
             {lk(): self._commit_count},
         )
+        # ---- alerting & incidents plane ----
+        mgr = self._incident_mgr
+        if mgr is not None:
+            open_by_kind: Dict[str, int] = {}
+            for row in mgr.list_incidents(state="open"):
+                open_by_kind[row["kind"]] = open_by_kind.get(row["kind"], 0) + 1
+            add(
+                "ray_tpu_incidents_open",
+                "gauge",
+                "currently-open incidents per kind (alerting plane)",
+                {lk(kind=k): n for k, n in sorted(open_by_kind.items())}
+                or {lk(): 0},
+            )
+            add(
+                "ray_tpu_incidents_total",
+                "counter",
+                "incidents ever opened per kind",
+                {lk(kind=k): n for k, n in sorted(mgr.opened_total.items())}
+                or {lk(): 0},
+            )
+            add(
+                "ray_tpu_incidents_closed_total",
+                "counter",
+                "incidents closed with a measured duration and verdict",
+                {lk(): mgr.closed_total},
+            )
+            add(
+                "ray_tpu_incident_open_seconds_max",
+                "gauge",
+                "age of the oldest currently-open incident",
+                {lk(): round(mgr.oldest_open_age(), 3)},
+            )
+            burn: Dict[str, float] = {}
+            ok: Dict[str, float] = {}
+            for row in mgr.list_slos():
+                ok[lk(slo=row["name"])] = 1 if row.get("ok") else 0
+                worst = row.get("worst") or {}
+                for win in ("fast", "slow"):
+                    v = worst.get(f"burn_{win}")
+                    if v is not None:
+                        burn[lk(slo=row["name"], window=win)] = v
+            if ok:
+                add(
+                    "ray_tpu_slo_ok",
+                    "gauge",
+                    "1 while the SLO is within budget on every subject, "
+                    "0 while any subject is breached",
+                    ok,
+                )
+            if burn:
+                add(
+                    "ray_tpu_slo_burn_rate",
+                    "gauge",
+                    "worst-subject error-budget burn rate per SLO and "
+                    "evaluation window (>= threshold on BOTH windows "
+                    "breaches)",
+                    burn,
+                )
+            add(
+                "ray_tpu_slo_breaches_total",
+                "counter",
+                "multi-window burn-rate breaches per SLO",
+                {
+                    lk(slo=name): n
+                    for name, n in sorted(mgr._slo_breaches.items())
+                }
+                or {lk(): 0},
+            )
+            sink_counts = {
+                lk(sink=name): n
+                for name, n in sorted(mgr.sinks.emitted.items())
+            }
+            add(
+                "ray_tpu_alerts_emitted_total",
+                "counter",
+                "alert payloads delivered per configured sink "
+                "(open + close notifications)",
+                sink_counts or {lk(): 0},
+            )
         return series
 
     def _terminate_worker(self, w: WorkerState):

@@ -551,6 +551,101 @@ class LatencyWindow:
         return self._live()
 
 
+# --------------------------------------------------------------------------
+# bounded once-per-key event gate (watchdog / incident dedup)
+# --------------------------------------------------------------------------
+
+
+class EventDeduper:
+    """Bounded once-per-key-per-rearm event gate.
+
+    One helper behind every watchdog's "emit this event at most once per
+    key per re-arm window" rule (leak suspects, transfer stalls, slow
+    links, stalled launches, incident alerts) — each used to carry its own
+    ad-hoc stamp dict/set with divergent growth and clearing rules.
+
+    Semantics:
+      * ``should_fire(key)`` — True iff the key has never fired, or fired
+        more than ``rearm_s`` seconds ago (``rearm_s=None`` = fire-once
+        per key, ever). A True return stamps the key.
+      * ``key in deduper`` / ``mark(key)`` — split check/stamp for callers
+        that decide membership early but only stamp on an actual emit.
+      * bounded two ways: ``mark`` past ``max_keys`` evicts the
+        oldest-stamped key (an adversarial key stream cannot grow the
+        table), and ``prune(keep=...)`` applies the owning watchdog's
+        liveness rule (drop stamps for settled subjects), optionally only
+        for stamps older than ``stale_s``.
+
+    Single-threaded by design: every current caller runs on the scheduler
+    loop's 1 Hz maintenance pass.
+    """
+
+    __slots__ = ("_rearm_s", "_max", "_stamps")
+
+    def __init__(self, rearm_s: Optional[float] = None, max_keys: int = 1024):
+        self._rearm_s = None if rearm_s is None else float(rearm_s)
+        self._max = max(1, int(max_keys))
+        # insertion-ordered key -> monotonic stamp; re-marks move to end,
+        # so the front is always the oldest stamp (O(1) eviction)
+        self._stamps: "collections.OrderedDict[Any, float]" = (
+            collections.OrderedDict()
+        )
+
+    def __len__(self) -> int:
+        return len(self._stamps)
+
+    def __contains__(self, key) -> bool:
+        return key in self._stamps
+
+    def mark(self, key, now: Optional[float] = None) -> None:
+        """Stamp ``key`` as fired now (evicting the oldest past the cap)."""
+        now = time.monotonic() if now is None else now
+        if key in self._stamps:
+            del self._stamps[key]
+        elif len(self._stamps) >= self._max:
+            self._stamps.popitem(last=False)
+        self._stamps[key] = now
+
+    def discard(self, key) -> None:
+        self._stamps.pop(key, None)
+
+    def should_fire(self, key, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        last = self._stamps.get(key)
+        if last is not None and (
+            self._rearm_s is None or now - last < self._rearm_s
+        ):
+            return False
+        self.mark(key, now)
+        return True
+
+    def prune(
+        self,
+        keep=None,
+        stale_s: Optional[float] = None,
+        now: Optional[float] = None,
+        over: int = 0,
+    ) -> int:
+        """Apply the owner's liveness rule: drop stamps whose key fails
+        ``keep(key)`` — but only stamps older than ``stale_s`` when given
+        (a just-fired stamp for a briefly-absent subject survives). With
+        ``over`` > 0 the sweep is skipped until the table exceeds that many
+        entries (the cheap "only bother when big" pattern the hand-rolled
+        copies used). Returns the number of dropped stamps."""
+        if over and len(self._stamps) <= over:
+            return 0
+        now = time.monotonic() if now is None else now
+        doomed = [
+            k
+            for k, t in self._stamps.items()
+            if (keep is None or not keep(k))
+            and (stale_s is None or now - t > stale_s)
+        ]
+        for k in doomed:
+            del self._stamps[k]
+        return len(doomed)
+
+
 def dropped_total() -> int:
     return _buffer.dropped_total
 

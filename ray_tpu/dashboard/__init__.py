@@ -11,6 +11,8 @@ stdlib HTTP server in the driver serves a dependency-free single-page UI
   /api/tasks            task table            /api/actors     actor table
   /api/objects          object store          /api/jobs       job table
   /api/events           cluster event log (failure forensics)
+  /api/incidents        alerting plane: incidents + SLO burn status
+  /api/doctor           one-shot cluster health digest
   /api/launch           actor-launch lifecycle profile (control plane)
   /api/decisions        scheduler/autoscaler decision flight recorder
   /api/stacks           thread stacks of driver + every node daemon
@@ -184,6 +186,32 @@ def start_dashboard(port: int = 8765) -> int:
                         ),
                         "summary": drv.rpc("summarize_transfers", "path", 20),
                     }
+                elif urlparse(self.path).path == "/api/incidents":
+                    # alerting plane: incident summaries + registered SLO
+                    # burn status, plus one full digest when ?id= is given
+                    # (head-side state, no worker flush needed)
+                    from ray_tpu._private.worker import get_driver
+
+                    q = parse_qs(urlparse(self.path).query)
+                    drv = get_driver()
+                    inc_id = q.get("id", [None])[0]
+                    if inc_id:
+                        body = drv.rpc("incident", inc_id)
+                    else:
+                        body = {
+                            "incidents": drv.rpc(
+                                "list_incidents",
+                                int(q.get("limit", ["100"])[0]),
+                                q.get("state", [None])[0],
+                                None,
+                            ),
+                            "slos": drv.rpc("list_slos"),
+                        }
+                elif urlparse(self.path).path == "/api/doctor":
+                    # one-shot health digest (`ray_tpu doctor` payload)
+                    from ray_tpu._private.worker import get_driver
+
+                    body = get_driver().rpc("doctor")
                 elif urlparse(self.path).path == "/api/decisions":
                     # decision flight recorder: scheduler placement +
                     # autoscaler reconcile decisions (head-side ring, no

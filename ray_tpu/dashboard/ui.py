@@ -55,10 +55,10 @@ PAGE = """<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const TABS = ["overview","node_stats","metrics","tasks","actors","launch",
-              "decisions","objects","memory","network","placement_groups",
-              "serve","jobs","train","logs","events","event_stats","traces",
-              "latency","stacks","profile"];
+const TABS = ["overview","incidents","node_stats","metrics","tasks","actors",
+              "launch","decisions","objects","memory","network",
+              "placement_groups","serve","jobs","train","logs","events",
+              "event_stats","traces","latency","stacks","profile"];
 // hash may carry a selection suffix, e.g. "#traces:<trace_id>"
 let tab = (location.hash.slice(1) || "overview").split(":")[0] || "overview";
 window.addEventListener("hashchange", () => {
@@ -343,6 +343,72 @@ const RENDER = {
       ).join("") + "</table>";
   },
   async logs() { return table(await j("/api/logs")); },
+  async incidents() {
+    // alerting plane: open/closed incidents + registered SLO burn status;
+    // "#incidents:<id>" drills into one record's cross-plane digest
+    const sel = (location.hash.slice(1).split(":")[1] || "");
+    if (sel) {
+      const inc = await j("/api/incidents?id=" + encodeURIComponent(sel));
+      if (!inc) return "<p class='meta'>no such incident</p>";
+      const d = inc.digest || {};
+      let html = `<h2>${esc(inc.id)} [${esc(inc.kind)}] ` +
+        `${esc(inc.subject)}</h2>` +
+        `<p>state=${esc(inc.state)} severity=${esc(inc.severity)} ` +
+        `triggers=${inc.count}` +
+        (inc.duration_s != null ? ` duration=${inc.duration_s}s` : "") +
+        `</p>` +
+        (inc.verdict ? `<p><b>verdict:</b> ${esc(inc.verdict)}</p>` : "") +
+        `<p>planes joined: ${esc((d.planes||[]).join(", "))}</p>`;
+      if (d.traces && d.traces.length)
+        html += "<h2>exemplar traces</h2>" + table(d.traces);
+      if (d.net && d.net.links && d.net.links.length)
+        html += "<h2>link ledger</h2>" + table(d.net.links,
+          ["src","dst","path","ewma_gib_per_s","stalls","failures","slow"]);
+      if (d.memory && d.memory.top_callsites)
+        html += "<h2>memory top callsites</h2>" +
+          table(d.memory.top_callsites);
+      if (d.train) html += "<h2>train run</h2>" + table([d.train]);
+      if (d.control && d.control.launches)
+        html += "<h2>recent launches</h2>" + table(d.control.launches);
+      if (d.events && d.events.length)
+        html += "<h2>correlated events</h2>" +
+          table(d.events.slice(-30).reverse(),
+                ["time","severity","type","source","message"]);
+      return html + `<p><a href="#incidents" onclick="go('incidents')">` +
+        `back to incident list</a></p>`;
+    }
+    const body = await j("/api/incidents?limit=100");
+    const incRows = (body.incidents || []).map(r => ({
+      id: `<a href="#incidents:${esc(r.id)}" ` +
+          `onclick="location.hash='incidents:${esc(r.id)}';refresh()">` +
+          `${esc(r.id)}</a>`,
+      state: r.state, kind: r.kind, subject: r.subject,
+      triggers: r.count,
+      duration_s: r.duration_s != null ? r.duration_s : "open",
+      planes: (r.planes || []).join(","),
+      verdict: r.verdict || "",
+    }));
+    const sloRows = (body.slos || []).map(s => ({
+      name: s.name, kind: s.kind, target: s.target,
+      state: s.ok ? "OK" : "BREACHED",
+      subjects: s.subjects, breaches: s.breaches_total,
+      worst: s.worst ? JSON.stringify(s.worst) : "",
+    }));
+    // id cells carry markup: render with a raw table to keep the links
+    const raw = (rows, cols) => !rows.length ? "<p class='meta'>none</p>" :
+      "<table><tr>" + cols.map(c=>`<th>${c}</th>`).join("") + "</tr>" +
+      rows.map(r => "<tr>" + cols.map(c => {
+        const cls = (c === "state")
+          ? (/open|BREACHED/.test(String(r[c])) ? "bad" : "ok") : "";
+        return `<td class="${cls}">${c==="id" ? r[c] : esc(r[c]??"")}</td>`;
+      }).join("") + "</tr>").join("") + "</table>";
+    return `<h2>incidents (${incRows.length})</h2>` +
+      raw(incRows, ["id","state","kind","subject","triggers","duration_s",
+                    "planes","verdict"]) +
+      `<h2>SLOs (${sloRows.length})</h2>` +
+      raw(sloRows, ["name","state","kind","target","subjects","breaches",
+                    "worst"]);
+  },
   async events() {
     // cluster event log (failure forensics): newest first, severity colored
     const rows = await j("/api/events?limit=500");

@@ -70,6 +70,19 @@ def initialize(
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
             pass
+        # a reused pool worker may already have run a jax computation
+        # (backend init is process-wide and first-use);
+        # jax.distributed.initialize refuses once backends exist, so on
+        # the virtual-cpu path reset them — the cpu backend rebuilds
+        # cheaply and no device buffers can span the reset (this process
+        # has not joined a mesh yet)
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                xla_bridge._clear_backends()
+        except Exception:
+            pass
 
     kwargs = {}
     if local_device_ids is not None:

@@ -220,9 +220,43 @@ def cmd_memory(args):
         print("no leak suspects")
 
 
+def _parse_since(raw: str) -> float:
+    """``--since`` value -> wall timestamp: a duration suffixed s/m/h/d
+    (``10m`` = 10 minutes ago), a bare number of seconds ago, or an
+    absolute unix timestamp (values > 1e9)."""
+    import time as _time
+
+    raw = raw.strip()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(raw[-1:])
+    if mult is not None:
+        return _time.time() - float(raw[:-1]) * mult
+    v = float(raw)
+    return v if v > 1e9 else _time.time() - v
+
+
+def _print_event(ev: dict) -> None:
+    import time as _time
+
+    stamp = _time.strftime(
+        "%Y-%m-%d %H:%M:%S", _time.localtime(ev.get("time", 0))
+    )
+    where = " ".join(
+        f"{k}={ev[k]}"
+        for k in ("task_id", "node_id", "pid", "attempt")
+        if ev.get(k) is not None
+    )
+    print(
+        f"{stamp} {ev.get('severity', 'INFO'):<7} "
+        f"{ev.get('type', '?'):<16} [{ev.get('source', '?')}] "
+        f"{ev.get('message', '')}" + (f"  ({where})" if where else "")
+    )
+
+
 def cmd_events(args):
     """Cluster event log (failure forensics): WORKER_DIED, TASK_FAILED,
-    STRAGGLER, OOM, ... with severity/source/provenance."""
+    STRAGGLER, OOM, ... with severity/source/provenance. ``--follow``
+    tails the log via the server-side ``after_event_id`` cursor (only
+    events beyond the last-seen id cross the wire per poll)."""
     import time as _time
 
     from ray_tpu.util import state
@@ -233,30 +267,214 @@ def cmd_events(args):
         filters.append(("severity", "=", args.severity.upper()))
     if args.type:
         filters.append(("type", "=", args.type.upper()))
+    since_ts = _parse_since(args.since) if args.since else None
     rows = state.list_cluster_events(
         filters=filters or None,
         limit=args.limit,
         job_id=args.job_id or None,
+        since_ts=since_ts,
+    )
+    if args.json and not args.follow:
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    for ev in rows:
+        print(json.dumps(ev, default=str)) if args.json else _print_event(ev)
+    if not rows and not args.follow:
+        print("no cluster events recorded")
+        return
+    if not args.follow:
+        return
+    cursor = max((ev.get("event_id", 0) for ev in rows), default=0)
+    try:
+        while True:
+            _time.sleep(1.0)
+            fresh = state.list_cluster_events(
+                filters=filters or None,
+                limit=args.limit,
+                job_id=args.job_id or None,
+                after_event_id=cursor,
+            )
+            for ev in fresh:
+                cursor = max(cursor, ev.get("event_id", 0))
+                (print(json.dumps(ev, default=str)) if args.json
+                 else _print_event(ev))
+    except KeyboardInterrupt:
+        return
+
+
+def cmd_doctor(args):
+    """One-shot cluster health digest: open incidents (with verdicts as
+    they close), SLO burn status, top anomaly counters, store snapshot."""
+    from ray_tpu.util import state
+
+    _init(args)
+    d = state.doctor()
+    if args.json:
+        print(json.dumps(d, indent=2, default=str))
+        return
+    if d.get("error"):
+        print(f"doctor: {d['error']}")
+        return
+    verdict = "HEALTHY" if d.get("healthy") else "ATTENTION NEEDED"
+    print(f"== cluster health: {verdict} ==")
+    print(
+        f"  nodes: {d.get('nodes', '?')}  workers: {d.get('workers', '?')}"
+    )
+    store = d.get("store") or {}
+    if store.get("store_capacity_bytes"):
+        used = store.get("store_used_bytes", 0) or 0
+        cap = store["store_capacity_bytes"]
+        print(
+            f"  object store: {used / 2**20:.1f} / {cap / 2**20:.0f} MiB "
+            f"({100.0 * used / cap:.1f}%)"
+        )
+    open_rows = d.get("open_incidents") or []
+    print(f"== open incidents ({len(open_rows)}) ==")
+    for row in open_rows:
+        print(
+            f"  {row['id']:<8} {row['kind']:<22} {row['subject']:<28} "
+            f"x{row['count']}  planes={','.join(row.get('planes') or [])}"
+        )
+    closed = d.get("recently_closed") or []
+    if closed:
+        print(f"== recently closed ({len(closed)}) ==")
+        for row in closed:
+            print(
+                f"  {row['id']:<8} {row['kind']:<22} "
+                f"{row['duration_s'] or 0:.1f}s  {row.get('verdict') or ''}"
+            )
+    slos = d.get("slos") or []
+    print(f"== SLOs ({len(slos)}) ==")
+    for s in slos:
+        worst = s.get("worst") or {}
+        status = "OK" if s.get("ok") else "BREACHED"
+        burns = (
+            f"burn fast={worst.get('burn_fast')} slow={worst.get('burn_slow')}"
+            if worst
+            else "no data"
+        )
+        print(
+            f"  {s['name']:<24} {s['kind']:<26} {status:<9} "
+            f"target={s['target']:g}  {burns}"
+        )
+    wd = d.get("watchdogs") or {}
+    anomalies = {k: v for k, v in wd.items() if v}
+    if anomalies:
+        print(
+            "== watchdog totals == "
+            + "  ".join(f"{k}={v}" for k, v in sorted(anomalies.items()))
+        )
+    top = d.get("event_counts") or {}
+    if top:
+        print(
+            "== top events == "
+            + "  ".join(f"{k}={v}" for k, v in list(top.items())[:8])
+        )
+
+
+def cmd_incidents(args):
+    """Incident records: `incidents` lists them, `incidents show <id>`
+    prints one record's cross-plane digest."""
+    import time as _time
+
+    from ray_tpu.util import state
+
+    _init(args)
+    parts = list(args.incident_id or [])
+    if parts and parts[0] == "show":
+        parts = parts[1:]
+    incident_id = parts[0] if parts else None
+    if incident_id:
+        inc = state.get_incident(incident_id)
+        if inc is None:
+            print(f"no incident {incident_id}")
+            sys.exit(1)
+        if args.json:
+            print(json.dumps(inc, indent=2, default=str))
+            return
+        stamp = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(inc["opened_at"])
+        )
+        print(
+            f"{inc['id']} [{inc['kind']}] {inc['subject']}  "
+            f"state={inc['state']} severity={inc['severity']} "
+            f"source={inc['source']} opened={stamp} "
+            f"triggers={inc['count']}"
+        )
+        if inc.get("duration_s") is not None:
+            print(f"  duration: {inc['duration_s']:.1f}s")
+        if inc.get("verdict"):
+            print(f"  verdict: {inc['verdict']}")
+        digest = inc.get("digest") or {}
+        print(f"  planes joined: {', '.join(digest.get('planes') or [])}")
+        for tr in digest.get("traces") or []:
+            stages = ", ".join(
+                f"{k}={v}ms"
+                for k, v in sorted(
+                    (tr.get("stages") or {}).items(),
+                    key=lambda kv: -(kv[1] or 0),
+                )[:4]
+            )
+            print(
+                f"  trace {tr['trace_id'][:16]}: "
+                f"{tr.get('duration_ms')}ms over {tr.get('spans')} spans "
+                f"({stages})"
+            )
+        mem = digest.get("memory") or {}
+        for cs in (mem.get("top_callsites") or [])[:3]:
+            print(
+                f"  mem top: {cs.get('callsite')} = {cs.get('bytes')}B "
+                f"({cs.get('count')} objects)"
+            )
+        net = digest.get("net") or {}
+        for row in net.get("links") or []:
+            print(
+                f"  link {row['src']}->{row['dst']} ({row['path']}): "
+                f"{row.get('ewma_gib_per_s')} GiB/s, "
+                f"{row.get('stalls')} stalls, slow={row.get('slow')}"
+            )
+        if digest.get("train"):
+            t = digest["train"]
+            print(
+                f"  train run {t.get('run')}: goodput={t.get('goodput')} "
+                f"downtime={t.get('downtime_s')}s "
+                f"recompiles={t.get('recompiles')}"
+            )
+        ctl = digest.get("control") or {}
+        if ctl:
+            print(
+                f"  control: {len(ctl.get('decisions') or [])} decisions, "
+                f"{len(ctl.get('launches') or [])} launches, "
+                f"spawn_fail_streaks={ctl.get('spawn_fail_streaks') or {}}"
+            )
+        for ev in (inc.get("events") or [])[-5:]:
+            _print_event(ev)
+        return
+    rows = state.list_incidents(
+        limit=args.limit,
+        state=args.state or None,
+        kind=args.type.upper() if args.type else None,
     )
     if args.json:
         print(json.dumps(rows, indent=2, default=str))
         return
-    for ev in rows:
+    if not rows:
+        print("no incidents recorded")
+        return
+    for row in rows:
         stamp = _time.strftime(
-            "%Y-%m-%d %H:%M:%S", _time.localtime(ev.get("time", 0))
+            "%H:%M:%S", _time.localtime(row["opened_at"])
         )
-        where = " ".join(
-            f"{k}={ev[k]}"
-            for k in ("task_id", "node_id", "pid", "attempt")
-            if ev.get(k) is not None
+        dur = (
+            f"{row['duration_s']:.0f}s"
+            if row.get("duration_s") is not None
+            else "open"
         )
         print(
-            f"{stamp} {ev.get('severity', 'INFO'):<7} "
-            f"{ev.get('type', '?'):<16} [{ev.get('source', '?')}] "
-            f"{ev.get('message', '')}" + (f"  ({where})" if where else "")
+            f"{row['id']:<8} {stamp} {row['state']:<7} {row['kind']:<22} "
+            f"{row['subject']:<28} x{row['count']:<3} {dur:<6} "
+            f"{row.get('verdict') or ''}"
         )
-    if not rows:
-        print("no cluster events recorded")
 
 
 def cmd_actors(args):
@@ -984,9 +1202,46 @@ def main(argv=None):
         help="keep only events attributed to this job (job hex, "
         "explicit or embedded in the event's task/actor id)",
     )
+    p.add_argument(
+        "--since",
+        help="only events after this point: a duration back from now "
+        "(10m, 2h, 90s) or an absolute unix timestamp",
+    )
+    p.add_argument(
+        "--follow",
+        "-f",
+        action="store_true",
+        help="tail mode: keep polling for new events via the server-side "
+        "after_event_id cursor (ctrl-c to stop)",
+    )
     p.add_argument("--limit", type=int, default=200)
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "doctor",
+        help="one-shot cluster health digest: open incidents, SLO "
+        "burn-rate status, top anomalies",
+    )
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "incidents",
+        help="alerting-plane incident records (open/merge/close with "
+        "cross-plane root-cause digests)",
+    )
+    p.add_argument(
+        "incident_id",
+        nargs="*",
+        help="show one incident's digest (`incidents <id>` or "
+        "`incidents show <id>`)",
+    )
+    p.add_argument("--state", choices=["open", "closed"])
+    p.add_argument("--type", help="filter: SLOW_LINK, SLO_BREACH, ...")
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_incidents)
 
     p = sub.add_parser(
         "train",

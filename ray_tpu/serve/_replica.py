@@ -50,6 +50,12 @@ def _replica_metrics() -> dict:
             "requests executed per deployment",
             tag_keys=("deployment", "method"),
         )
+        _metrics["ttft"] = Histogram(
+            "ray_tpu_serve_ttft_ms",
+            "streaming time-to-first-token per deployment (request "
+            "admitted -> first item yielded) — the stream-TTFT SLO input",
+            tag_keys=("deployment", "method"),
+        )
     return _metrics
 
 
@@ -379,9 +385,18 @@ class Replica:
                     if items == 0:
                         # TTFT: request admitted -> first item yielded (the
                         # streaming span's headline stage)
-                        span_extras["ttft_ms"] = round(
-                            (_time.perf_counter() - t0) * 1e3, 3
-                        )
+                        ttft_ms = round((_time.perf_counter() - t0) * 1e3, 3)
+                        span_extras["ttft_ms"] = ttft_ms
+                        try:
+                            _replica_metrics()["ttft"].observe(
+                                ttft_ms,
+                                tags={
+                                    "deployment": self._deployment,
+                                    "method": method,
+                                },
+                            )
+                        except Exception:
+                            pass
                     items += 1
                     yield item
                 span_extras["stream_items"] = items

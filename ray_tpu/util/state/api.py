@@ -143,7 +143,11 @@ def backlog_summary() -> dict:
 
 
 def list_cluster_events(
-    filters=None, limit: int = 10_000, job_id: Optional[str] = None
+    filters=None,
+    limit: int = 10_000,
+    job_id: Optional[str] = None,
+    after_event_id: Optional[int] = None,
+    since_ts: Optional[float] = None,
 ) -> List[dict]:
     """Structured cluster events — WORKER_DIED, NODE_DEAD, TASK_RETRY,
     TASK_FAILED, LEASE_FAILED, OBJECT_LOST, OOM, PREEMPTED, STRAGGLER,
@@ -151,8 +155,11 @@ def list_cluster_events(
     ``ray.util.state.list_cluster_events``). ``job_id=`` (job hex) keeps
     only events attributed to that job — matching an explicit ``job_id``
     field or the job embedded in the event's task/actor id; the filter
-    runs server-side, so the cap applies after it. Flushes the telemetry
-    plane first so worker/serve-recorded events are read-your-writes."""
+    runs server-side, so the cap applies after it. ``after_event_id=`` is
+    a server-side tail cursor (only events beyond that id — the backbone
+    of ``ray_tpu events --follow``); ``since_ts=`` keeps events at or
+    after a wall timestamp. Flushes the telemetry plane first so
+    worker/serve-recorded events are read-your-writes."""
     rt = get_runtime()
     if hasattr(rt, "scheduler"):
         from ray_tpu._private import telemetry
@@ -162,9 +169,79 @@ def list_cluster_events(
             rt.scheduler.request_telemetry_flush()
         except Exception:
             pass
-    return _filtered(_rpc("list_cluster_events", limit, job_id), filters)[
+    return _filtered(
+        _rpc("list_cluster_events", limit, job_id, after_event_id, since_ts),
+        filters,
+    )[:limit]
+
+
+def list_incidents(
+    filters=None,
+    limit: int = 1000,
+    state: Optional[str] = None,
+    kind: Optional[str] = None,
+) -> List[dict]:
+    """Alerting-plane incident summaries, newest first: ``{id, kind,
+    subject, state (open|closed), severity, source (watchdog|slo), slo,
+    opened_at, closed_at, duration_s, count, planes, verdict}``.
+    ``state=``/``kind=`` filter server-side; ``filters=`` applies the
+    standard client-side tuples on top. The full record (cross-plane
+    digest included) comes from :func:`get_incident`."""
+    return _filtered(_rpc("list_incidents", limit, state, kind), filters)[
         :limit
     ]
+
+
+def get_incident(incident_id: str) -> Optional[dict]:
+    """One incident's full record: the summary fields plus the trigger
+    events and the cross-plane ``digest`` (correlated cluster events,
+    exemplar traces with stage breakdowns, memory snapshot, link-ledger /
+    goodput-ledger / decision-ring slices — ``digest["planes"]`` lists
+    the non-empty sections). Open incidents re-join the planes at read
+    time, so the view is live."""
+    return _rpc("incident", incident_id)
+
+
+def list_slos(filters=None, limit: int = 1000) -> List[dict]:
+    """Registered SLOs with live burn-rate status: the spec fields plus
+    ``subjects`` (observed subject count), ``ok``, ``breaches_total``,
+    and ``worst`` (the worst subject's fast/slow burns + detail)."""
+    return _filtered(_rpc("list_slos"), filters)[:limit]
+
+
+def register_slo(
+    name: str,
+    kind: str,
+    target: float,
+    **kwargs,
+) -> dict:
+    """Register (or replace) one declarative SLO. ``kind`` is one of
+    ``job_latency_p99`` / ``deployment_latency_p99`` /
+    ``deployment_availability`` / ``deployment_ttft_p99`` /
+    ``train_goodput_floor`` / ``link_throughput_floor`` /
+    ``actor_launch_rate_floor``; keyword extras: ``budget`` (tolerated
+    bad fraction, default 0.1), ``threshold`` (burn multiple, default
+    1.0), ``fast_window_s``/``slow_window_s`` (multi-window burn-rate
+    evaluation), ``subject`` (None = every observed subject),
+    ``severity``, ``params``. Evaluated at 1 Hz on the scheduler's
+    maintenance pass; a breach opens an incident."""
+    return _rpc(
+        "register_slo",
+        {"name": name, "kind": kind, "target": target, **kwargs},
+    )
+
+
+def remove_slo(name: str) -> bool:
+    return _rpc("remove_slo", name)
+
+
+def doctor() -> dict:
+    """One-shot cluster health digest (the ``ray_tpu doctor`` payload):
+    ``healthy``, open incidents, recently-closed verdicts, SLO status,
+    top event counts, watchdog totals, and the store snapshot. Flushes
+    the telemetry plane first for a current view."""
+    _flush_for_read()
+    return _rpc("doctor")
 
 
 def list_jobs(filters=None, limit: int = 10_000) -> List[dict]:

@@ -1,0 +1,262 @@
+"""Incident-plane chaos acceptance (slow; part of `make chaos`).
+
+Seeded fault injections, each of which must produce EXACTLY ONE incident
+whose cross-plane digest joins >= 3 planes and whose close verdict names
+the true injected cause:
+
+  * a throttled link among healthy peers  -> one SLOW_LINK incident
+    (events + memory + net), verdict naming the degraded link;
+  * a worker SIGKILL storm               -> one WORKER_KILL_STORM
+    incident (events + memory + control) — burst-gated, not one page
+    per death — verdict naming the kill burst on the node;
+  * a grow-only object leak              -> one OBJECT_LEAK_SUSPECT
+    incident (events + traces + memory), verdict naming the leaking
+    callsite.
+
+Plus the calm-run control: the same cluster under sustained mixed load
+opens ZERO incidents (no alert noise on healthy clusters).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu.util import state
+
+pytestmark = pytest.mark.slow
+
+
+def _sch():
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().node.scheduler
+
+
+@pytest.fixture
+def chaos_cluster():
+    """Incident knobs tightened to converge inside a test budget: 3s
+    quiet-close, leak watchdog at 0.1s scans with small growth floors."""
+    rt = ray_tpu.init(
+        num_cpus=4,
+        ignore_reinit_error=True,
+        _system_config={
+            "incident_quiet_close_s": 3.0,
+            "incident_event_window_s": 60.0,
+            "leak_watchdog_interval_s": 0.1,
+            "leak_watchdog_window": 5,
+            "leak_watchdog_min_growth_bytes": 50_000,
+            "leak_watchdog_min_count_growth": 3,
+            "metrics_report_interval_ms": 50,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=60.0, interval=0.25, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _feed_link(sch, src, dst, gibps, n=4, nbytes=8 * 1024 * 1024):
+    """Synthesize n completed socket transfers at a given rate (the
+    netplane test harness's injection point: the scheduler's own
+    transfer-completion bookkeeping)."""
+    wire_ms = nbytes / 2**30 / gibps * 1e3
+    for _ in range(n):
+        oid = ObjectID.from_random()
+        sch._fetching[(oid, dst)] = (src, True)
+        sch._xfer_complete(
+            oid, dst, True,
+            stats={"path": "socket", "bytes": nbytes, "wire_ms": wire_ms,
+                   "total_ms": wire_ms, "t0": time.time()},
+        )
+
+
+def test_slow_link_incident_lifecycle(chaos_cluster):
+    """Throttled link among healthy peers: ONE SLOW_LINK incident opens
+    with a >=3-plane digest, and recovering the link closes it with a
+    verdict naming the degraded wire throughput."""
+    sch = _sch()
+    head = sch._node.head_node_id
+    nodes = [NodeID.from_random() for _ in range(4)]
+    for dst in nodes[:3]:
+        _feed_link(sch, head, dst, gibps=2.0)
+    _feed_link(sch, nodes[0], nodes[3], gibps=0.05, n=6)  # ~40x slower
+
+    inc = _wait(
+        lambda: next(iter(state.list_incidents(kind="SLOW_LINK")), None),
+        msg="SLOW_LINK incident",
+    )
+    assert len(state.list_incidents(kind="SLOW_LINK")) == 1
+    slow_label = sch._node_label(nodes[3])
+    assert inc["subject"].endswith(slow_label)
+
+    full = state.get_incident(inc["id"])
+    digest = full["digest"]
+    assert len(digest["planes"]) >= 3, digest["planes"]
+    assert {"events", "memory", "net"} <= set(digest["planes"])
+    assert any(e["type"] == "SLOW_LINK" for e in digest["events"])
+    link_rows = digest["net"]["links"]
+    assert link_rows and all(
+        f"{r['src']}->{r['dst']}" == inc["subject"] for r in link_rows
+    )
+    assert digest["net"]["recent_transfers"]
+
+    # recovery: pull the link's EWMA back up until the watchdog clears
+    # the slow flag, then the incident quiet-closes
+    def recovered_and_closed():
+        _feed_link(sch, nodes[0], nodes[3], gibps=2.0, n=4)
+        rows = state.list_incidents(kind="SLOW_LINK")
+        return next((r for r in rows if r["state"] == "closed"), None)
+
+    closed = _wait(recovered_and_closed, timeout=90.0, interval=1.0,
+                   msg="SLOW_LINK close after recovery")
+    assert closed["duration_s"] > 0
+    assert "degraded wire throughput" in closed["verdict"]
+    assert closed["verdict"].count(slow_label) >= 1
+    # still exactly one incident: repeats merged, never re-paged
+    assert len(state.list_incidents(kind="SLOW_LINK")) == 1
+
+
+def test_worker_kill_storm_one_incident(chaos_cluster):
+    """SIGKILLing several workers in a burst yields exactly ONE
+    WORKER_KILL_STORM incident (not one per death) whose digest joins the
+    control plane and whose verdict names the kill burst."""
+
+    @ray_tpu.remote
+    class Victim:
+        def pid(self):
+            return os.getpid()
+
+    actors = [Victim.remote() for _ in range(3)]
+    pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=120)
+    assert len(set(pids)) == 3
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    storms = _wait(
+        lambda: state.list_incidents(kind="WORKER_KILL_STORM"),
+        msg="kill-storm incident",
+    )
+    assert len(storms) == 1, storms
+    inc = storms[0]
+    node_label = inc["subject"]
+
+    full = state.get_incident(inc["id"])
+    digest = full["digest"]
+    assert len(digest["planes"]) >= 3, digest["planes"]
+    assert {"events", "memory", "control"} <= set(digest["planes"])
+    deaths = [e for e in digest["events"] if e["type"] == "WORKER_DIED"]
+    assert len(deaths) >= 3
+    # the control slice carries the victims' launch entries
+    assert digest["control"].get("launches") or digest["control"].get(
+        "decisions"
+    )
+
+    closed = _wait(
+        lambda: next(
+            (r for r in state.list_incidents(kind="WORKER_KILL_STORM")
+             if r["state"] == "closed"), None),
+        msg="storm close",
+    )
+    assert "kill/crash burst" in closed["verdict"]
+    assert node_label in closed["verdict"]
+    assert len(state.list_incidents(kind="WORKER_KILL_STORM")) == 1
+
+
+def test_leak_incident_names_callsite(chaos_cluster):
+    """A grow-only ref hoard of task-return objects opens ONE
+    OBJECT_LEAK_SUSPECT incident; the digest joins traces (creation
+    provenance of exemplar leaked objects) + memory (suspect row), and
+    releasing the hoard closes it with the callsite named in the
+    verdict."""
+    from ray_tpu._private import telemetry
+
+    @ray_tpu.remote
+    def make_block():
+        # 200 KB: big enough to be store-backed (inlined returns never
+        # reach the provenance index, so a hoard of them can't be a
+        # store leak)
+        return np.zeros(200_000, dtype=np.uint8)
+
+    hoard = []
+    inc = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and inc is None:
+        ref = make_block.remote()
+        ray_tpu.get(ref)  # sealed + live; the ref in the hoard pins it
+        hoard.append(ref)
+        telemetry.flush()
+        inc = next(
+            iter(state.list_incidents(kind="OBJECT_LEAK_SUSPECT")), None
+        )
+    assert inc, "leak incident never opened"
+    assert "make_block" in inc["subject"]
+    assert len(state.list_incidents(kind="OBJECT_LEAK_SUSPECT")) == 1
+
+    full = state.get_incident(inc["id"])
+    digest = full["digest"]
+    assert len(digest["planes"]) >= 3, digest["planes"]
+    assert {"events", "traces", "memory"} <= set(digest["planes"])
+    assert digest["memory"]["leak_suspect"]["callsite"] == inc["subject"]
+    assert digest["memory"]["leak_suspect"]["growth_bytes"] > 0
+    assert digest["traces"], "no exemplar trace joined via provenance"
+    assert digest["traces"][0]["spans"] >= 1
+
+    # release the hoard: the suspect clears, the incident quiet-closes
+    hoard.clear()
+    closed = _wait(
+        lambda: next(
+            (r for r in state.list_incidents(kind="OBJECT_LEAK_SUSPECT")
+             if r["state"] == "closed"), None),
+        timeout=90.0,
+        msg="leak incident close after release",
+    )
+    assert "unreleased references" in closed["verdict"]
+    assert inc["subject"] in closed["verdict"]
+
+
+def test_calm_cluster_under_load_zero_incidents(chaos_cluster):
+    """The control run: sustained mixed load (tasks + bounded put/get
+    churn + actor calls) with the plane fully on opens ZERO incidents —
+    the alerting plane must be silent on healthy clusters."""
+
+    @ray_tpu.remote
+    def work(i):
+        return i * 2
+
+    @ray_tpu.remote
+    class Worker:
+        def ping(self):
+            return "ok"
+
+    actors = [Worker.remote() for _ in range(2)]
+    payload = np.zeros(100_000, dtype=np.uint8)
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        refs = [work.remote(i) for i in range(10)]
+        ref = ray_tpu.put(payload)
+        ray_tpu.get(ref)
+        del ref  # bounded churn: created and released each round
+        assert ray_tpu.get(refs, timeout=60) == [i * 2 for i in range(10)]
+        assert ray_tpu.get(
+            [a.ping.remote() for a in actors], timeout=60
+        ) == ["ok", "ok"]
+    time.sleep(1.5)  # one more full scan
+    assert state.list_incidents() == [], state.list_incidents()
+    doc = state.doctor()
+    assert doc["healthy"] is True, doc

@@ -1,0 +1,541 @@
+"""Alerting & incident-forensics plane tests (fast tier-1).
+
+Covers: the shared ``EventDeduper`` gate semantics + bounds (the unified
+replacement for the watchdogs' hand-rolled stamp dicts), SLO spec
+validation and burn-rate math, the incident lifecycle (watchdog trigger →
+open → merge → quiet-close with duration + verdict), WORKER_DIED burst
+gating (single deaths are churn; a storm is ONE incident), the SLO
+breach → incident path on a live cluster, the cross-plane digest and
+the shape contracts it joins (memory snapshot, link rows, launch ring,
+decision log), the `after_event_id`/`since_ts` server-side event cursor,
+the `ray_tpu doctor` / `ray_tpu incidents` / `ray_tpu events --since`
+CLI surfaces, the dashboard `/api/incidents` + `/api/doctor` endpoints,
+and the new ``ray_tpu_incidents_*`` / ``ray_tpu_slo_*`` metric series.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.incidents import SLOSpec, _SLOState, _hist_p99
+from ray_tpu._private.telemetry import EventDeduper
+from ray_tpu.util import state
+
+
+def _sch():
+    from ray_tpu._private.worker import get_runtime
+
+    return get_runtime().node.scheduler
+
+
+def _mgr():
+    return _sch()._incident_mgr
+
+
+@pytest.fixture
+def incident_cluster():
+    """Two-cpu cluster with a tight quiet-close so lifecycle tests don't
+    wait out the production 120s window."""
+    rt = ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "incident_quiet_close_s": 2.0,
+            "incident_event_window_s": 60.0,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=30.0, interval=0.2, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# EventDeduper: the unified watchdog gate
+# ---------------------------------------------------------------------------
+
+
+def test_deduper_fire_once_semantics():
+    """rearm_s=None keys fire exactly once, ever (the straggler/launch
+    per-(subject, attempt) rule)."""
+    d = EventDeduper(rearm_s=None, max_keys=8)
+    assert d.should_fire("k")
+    assert not d.should_fire("k")
+    assert not d.should_fire("k", now=1e9)  # no rearm, no matter how late
+    assert "k" in d and len(d) == 1
+    d.discard("k")
+    assert "k" not in d and d.should_fire("k")
+
+
+def test_deduper_rearm_window():
+    d = EventDeduper(rearm_s=10.0)
+    assert d.should_fire("k", now=100.0)
+    assert not d.should_fire("k", now=105.0)  # inside the window
+    assert d.should_fire("k", now=110.5)  # re-armed
+    assert not d.should_fire("k", now=111.0)  # stamp refreshed on re-fire
+
+
+def test_deduper_mark_check_split():
+    """`in` + `mark` is the check-early/stamp-on-emit split the straggler
+    scan uses — membership alone must not stamp."""
+    d = EventDeduper(rearm_s=None)
+    assert "k" not in d
+    assert "k" not in d  # repeated checks don't create state
+    d.mark("k", now=1.0)
+    assert "k" in d
+
+
+def test_deduper_eviction_bounds_adversarial_keys():
+    """mark past max_keys evicts the OLDEST stamp, so an unbounded key
+    stream (e.g. ever-new callsites) cannot grow the table."""
+    d = EventDeduper(rearm_s=None, max_keys=4)
+    for i in range(4):
+        d.mark(i, now=float(i))
+    d.mark(99, now=99.0)
+    assert len(d) == 4
+    assert 0 not in d  # oldest evicted
+    assert 99 in d and 3 in d
+    # a re-mark refreshes the stamp: key 1 moves to newest, key 2 becomes
+    # the eviction victim
+    d.mark(1, now=100.0)
+    d.mark(100, now=101.0)
+    assert 2 not in d and 1 in d
+
+
+def test_deduper_prune_liveness_and_staleness():
+    d = EventDeduper(rearm_s=None, max_keys=64)
+    for i in range(6):
+        d.mark(i, now=float(i))
+    # keep-rule prune: drop settled subjects (odd keys), regardless of age
+    dropped = d.prune(keep=lambda k: k % 2 == 0, now=100.0)
+    assert dropped == 3 and sorted(d._stamps) == [0, 2, 4]
+    # stale_s guard: young stamps for absent subjects survive the sweep
+    d.mark(7, now=99.9)
+    dropped = d.prune(keep=lambda k: False, stale_s=50.0, now=100.0)
+    assert dropped == 3 and 7 in d and len(d) == 1
+    # over= threshold: sweep skipped entirely below the size floor
+    assert d.prune(keep=lambda k: False, now=200.0, over=10) == 0
+    assert 7 in d
+
+
+# ---------------------------------------------------------------------------
+# SLO spec + burn math
+# ---------------------------------------------------------------------------
+
+
+def test_slospec_validation():
+    with pytest.raises(ValueError, match="needs a name"):
+        SLOSpec.from_dict({"kind": "job_latency_p99", "target": 1.0})
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        SLOSpec.from_dict({"name": "x", "kind": "nope", "target": 1.0})
+    with pytest.raises(ValueError, match="needs a target"):
+        SLOSpec.from_dict({"name": "x", "kind": "job_latency_p99"})
+    with pytest.raises(ValueError, match="unknown SLO spec fields"):
+        SLOSpec.from_dict(
+            {"name": "x", "kind": "job_latency_p99", "target": 1.0,
+             "tresh": 2}
+        )
+    spec = SLOSpec.from_dict(
+        {"name": "x", "kind": "deployment_latency_p99", "target": 250,
+         "subject": "chat", "budget": 0.2}
+    )
+    assert spec.threshold == 1.0 and spec.fast_window_s == 60.0
+    assert spec.subject == "chat" and spec.budget == 0.2
+    assert spec.to_dict()["target"] == 250.0
+
+
+def test_slo_burn_math():
+    st = _SLOState(max_samples=100)
+    now = 1000.0
+    # under min_samples: no burn verdict at all (prevents 1-sample pages)
+    st.samples.append((now - 1, 1.0))
+    assert st.burn(60.0, 0.1, now) is None
+    st.samples.clear()
+    # half the window bad, budget 10% -> burn 5x
+    for i in range(10):
+        st.samples.append((now - 10 + i, 1.0 if i % 2 == 0 else 0.0))
+    assert st.burn(60.0, 0.1, now) == pytest.approx(5.0)
+    # a tight window sees only the newest samples
+    for i in range(5):
+        st.samples.append((now - 0.5 + i * 0.1, 0.0))
+    assert st.burn(1.0, 0.1, now) == pytest.approx(0.0)
+
+
+def test_hist_p99_bucket_upper_bound():
+    # 100 obs: 99 in the first bucket (<=10), 1 in (10, 100]
+    boundaries = [10.0, 100.0]
+    buckets = [99.0, 1.0, 0.0]  # +inf bucket empty
+    assert _hist_p99(100, buckets, boundaries) == pytest.approx(10.0)
+    buckets = [50.0, 0.0, 50.0]  # half in +inf: p99 pins to last boundary
+    assert _hist_p99(100, buckets, boundaries) == pytest.approx(100.0)
+    assert _hist_p99(0, [0, 0, 0], boundaries) is None
+
+
+# ---------------------------------------------------------------------------
+# incident lifecycle on a live cluster
+# ---------------------------------------------------------------------------
+
+
+def test_calm_cluster_stays_clean(incident_cluster):
+    """Normal task traffic opens nothing; doctor says healthy."""
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(20)]) == list(range(1, 21))
+    time.sleep(1.5)  # at least one full scan
+    assert state.list_incidents() == []
+    doc = state.doctor()
+    assert doc["healthy"] is True
+    assert doc["open_incidents"] == []
+    assert isinstance(doc["watchdogs"], dict)
+    assert doc["watchdogs"]["stragglers"] == 0
+
+
+def test_slo_registry_roundtrip(incident_cluster):
+    row = state.register_slo(
+        "chat-p99", "deployment_latency_p99", 250.0, subject="chat",
+        budget=0.2,
+    )
+    assert row["name"] == "chat-p99" and row["budget"] == 0.2
+    slos = {s["name"]: s for s in state.list_slos()}
+    assert "chat-p99" in slos
+    assert slos["chat-p99"]["ok"] is True  # no subjects yet -> not breached
+    with pytest.raises(Exception, match="unknown SLO kind"):
+        state.register_slo("bad", "not_a_kind", 1.0)
+    assert state.remove_slo("chat-p99") is True
+    assert state.remove_slo("chat-p99") is False
+    assert all(s["name"] != "chat-p99" for s in state.list_slos())
+
+
+def test_watchdog_trigger_opens_merges_and_closes(incident_cluster):
+    """A watchdog event opens ONE incident; repeats merge (count bumps,
+    no second page); quiet + recovery closes it with duration + verdict."""
+    sch = _sch()
+    sch.record_cluster_event(
+        "STRAGGLER", "f_slow 40x over p95", severity="WARNING",
+        source="WATCHDOG", name="f_slow", elapsed_s=40.0, p95_s=1.0,
+    )
+    inc = _wait(
+        lambda: next(iter(state.list_incidents(kind="STRAGGLER")), None),
+        msg="STRAGGLER incident to open",
+    )
+    assert inc["state"] == "open" and inc["subject"] == "f_slow"
+    assert inc["count"] == 1
+    # repeat trigger merges into the SAME incident
+    sch.record_cluster_event(
+        "STRAGGLER", "f_slow still over", severity="WARNING",
+        source="WATCHDOG", name="f_slow", elapsed_s=50.0, p95_s=1.0,
+    )
+    merged = _wait(
+        lambda: next(
+            (r for r in state.list_incidents(kind="STRAGGLER")
+             if r["count"] >= 2), None),
+        msg="trigger merge",
+    )
+    assert merged["id"] == inc["id"]
+    assert len(state.list_incidents(kind="STRAGGLER")) == 1
+    # the lifecycle reaches the cluster event log
+    opened = state.list_cluster_events(
+        filters=[("type", "=", "INCIDENT_OPENED")]
+    )
+    assert any(e.get("incident_id") == inc["id"] for e in opened)
+    # full record: digest joined at least events + memory planes
+    full = state.get_incident(inc["id"])
+    assert full["digest"]["planes"], full["digest"]
+    assert "memory" in full["digest"]["planes"]
+    assert any(
+        e["type"] == "STRAGGLER" for e in full["digest"]["events"]
+    )
+    # quiet (no new triggers) past incident_quiet_close_s=2 closes it
+    closed = _wait(
+        lambda: next(
+            (r for r in state.list_incidents(kind="STRAGGLER")
+             if r["state"] == "closed"), None),
+        msg="incident close",
+    )
+    assert closed["duration_s"] is not None and closed["duration_s"] >= 0
+    assert closed["verdict"] and "f_slow" in closed["verdict"]
+    assert any(
+        e.get("incident_id") == inc["id"]
+        for e in state.list_cluster_events(
+            filters=[("type", "=", "INCIDENT_CLOSED")]
+        )
+    )
+
+
+def test_worker_died_burst_gating(incident_cluster):
+    """One death is elastic churn (no incident); a >=3-death burst on one
+    node collapses into exactly ONE WORKER_KILL_STORM."""
+    sch = _sch()
+    node = NodeID.from_random().hex()[:12]
+    sch.record_cluster_event(
+        "WORKER_DIED", "exitcode -9", severity="ERROR",
+        source="SCHEDULER", node_id=node,
+    )
+    time.sleep(2.0)  # two scans: a lone death must never page
+    assert state.list_incidents(kind="WORKER_KILL_STORM") == []
+    for _ in range(3):
+        sch.record_cluster_event(
+            "WORKER_DIED", "exitcode -9", severity="ERROR",
+            source="SCHEDULER", node_id=node,
+        )
+    storm = _wait(
+        lambda: state.list_incidents(kind="WORKER_KILL_STORM"),
+        msg="kill-storm incident",
+    )
+    assert len(storm) == 1
+    assert storm[0]["subject"] == node
+
+
+def test_slo_breach_opens_incident(incident_cluster):
+    """A registered job-latency SLO with an impossible target breaches
+    (both windows burning) and opens an SLO_BREACH incident."""
+
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.02)
+        return 1
+
+    state.register_slo(
+        "job-p99", "job_latency_p99", 0.001,  # 1us target: always bad
+        budget=0.5, threshold=1.0, fast_window_s=5.0, slow_window_s=10.0,
+    )
+    # keep latency samples flowing while the 1 Hz evaluator accumulates
+    deadline = time.monotonic() + 30.0
+    breach = None
+    while time.monotonic() < deadline and not breach:
+        ray_tpu.get([work.remote() for _ in range(4)])
+        breach = next(
+            iter(state.list_incidents(kind="SLO_BREACH")), None
+        )
+    assert breach, "SLO breach never opened"
+    assert breach["slo"] == "job-p99"
+    assert breach["subject"].startswith("job-p99:")
+    slos = {s["name"]: s for s in state.list_slos()}
+    row = slos["job-p99"]
+    assert row["ok"] is False and row["breaches_total"] >= 1
+    assert row["worst"]["burn_fast"] >= 1.0
+    evs = state.list_cluster_events(filters=[("type", "=", "SLO_BREACH")])
+    assert evs and evs[0]["slo"] == "job-p99"
+    doc = state.doctor()
+    assert doc["healthy"] is False
+    state.remove_slo("job-p99")
+
+
+# ---------------------------------------------------------------------------
+# event-log cursor (ray_tpu events --since/--follow backend)
+# ---------------------------------------------------------------------------
+
+
+def test_event_cursor_after_event_id_and_since_ts(incident_cluster):
+    sch = _sch()
+    sch.record_cluster_event("OOM", "marker-a", severity="WARNING",
+                             source="TEST", node_id="aaaa")
+    evs = _wait(
+        lambda: state.list_cluster_events(filters=[("type", "=", "OOM")]),
+        msg="first marker event",
+    )
+    cursor = max(e["event_id"] for e in evs)
+    t_mid = time.time()
+    assert state.list_cluster_events(after_event_id=cursor) == []
+    time.sleep(0.05)
+    sch.record_cluster_event("OOM", "marker-b", severity="WARNING",
+                             source="TEST", node_id="bbbb")
+    newer = _wait(
+        lambda: state.list_cluster_events(after_event_id=cursor),
+        msg="cursor-filtered tail",
+    )
+    assert all(e["event_id"] > cursor for e in newer)
+    assert any(e["message"] == "marker-b" for e in newer)
+    assert not any(e["message"] == "marker-a" for e in newer)
+    # since_ts: wall-clock variant used by `events --since`
+    recent = state.list_cluster_events(since_ts=t_mid)
+    assert any(e["message"] == "marker-b" for e in recent)
+    assert not any(e["message"] == "marker-a" for e in recent)
+
+
+# ---------------------------------------------------------------------------
+# CLI + dashboard + metric surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cli_doctor_and_incidents(incident_cluster, capsys):
+    from ray_tpu.scripts.cli import main
+
+    sch = _sch()
+    sch.record_cluster_event(
+        "STRAGGLER", "f_cli 10x over p95", severity="WARNING",
+        source="WATCHDOG", name="f_cli", elapsed_s=10.0, p95_s=1.0,
+    )
+    inc = _wait(
+        lambda: next(iter(state.list_incidents(kind="STRAGGLER")), None),
+        msg="incident for the CLI",
+    )
+    main(["doctor"])
+    out = capsys.readouterr().out
+    assert "cluster health" in out and "incident" in out.lower()
+    main(["doctor", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["healthy"] is False and doc["open_incidents"]
+    main(["incidents"])
+    out = capsys.readouterr().out
+    assert inc["id"] in out and "STRAGGLER" in out
+    main(["incidents", "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert any(r["id"] == inc["id"] for r in rows)
+    main(["incidents", "show", inc["id"]])
+    out = capsys.readouterr().out
+    assert "STRAGGLER" in out and "f_cli" in out
+    main(["incidents", inc["id"], "--json"])  # "show" prefix is optional
+    full = json.loads(capsys.readouterr().out)
+    assert full["id"] == inc["id"] and full["digest"]["planes"]
+    with pytest.raises(SystemExit):
+        main(["incidents", "show", "inc-does-not-exist"])
+
+
+def test_cli_events_since(incident_cluster, capsys):
+    from ray_tpu.scripts.cli import main
+
+    sch = _sch()
+    sch.record_cluster_event("OOM", "cli-marker", severity="WARNING",
+                             source="TEST", node_id="cccc")
+    _wait(
+        lambda: state.list_cluster_events(filters=[("type", "=", "OOM")]),
+        msg="marker event",
+    )
+    main(["events", "--since", "10m", "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert any(e.get("message") == "cli-marker" for e in rows)
+    # a since-window in the future excludes everything
+    main(["events", "--since", "0s", "--json"])
+    out = capsys.readouterr().out.strip()
+    assert "cli-marker" not in out
+
+
+def test_dashboard_incidents_endpoints(incident_cluster):
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    sch = _sch()
+    sch.record_cluster_event(
+        "STRAGGLER", "f_dash over p95", severity="WARNING",
+        source="WATCHDOG", name="f_dash", elapsed_s=9.0, p95_s=1.0,
+    )
+    _wait(
+        lambda: state.list_incidents(kind="STRAGGLER"),
+        msg="incident for the dashboard",
+    )
+    port = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/incidents", timeout=10
+        ) as resp:
+            body = json.loads(resp.read())
+        assert any(r["kind"] == "STRAGGLER" for r in body["incidents"])
+        assert "slos" in body
+        inc_id = body["incidents"][0]["id"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/incidents?id={inc_id}", timeout=10
+        ) as resp:
+            full = json.loads(resp.read())
+        assert full["id"] == inc_id and "digest" in full
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/doctor", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert "healthy" in doc and "watchdogs" in doc
+    finally:
+        stop_dashboard()
+
+
+def test_incident_metric_series(incident_cluster):
+    from ray_tpu._private.worker import get_driver
+
+    sch = _sch()
+    sch.record_cluster_event(
+        "STRAGGLER", "f_m over p95", severity="WARNING",
+        source="WATCHDOG", name="f_m", elapsed_s=9.0, p95_s=1.0,
+    )
+    _wait(
+        lambda: state.list_incidents(kind="STRAGGLER"),
+        msg="incident for metrics",
+    )
+    series = {s["name"]: s for s in get_driver().rpc("runtime_metrics")}
+    for name in (
+        "ray_tpu_incidents_open",
+        "ray_tpu_incidents_total",
+        "ray_tpu_incidents_closed_total",
+        "ray_tpu_incident_open_seconds_max",
+        "ray_tpu_slo_breaches_total",
+        "ray_tpu_alerts_emitted_total",
+    ):
+        assert name in series, name
+    assert sum(series["ray_tpu_incidents_open"]["data"].values()) >= 1
+    assert sum(series["ray_tpu_incidents_total"]["data"].values()) >= 1
+    assert any(
+        "STRAGGLER" in k for k in series["ray_tpu_incidents_open"]["data"]
+    )
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "ray_tpu_incidents_open" in text
+    # HELP descriptions ship with every series (satellite of this plane)
+    assert "# HELP ray_tpu_incidents_open" in text
+
+
+# ---------------------------------------------------------------------------
+# cross-plane shape guard
+# ---------------------------------------------------------------------------
+
+
+def test_digest_source_shapes_hold(incident_cluster):
+    """The digest joins other planes by reaching into their row shapes;
+    if any of those shapes drifts, fail HERE with a named contract, not
+    inside a best-effort digest assembly that would silently go empty."""
+    sch = _sch()
+    # memory plane: forensics snapshot keys the digest copies
+    mem = sch.memory_forensics_snapshot(top=3)
+    for key in ("store_capacity_bytes", "top_callsites"):
+        assert key in mem, f"memory_forensics_snapshot lost {key!r}"
+    # net plane: link ledger rows (feed one synthetic completed transfer)
+    dst = NodeID.from_random()
+    oid = ObjectID.from_random()
+    sch._fetching[(oid, dst)] = (sch._node.head_node_id, True)
+    sch._xfer_complete(
+        oid, dst, True,
+        stats={"path": "socket", "bytes": 1 << 20, "wire_ms": 5.0,
+               "total_ms": 5.0, "t0": time.time()},
+    )
+    rows = sch._net_link_rows()
+    assert rows, "link ledger empty after a completed transfer"
+    for key in ("src", "dst", "path", "bytes"):
+        assert key in rows[0], f"_net_link_rows lost {key!r}"
+    # train plane: run listing stays a list of dicts with the keys the
+    # goodput digest slice reads (empty on this cluster, shape still held)
+    runs = sch._train_index.list_runs()
+    assert isinstance(runs, list)
+    # control plane: decision ring + lock and the launch ring the digest
+    # slices by time window
+    assert hasattr(sch, "_decisions") and hasattr(sch, "_decision_lock")
+    assert hasattr(sch, "_launch_recent")
+    # events: every recorded event carries the id the cursor pages on
+    sch.record_cluster_event("OOM", "shape probe", severity="WARNING",
+                             source="TEST", node_id="dddd")
+    evs = _wait(
+        lambda: state.list_cluster_events(filters=[("type", "=", "OOM")]),
+        msg="shape-probe event",
+    )
+    assert all("event_id" in e and "time" in e for e in evs)

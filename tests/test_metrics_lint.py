@@ -100,6 +100,80 @@ def test_no_stale_series_in_design_map():
     assert not dupes, f"series listed twice in the DESIGN_MAP table: {dupes}"
 
 
+def _described_text(node) -> bool:
+    """True when an AST node statically yields non-empty help text:
+    a string literal (implicit concatenation folds to one Constant),
+    an f-string, or a ``+``/parenthesized composition of those."""
+    import ast
+
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and bool(node.value.strip())
+    if isinstance(node, ast.JoinedStr):
+        return True  # f-strings always carry at least the template
+    if isinstance(node, ast.BinOp):
+        return _described_text(node.left) or _described_text(node.right)
+    return False
+
+
+def find_undescribed() -> List[Tuple[str, str, int]]:
+    """(series, relpath, lineno) for every ray_tpu_* registration whose
+    HELP description is missing or empty. Descriptions feed straight into
+    the ``# HELP`` lines of ``prometheus_text()`` — an empty one ships an
+    undocumented scrape series."""
+    import ast
+
+    bad: List[Tuple[str, str, int]] = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            try:
+                tree = ast.parse(text)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                fname_call = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname_call not in ("Counter", "Gauge", "Histogram", "add"):
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("ray_tpu_")):
+                    continue
+                series = node.args[0].value
+                # description position: arg 1 for metric constructors,
+                # arg 2 for the runtime add(name, kind, description, data)
+                desc_idx = 2 if fname_call == "add" else 1
+                desc = None
+                for kw in node.keywords:
+                    if kw.arg == "description":
+                        desc = kw.value
+                if desc is None and len(node.args) > desc_idx:
+                    desc = node.args[desc_idx]
+                if desc is None or not _described_text(desc):
+                    rel = os.path.relpath(path, REPO)
+                    bad.append((series, rel, node.lineno))
+    return bad
+
+
+def test_every_series_has_description():
+    """Every ray_tpu_* registration must carry non-empty HELP text —
+    ``prometheus_text()`` emits it verbatim as the series' ``# HELP``
+    line, so an empty description is an undocumented scrape surface."""
+    bad = find_undescribed()
+    assert not bad, (
+        "metric series registered without a HELP description "
+        f"(add one — it becomes the # HELP line): {bad}"
+    )
+
+
 def test_scanner_finds_known_series():
     """Guard the scanner itself: if the regex rots, the other tests pass
     vacuously. These three series span both registration pipelines."""

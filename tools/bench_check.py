@@ -1,0 +1,112 @@
+"""Bench regression gate: fail if any recorded plane overhead blows budget.
+
+Every observability plane lands with an ON/OFF overhead row in a
+``BENCH_*.jsonl`` ledger (``*_overhead_ratio``, budget <= 1.05) and, for
+the planes that decompose latency into stages, a ``*_stage_coverage`` row
+(fraction of wall time attributed to named stages, floor 0.9).  Those rows
+are appended over time — the newest row per metric is the current claim.
+This gate re-reads the ledgers and exits non-zero when the newest claim of
+any gated metric is out of budget, so a plane regression can't hide behind
+a stale green row.
+
+Rules (per newest row of each metric):
+  * ``*_overhead_ratio``  — value must be <= the row's numeric ``budget``
+    field when present, else <= the default 1.05.
+  * ``*_stage_coverage``  — value must be >= 0.9.
+
+Rows whose ``value`` is null/non-numeric (placeholders for benches not yet
+run on this host) are reported but don't gate.
+
+Run: ``python tools/bench_check.py [--root DIR]``  (or ``make bench-gate``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+DEFAULT_RATIO_BUDGET = 1.05
+COVERAGE_FLOOR = 0.9
+
+
+def load_newest_rows(root: str) -> dict[str, tuple[dict, str]]:
+    """Newest row per metric across every BENCH_*.jsonl (file order = append
+    order, so later lines win; across files the metric namespaces don't
+    collide in practice, but last-read still wins deterministically)."""
+    newest: dict[str, tuple[dict, str]] = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.jsonl"))):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                metric = row.get("metric")
+                if isinstance(metric, str) and metric:
+                    newest[metric] = (row, os.path.basename(path))
+    return newest
+
+
+def check(root: str) -> int:
+    newest = load_newest_rows(root)
+    if not newest:
+        print(f"bench-gate: no BENCH_*.jsonl rows found under {root}",
+              file=sys.stderr)
+        return 2
+    failures: list[str] = []
+    checked = 0
+    for metric in sorted(newest):
+        row, src = newest[metric]
+        gated_ratio = metric.endswith("_overhead_ratio")
+        gated_cov = metric.endswith("_stage_coverage")
+        if not (gated_ratio or gated_cov):
+            continue
+        value = row.get("value")
+        if not isinstance(value, (int, float)):
+            print(f"  SKIP  {metric} ({src}): no numeric value recorded")
+            continue
+        checked += 1
+        if gated_ratio:
+            budget = row.get("budget")
+            limit = budget if isinstance(budget, (int, float)) \
+                else DEFAULT_RATIO_BUDGET
+            ok = value <= limit
+            verdict = f"{value} <= {limit}"
+        else:
+            ok = value >= COVERAGE_FLOOR
+            verdict = f"{value} >= {COVERAGE_FLOOR}"
+        tag = "ok" if ok else "FAIL"
+        print(f"  {tag:4s}  {metric} ({src}): {verdict}")
+        if not ok:
+            failures.append(f"{metric}={value} ({src}, want {verdict})")
+    if not checked:
+        print("bench-gate: no gated metrics (*_overhead_ratio / "
+              "*_stage_coverage) found", file=sys.stderr)
+        return 2
+    if failures:
+        print(f"bench-gate: {len(failures)} metric(s) out of budget:",
+              file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"bench-gate: {checked} gated metric(s) within budget")
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding the BENCH_*.jsonl ledgers")
+    args = ap.parse_args()
+    sys.exit(check(args.root))
+
+
+if __name__ == "__main__":
+    main()
