@@ -14,7 +14,7 @@ PY ?= python
 # reproduce a failing chaos run kill-for-kill
 CHAOS_SEED ?= 1729
 
-.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net bench-launch bench-incidents bench-gate ci clean
+.PHONY: all native cpp sanitize test test-fast chaos chaos-serve bench bench-isolation bench-trace trace-demo train-obs-demo bench-train-obs bench-net bench-launch bench-incidents bench-lm-decode bench-gate ci clean
 
 all: native cpp
 
@@ -42,14 +42,17 @@ test-fast: native
 chaos:
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_chaos.py \
 		tests/test_elastic_chaos.py tests/test_preempt_chaos.py \
-		tests/test_serve_chaos.py tests/test_incident_chaos.py -m slow -q
+		tests/test_serve_chaos.py tests/test_llm_chaos.py \
+		tests/test_incident_chaos.py -m slow -q
 
 # serve-plane churn suite: replica + controller SIGKILLs under sustained
-# mixed unary/streaming load, graceful-redeploy zero-drop proof. Seeded via
+# mixed unary/streaming load, graceful-redeploy zero-drop proof — plus the
+# LLM variant with live decode streams (kills mid-decode fail typed or
+# pre-first-token; drain finishes in-flight decodes). Seeded via
 # CHAOS_SEED like the rest of the chaos group; on-demand for CI.
 chaos-serve:
 	CHAOS_SEED=$(CHAOS_SEED) $(PY) -m pytest tests/test_serve_chaos.py \
-		-m slow -q
+		tests/test_llm_chaos.py -m slow -q
 
 bench:
 	$(PY) bench.py
@@ -102,9 +105,19 @@ bench-launch:
 bench-incidents:
 	JAX_PLATFORMS=cpu $(PY) bench_incidents.py --append
 
+# LM decode: static vs continuous batching tokens/s, serve-deployed TTFT
+# p50/p99 (tracing-plane stream spans via the controller fold, registers
+# the deployment_ttft_p99 SLO), and the >=100-stream KV saturation run.
+# Appends rows to BENCH_LM_DECODE.jsonl.
+bench-lm-decode:
+	$(PY) bench_lm_decode.py --mode all
+
 # bench regression gate: re-reads the BENCH_*.jsonl ledgers and fails
 # non-zero if the newest row of any *_overhead_ratio metric exceeds its
-# budget (default 1.05) or any *_stage_coverage row is below 0.9.
+# budget (default 1.05), any *_stage_coverage row is below 0.9, any
+# *_ttft_p99_ms row exceeds its budget (default 5000 ms), any
+# *_floor_ratio row is below its floor (default 1.0), or any
+# *_untyped_failures row exceeds its budget (default 0).
 bench-gate:
 	$(PY) tools/bench_check.py
 

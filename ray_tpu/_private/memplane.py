@@ -310,4 +310,89 @@ def collect_device_metrics() -> bool:
                 gauges["peak_bytes_in_use"].set(int(peak), tags=tags)
     except Exception:
         pass
+    # KV-cache view: every registered paged-pool provider (LLM engines in
+    # this process) folds into the ray_tpu_kv_* gauges alongside the
+    # allocator stats, so `ray_tpu memory` shows KV occupancy next to HBM
+    try:
+        for name, provider in list(_kv_providers.items()):
+            try:
+                record_kv_occupancy(provider())
+            except Exception:
+                pass
+    except Exception:
+        pass
     return True
+
+
+# -- paged KV cache occupancy (LLM serving plane) ----------------------------
+#
+# The serve-plane inference engine reserves KV blocks at admission and
+# sheds on exhaustion; these gauges make that live shed signal visible in
+# the same device-gauge surface as HBM use. Providers are callables
+# returning an engine's kv_stats() snapshot, swept by
+# collect_device_metrics() and updated inline by the engine on every
+# admission/finish edge.
+
+_kv_gauges: Dict[str, object] = {}
+_kv_providers: Dict[str, object] = {}
+
+
+def register_kv_provider(deployment: str, provider) -> None:
+    """Register a KV-stats source (an engine's ``kv_stats``) so periodic
+    device sweeps refresh the ``ray_tpu_kv_*`` gauges even when the
+    engine is idle."""
+    _kv_providers[str(deployment)] = provider
+
+
+def _get_kv_gauges() -> Dict[str, object]:
+    with _counter_lock:
+        if "blocks_total" not in _kv_gauges:
+            from ray_tpu.util.metrics import Gauge
+
+            _kv_gauges["blocks_total"] = Gauge(
+                "ray_tpu_kv_blocks_total",
+                "usable KV-cache blocks in the paged device pool per LLM "
+                "deployment (excludes the reserved null block)",
+                tag_keys=("deployment",),
+            )
+            _kv_gauges["blocks_free"] = Gauge(
+                "ray_tpu_kv_blocks_free",
+                "KV-cache blocks currently on the free list per LLM "
+                "deployment — the admission-control shed signal",
+                tag_keys=("deployment",),
+            )
+            _kv_gauges["occupancy"] = Gauge(
+                "ray_tpu_kv_occupancy_ratio",
+                "fraction of usable KV-cache blocks in use per LLM "
+                "deployment (1.0 = pool exhausted, requests shed)",
+                tag_keys=("deployment",),
+            )
+            _kv_gauges["bytes_total"] = Gauge(
+                "ray_tpu_kv_pool_bytes",
+                "device bytes reserved by the paged KV pool per LLM "
+                "deployment (blocks x bytes-per-block, both k and v)",
+                tag_keys=("deployment",),
+            )
+    return _kv_gauges
+
+
+def record_kv_occupancy(stats: Dict[str, object]) -> None:
+    """Fold one engine ``kv_stats()`` snapshot into the KV gauges."""
+    if not enabled():
+        return
+    try:
+        gauges = _get_kv_gauges()
+        tags = {"deployment": str(stats.get("deployment", "llm"))}
+        total = int(stats.get("blocks_total", 0))
+        free = int(stats.get("blocks_free", 0))
+        gauges["blocks_total"].set(float(total), tags=tags)
+        gauges["blocks_free"].set(float(free), tags=tags)
+        gauges["occupancy"].set(
+            0.0 if not total else 1.0 - free / total, tags=tags
+        )
+        bpb = int(stats.get("bytes_per_block", 0))
+        if bpb:
+            # pool bytes include the reserved null block
+            gauges["bytes_total"].set(float((total + 1) * bpb), tags=tags)
+    except Exception:
+        pass

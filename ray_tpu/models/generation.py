@@ -8,6 +8,17 @@ TPU-native: static shapes for XLA, masked attention over the cache instead
 of data-dependent slicing, bf16 weights with fp32 logits.
 
 Layout: cache k/v are (L, B, max_len, kv_heads, head_dim).
+
+Two cache layouts share the same attention math:
+
+* dense (``init_kv_cache`` + ``make_decode_fns``): per-batch contiguous
+  cache, all sequences advance in lockstep — the static-batch demo path.
+* paged (``init_paged_pool`` + ``make_paged_fns``): one device-wide pool of
+  fixed-size blocks; each sequence owns a block table mapping absolute
+  positions to pool slots. Shapes stay static (block tables are dense
+  int32 arrays padded with the reserved null block 0), so the serve
+  plane's continuous-batching engine reuses one compiled decode step no
+  matter which sequences occupy the batch slots.
 """
 
 from __future__ import annotations
@@ -140,6 +151,244 @@ def make_decode_fns(cfg: TransformerConfig, max_len: int):
     return prefill, decode_step
 
 
+# -- paged KV cache ----------------------------------------------------------
+#
+# The pool is (L, num_blocks * block_size, kv_heads, head_dim): flat slot
+# addressing, where block b covers slots [b*block_size, (b+1)*block_size).
+# Block 0 is reserved as the null block: padded block-table entries and
+# masked-out writes land there, and its (garbage) contents are always
+# behind the causal mask, so attention never reads them.
+
+
+def _kv_storage_dtype(dtype):
+    """Storage dtype for the paged pool: 16-bit floats are stored as their
+    raw bits (uint16). XLA's CPU backend expands sub-32-bit float scatters
+    into a whole-pool f32 convert/convert-back pair — an O(pool-size)
+    memcpy per layer per step — while integer scatters stay native and
+    in-place. Bitcasting the few written/gathered rows at the edges is
+    free and bitwise-identical to storing the float directly."""
+    d = jnp.dtype(dtype)
+    return jnp.uint16 if d.itemsize == 2 else d
+
+
+def init_paged_pool(
+    cfg: TransformerConfig, num_blocks: int, block_size: int
+) -> Dict:
+    """Preallocated device pool for the paged KV cache (block 0 reserved).
+
+    Entries are ``cfg.dtype`` values; 16-bit dtypes are held as raw bits
+    (see ``_kv_storage_dtype``) and bitcast at the scatter/gather edges."""
+    n_slots = num_blocks * block_size
+    shape = (cfg.n_layers, n_slots, cfg.kv_heads, cfg.head_dim)
+    st = _kv_storage_dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, st), "v": jnp.zeros(shape, st)}
+
+
+def _paged_attention(q, gk, gv, q_positions):
+    """q (B,S,H,Hd) against gathered block rows (B,M,KV,Hd) whose row index
+    IS the absolute position (block p of a table covers positions
+    [p*bs, (p+1)*bs)); causal mask row <= q_position per batch element.
+    Same scale/mask/softmax forms as ``_cached_attention`` so dense and
+    paged decode agree tokenwise."""
+    n_rep = q.shape[2] // gk.shape[2]
+    if n_rep > 1:
+        b, m, kv, d = gk.shape
+        gk = jnp.broadcast_to(gk[:, :, :, None, :], (b, m, kv, n_rep, d)).reshape(
+            b, m, kv * n_rep, d
+        )
+        gv = jnp.broadcast_to(gv[:, :, :, None, :], (b, m, kv, n_rep, d)).reshape(
+            b, m, kv * n_rep, d
+        )
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, gk, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    m = gk.shape[1]
+    mask = jnp.arange(m)[None, None, :] <= q_positions[:, :, None]  # (B,S,M)
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, gv)
+
+
+def _forward_paged(
+    params,
+    tokens,
+    positions,
+    write_mask,
+    block_tables,
+    pool,
+    cfg: TransformerConfig,
+    block_size: int,
+):
+    """Run the model over ``tokens`` (B,S) at per-sequence absolute
+    ``positions`` (B,S), scattering k/v into the block pool and attending
+    over each sequence's gathered blocks. ``write_mask`` (B,S) diverts
+    padded rows to the null block; ``block_tables`` (B, max_blocks) maps
+    block index -> pool block (0-padded). Returns (logits (B,S,V), pool)."""
+    b, s = tokens.shape
+    mb = block_tables.shape[1]
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    # flat slot destination per (b, s) token; masked rows -> null block 0
+    pidx = jnp.clip(positions // block_size, 0, mb - 1)
+    slot = (
+        jnp.take_along_axis(block_tables, pidx, axis=1) * block_size
+        + positions % block_size
+    )
+    null_slot = jnp.arange(b * s, dtype=slot.dtype) % block_size
+    write_slots = jnp.where(write_mask.reshape(-1), slot.reshape(-1), null_slot)
+
+    # gathered pool rows per sequence: row index == absolute position
+    gather_idx = (
+        block_tables[:, :, None] * block_size
+        + jnp.arange(block_size)[None, None, :]
+    ).reshape(b, mb * block_size)
+
+    # The pool rides in the scan CARRY (updated at a dynamic layer index),
+    # not in the per-layer ys: stacked scan outputs allocate a fresh slab
+    # and copy every layer's full k/v through it, which defeats buffer
+    # donation and turns each decode step into an O(pool-size) memcpy.
+    # Carry-threaded updates alias in place under ``donate_argnums``.
+    def body(carry, layer_inputs):
+        x, pk, pv = carry
+        layer, li = layer_inputs
+        h = rms_norm(x, layer["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        # round to cfg.dtype, then scatter/gather in the pool's STORAGE
+        # dtype (raw bits for 16-bit floats): float16-family scatters are
+        # expanded by the CPU backend into whole-pool convert pairs, so
+        # only the written/gathered rows may change representation here
+        bits = pk.dtype != jnp.dtype(cfg.dtype)
+        kw = k.reshape(b * s, *k.shape[2:]).astype(cfg.dtype)
+        vw = v.reshape(b * s, *v.shape[2:]).astype(cfg.dtype)
+        if bits:
+            kw = jax.lax.bitcast_convert_type(kw, pk.dtype)
+            vw = jax.lax.bitcast_convert_type(vw, pv.dtype)
+        pk = pk.at[li, write_slots].set(kw)
+        pv = pv.at[li, write_slots].set(vw)
+        gk, gv = pk[li][gather_idx], pv[li][gather_idx]
+        if bits:
+            gk = jax.lax.bitcast_convert_type(gk, cfg.dtype)
+            gv = jax.lax.bitcast_convert_type(gv, cfg.dtype)
+        att = _paged_attention(q, gk, gv, positions)
+        att_out = jnp.einsum("bshk,hkd->bsd", att, layer["wo"])
+        if cfg.parallel_block:
+            m = h
+            x_out = x + att_out + _mlp(cfg, layer, m)
+        else:
+            x1 = x + att_out
+            m = rms_norm(x1, layer["mlp_norm"])
+            x_out = x1 + _mlp(cfg, layer, m)
+        return (x_out, pk, pv), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, pool["k"], pool["v"]),
+        (_stacked(params), jnp.arange(cfg.n_layers)),
+    )
+    x = rms_norm(x, params["final_norm"])
+    unembed = params.get("unembed")
+    if unembed is None:
+        unembed = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, unembed).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def make_paged_fns(cfg: TransformerConfig, *, block_size: int):
+    """Returns (prefill, decode_step, decode_step_greedy) over a paged
+    pool, jitted with the pool donated (in-place on device between steps).
+
+    prefill(params, tokens (1,S), block_table (1,MB), pool, length ())
+        -> (logits at position length-1 (1,V), pool)
+    decode_step(params, tokens (B,), positions (B,), block_tables (B,MB),
+        pool, active (B,) bool) -> (logits (B,V), pool)
+    decode_step_greedy(same args) -> (next tokens (B,) int32, pool)
+        — argmax fused on device so a greedy batch ships B ints to the
+        host per step instead of B x vocab logits (the hot serving path;
+        identical tokens to argmax over ``decode_step``'s logits).
+
+    Shapes are static per (S, MB, B): the engine buckets prompt lengths
+    and runs decode at a fixed max batch, so each compiles exactly once.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    def prefill(params, tokens, block_table, pool, length):
+        s = tokens.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], tokens.shape)
+        write_mask = positions < length
+        logits, pool = _forward_paged(
+            params, tokens, positions, write_mask, block_table, pool, cfg, block_size
+        )
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1, keepdims=False)
+        return last, pool
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def decode_step(params, tokens, positions, block_tables, pool, active):
+        logits, pool = _forward_paged(
+            params,
+            tokens[:, None],
+            positions[:, None],
+            active[:, None],
+            block_tables,
+            pool,
+            cfg,
+            block_size,
+        )
+        return logits[:, 0, :], pool
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def decode_step_greedy(params, tokens, positions, block_tables, pool, active):
+        logits, pool = _forward_paged(
+            params,
+            tokens[:, None],
+            positions[:, None],
+            active[:, None],
+            block_tables,
+            pool,
+            cfg,
+            block_size,
+        )
+        return jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32), pool
+
+    return prefill, decode_step, decode_step_greedy
+
+
+# -- sampling ----------------------------------------------------------------
+
+
+def sample_token(
+    logits,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: Optional[jax.Array] = None,
+):
+    """Next-token selection from ``logits`` (..., V): greedy argmax when
+    temperature <= 0 (the bitwise-stable default), else temperature
+    scaling with optional top-k filtering before categorical sampling."""
+    if not temperature or temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    if key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    scaled = logits / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(scaled, int(top_k))[0][..., -1:]
+        scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
+def sequence_key(seed: int, step: int) -> jax.Array:
+    """Per-sequence PRNG stream, deterministic in (seed, step) and
+    independent of batch composition — continuous batching samples the
+    same tokens for a sequence no matter which neighbours share the
+    decode step."""
+    return jax.random.fold_in(jax.random.PRNGKey(int(seed)), int(step))
+
+
 def generate(
     params,
     prompt_tokens,
@@ -147,6 +396,7 @@ def generate(
     *,
     max_new_tokens: int = 32,
     temperature: float = 0.0,
+    top_k: int = 0,
     key: Optional[jax.Array] = None,
     fns: Optional[Tuple] = None,
 ) -> jnp.ndarray:
@@ -174,7 +424,9 @@ def generate(
     for i in range(max_new_tokens):
         if temperature and temperature > 0:
             key, sub = jax.random.split(key)
-            tok = jax.random.categorical(sub, logits / temperature)
+            tok = sample_token(
+                logits, temperature=temperature, top_k=top_k, key=sub
+            )
         else:
             tok = jnp.argmax(logits, axis=-1)
         out.append(tok)

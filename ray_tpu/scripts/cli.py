@@ -133,6 +133,39 @@ def _fmt_bytes(n, units: str) -> str:
     return f"{n}" if units == "B" else f"{n / div:.1f}"
 
 
+def _print_kv_cache_section(units: str) -> None:
+    """Paged KV-cache occupancy per LLM deployment (the live shed
+    signal), folded from the aggregated ``ray_tpu_kv_*`` gauges."""
+    try:
+        from ray_tpu.util.metrics import prometheus_text
+
+        series: dict = {}
+        for line in prometheus_text().splitlines():
+            if not line.startswith("ray_tpu_kv_"):
+                continue
+            name, _, value = line.rpartition(" ")
+            dep = "?"
+            if 'deployment="' in name:
+                dep = name.split('deployment="', 1)[1].split('"', 1)[0]
+            metric = name.split("{", 1)[0]
+            series.setdefault(dep, {})[metric] = float(value)
+        if not series:
+            return
+        print("== paged KV cache (LLM serving plane) ==")
+        for dep, vals in sorted(series.items()):
+            total = vals.get("ray_tpu_kv_blocks_total", 0)
+            free = vals.get("ray_tpu_kv_blocks_free", 0)
+            occ = vals.get("ray_tpu_kv_occupancy_ratio", 0.0)
+            nbytes = vals.get("ray_tpu_kv_pool_bytes", 0)
+            print(
+                f"  {dep}: {total - free:g}/{total:g} blocks in use "
+                f"({occ:.0%} occupancy, pool "
+                f"{_fmt_bytes(int(nbytes), units)} {units})"
+            )
+    except Exception:
+        pass  # KV gauges are best-effort decoration on the memory view
+
+
 def cmd_memory(args):
     """Memory plane: live objects grouped by creation callsite (or job /
     node / ungrouped) with owner, bytes, and leak classification — the
@@ -167,6 +200,7 @@ def cmd_memory(args):
                 f"{r.get('job') or '-':<10} {r.get('kind') or '-':<12} "
                 f"{r['object_id'][:16]:<18} {r.get('callsite') or '-'}"
             )
+        _print_kv_cache_section(units)
         return
     summary = state.summarize_objects(group_by=args.group_by, limit=args.limit)
     rows = summary["rows"]
@@ -218,6 +252,7 @@ def cmd_memory(args):
             )
     elif args.leaks_only and not rows:
         print("no leak suspects")
+    _print_kv_cache_section(units)
 
 
 def _parse_since(raw: str) -> float:

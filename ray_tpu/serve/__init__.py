@@ -70,7 +70,20 @@ __all__ = [
     "ReplicaDrainingError",
     "DeploymentOverloadedError",
     "RequestTimeoutError",
+    "llm",
 ]
+
+
+def __getattr__(name):
+    # the LLM plane imports jax via the model family; load it only when
+    # asked for so plain serve users keep a jax-free import
+    if name == "llm":
+        import importlib
+
+        mod = importlib.import_module("ray_tpu.serve.llm")
+        globals()["llm"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 from ray_tpu._private import usage as _usage
 

@@ -68,7 +68,10 @@ def _error_body(status: int, message: str) -> Tuple[int, bytes, str]:
 def _retry_after_headers(e: DeploymentOverloadedError) -> Dict[str, str]:
     import math
 
-    return {"Retry-After": str(max(1, int(math.ceil(e.retry_after_s))))}
+    # getattr: a replica-raised shed may cross the task boundary as a
+    # reconstructed instance without the attribute
+    after = getattr(e, "retry_after_s", 1.0) or 1.0
+    return {"Retry-After": str(max(1, int(math.ceil(after))))}
 
 
 @ray_tpu.remote(max_concurrency=16)
@@ -465,6 +468,16 @@ class HTTPProxy:
                                    extra_headers=None) -> bool:
         first = await q.get()
         if first is None or isinstance(first, BaseException):
+            if isinstance(first, DeploymentOverloadedError):
+                # replica-side shed (e.g. KV-aware admission in an LLM
+                # engine) raised before the first response event: same
+                # 503 + Retry-After surface as proxy-side admission
+                hdrs = dict(extra_headers or {})
+                hdrs.update(_retry_after_headers(first))
+                await self._write_simple(
+                    writer, *_error_body(503, str(first)), keep, hdrs
+                )
+                return True
             msg = str(first) if first is not None else "empty ASGI response"
             await self._write_simple(
                 writer, *_error_body(500, msg), keep, extra_headers

@@ -268,6 +268,33 @@ class Replica:
             return []
         return win.raw()[-int(max_n):]
 
+    def _record_ttft(self, ttft_ms: float) -> None:
+        """Sliding-window TTFT sample (streaming responses only) — folded
+        per-deployment by the controller, where it doubles as the
+        TTFT-driven autoscaling signal (``target_ttft_ms``)."""
+        try:
+            from ray_tpu.util.tracing import current_trace_id
+
+            win = getattr(self, "_ttft_win", None)
+            if win is None:
+                from ray_tpu._private.telemetry import LatencyWindow
+                from ray_tpu._private.worker import get_runtime
+
+                window_s = float(
+                    getattr(get_runtime().config, "latency_window_s", 60.0)
+                )
+                win = self._ttft_win = LatencyWindow(window_s=window_s)
+            win.observe(ttft_ms, current_trace_id())
+        except Exception:
+            pass
+
+    def ttft_samples(self, max_n: int = 512):
+        """Raw in-window (ts, ttft_ms, trace_id) stream-TTFT samples."""
+        win = getattr(self, "_ttft_win", None)
+        if win is None:
+            return []
+        return win.raw()[-int(max_n):]
+
     def _record_failure(self, method: str, error: BaseException) -> None:
         """Ship a request failure into the cluster event log (forensics
         plane) so ``list_cluster_events`` covers the serving path, not just
@@ -397,6 +424,7 @@ class Replica:
                             )
                         except Exception:
                             pass
+                        self._record_ttft(ttft_ms)
                     items += 1
                     yield item
                 span_extras["stream_items"] = items

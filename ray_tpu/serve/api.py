@@ -191,9 +191,14 @@ class Deployment:
 
 
 def deployment(target=None, **options):
-    """``@serve.deployment`` decorator (parity: ``api.py``)."""
+    """``@serve.deployment`` decorator (parity: ``api.py``).
+
+    Works bare (``@serve.deployment``), parametrised
+    (``@serve.deployment(num_replicas=2)``), and as a direct call with
+    both (``serve.deployment(MyClass, num_replicas=2)``) — options must
+    never be silently dropped in the direct-call form."""
     if target is not None and callable(target):
-        return Deployment(target)
+        return Deployment(target, **options)
 
     def wrap(t):
         return Deployment(t, **options)
@@ -722,6 +727,10 @@ class ServeController:
                     # (sliding-window p50/p95/p99 across ALL replicas, with
                     # exemplar trace ids for the slow tail)
                     "latency": d.get("latency"),
+                    # stream-TTFT fold (streaming deployments only): the
+                    # tracing plane's per-stream first-token spans, rolled
+                    # into a per-deployment window — the LLM SLO surface
+                    "ttft": d.get("ttft"),
                     # the resilience knobs, surfaced for operators
                     # (docstring: Deployment)
                     "config": _handle_config(spec),
@@ -775,7 +784,13 @@ class ServeController:
         """Queue-depth autoscaling (parity: serve autoscaling_policy.py):
         desired = clamp(ceil(total_ongoing / target), min, max), where
         total_ongoing is the replicas' queued+running depth. Only moves the
-        TARGET; the reconcile pass starts/drains replicas toward it."""
+        TARGET; the reconcile pass starts/drains replicas toward it.
+
+        With ``target_ttft_ms`` set, the folded stream-TTFT window acts as
+        a second scale-UP signal: a p99 TTFT above target asks for one more
+        replica even when queue depths look fine (decode slots saturated by
+        long streams rather than queued requests). TTFT never scales down —
+        an idle deployment has no TTFT samples, only depths."""
         cfg = d["spec"].get("autoscaling_config")
         if not cfg or not alive or depths is None:
             return
@@ -786,6 +801,14 @@ class ServeController:
         import math
 
         desired = max(lo, min(hi, math.ceil(total / max(target, 1e-9)) or lo))
+        ttft_target = cfg.get("target_ttft_ms")
+        if ttft_target is not None:
+            snap = d.get("ttft") or {}
+            p99 = snap.get("p99")
+            if snap.get("count", 0) >= int(cfg.get("ttft_min_samples", 5)) and (
+                p99 is not None and float(p99) > float(ttft_target)
+            ):
+                desired = max(desired, min(hi, len(alive) + 1))
         d["spec"]["num_replicas"] = desired
 
     # -- reconciliation (parity: DeploymentState reconcile loop) ----------
@@ -904,6 +927,29 @@ class ServeController:
                     if samples:
                         win.merge_from(samples)
                 d["latency"] = win.snapshot()
+            except Exception:
+                pass
+            # stream-TTFT aggregation (same fold, separate window): the
+            # per-deployment p50/p99 TTFT shown by serve.status() and the
+            # TTFT-driven autoscaling signal (target_ttft_ms)
+            try:
+                ttft_refs = [r.ttft_samples.remote() for r in alive]
+                all_ttft = ray_tpu.get(
+                    ttft_refs,
+                    timeout=max(0.5, probe_deadline - time.monotonic()),
+                )
+                from ray_tpu._private.telemetry import LatencyWindow as _LW
+                from ray_tpu._private.worker import get_runtime as _grt
+
+                twin = _LW(
+                    window_s=float(
+                        getattr(_grt().config, "latency_window_s", 60.0)
+                    )
+                )
+                for samples in all_ttft:
+                    if samples:
+                        twin.merge_from(samples)
+                d["ttft"] = twin.snapshot()
             except Exception:
                 pass
             # health state vs the PRE-autoscale target and BEFORE repair:

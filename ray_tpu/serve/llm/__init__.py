@@ -1,0 +1,27 @@
+"""LLM serving plane: paged KV cache + continuous batching on serve.
+
+Import lazily (``from ray_tpu.serve import llm``) — this package pulls in
+jax via the model family, which plain serve users should not pay for.
+"""
+
+from ray_tpu.serve.llm.deployment import TINY_MODEL, LLMServer, llm_deployment
+from ray_tpu.serve.llm.engine import EngineConfig, InferenceEngine, TokenStream
+from ray_tpu.serve.llm.kv_cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    BlockTable,
+    KVCacheExhausted,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "BlockTable",
+    "EngineConfig",
+    "InferenceEngine",
+    "KVCacheExhausted",
+    "LLMServer",
+    "NULL_BLOCK",
+    "TINY_MODEL",
+    "TokenStream",
+    "llm_deployment",
+]

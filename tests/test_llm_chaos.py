@@ -1,0 +1,202 @@
+"""LLM serving chaos: SIGKILL replicas under live decode streams
+(`make chaos-serve`, seeded via CHAOS_SEED).
+
+Acceptance (ISSUE 20): a replica killed mid-decode fails its streams
+either before the first token or with a typed ``ReplicaDiedError`` —
+never an untyped error, never a hang; after the fleet heals, greedy
+decode still matches the pre-chaos reference (weights re-seed
+deterministically). Graceful drain (redeploy under load) finishes every
+in-flight decode with zero failures of any kind.
+"""
+
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import serve  # noqa: E402
+from ray_tpu.serve.llm import llm_deployment, TINY_MODEL  # noqa: E402
+
+# pytest's prepend import mode puts tests/ on sys.path (no tests/__init__),
+# so the chaos harness package imports as a top-level name
+from chaos import ChaosMonkey, chaos_seed, serve_replica_pids  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+ENGINE = dict(
+    block_size=4,
+    num_blocks=256,
+    max_batch=4,
+    max_blocks_per_seq=32,
+    max_waiting=8,
+)
+PROMPT = [7, 3, 11, 23, 5, 42]
+N_TOKENS = 32
+
+
+def _deploy_llm(name, **opts):
+    opts.setdefault("num_replicas", 2)
+    opts.setdefault("health_check_period_s", 0.5)
+    opts.setdefault("max_ongoing_requests", 12)
+    app = llm_deployment(TINY_MODEL, ENGINE, deployment_name="llm", **opts)
+    serve.run(app, name=name)
+    return serve.get_app_handle(name).options(stream=True)
+
+
+def test_llm_replica_kill_mid_decode_fails_typed_or_pre_token():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        h = _deploy_llm("llmchaos")
+        # pre-chaos greedy reference: every healed replica re-seeds the
+        # same weights, so this must still hold token-for-token after kills
+        reference = list(h.generate.remote(PROMPT, max_new_tokens=N_TOKENS))
+        assert len(reference) == N_TOKENS
+
+        counts = {"ok": 0, "typed": 0, "shed": 0, "other": 0}
+        post_token_untyped = []
+        other_errors = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(i):
+            hc = serve.get_app_handle("llmchaos").options(stream=True)
+            while not stop.is_set():
+                got = []
+                try:
+                    for tok in hc.generate.remote(
+                        PROMPT, max_new_tokens=N_TOKENS
+                    ):
+                        got.append(tok)
+                    with lock:
+                        counts["ok"] += 1
+                    assert got == reference
+                except serve.ReplicaDiedError:
+                    # typed death is acceptable at ANY point in the stream
+                    with lock:
+                        counts["typed"] += 1
+                except serve.DeploymentOverloadedError:
+                    # sheds may only happen before the first token
+                    with lock:
+                        counts["shed"] += 1
+                        if got:
+                            post_token_untyped.append(
+                                f"shed after {len(got)} tokens"
+                            )
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        counts["other"] += 1
+                        if len(other_errors) < 5:
+                            other_errors.append(repr(e))
+                        if got:
+                            post_token_untyped.append(
+                                f"{type(e).__name__} after {len(got)} tokens"
+                            )
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+
+        monkey = ChaosMonkey(
+            seed=chaos_seed(),
+            interval_s=(1.0, 2.0),
+            victims=serve_replica_pids,
+            max_kills=2,
+            arm_when=lambda: counts["ok"] >= 3,
+        )
+        monkey.start()
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline and len(monkey.kills) < 2:
+            time.sleep(0.2)
+        kills = monkey.stop()
+        # let the fleet heal while load continues
+        heal_deadline = time.monotonic() + 60.0
+        while time.monotonic() < heal_deadline:
+            try:
+                row = serve.status().get("llmchaos", {}).get("llm", {})
+                if row.get("num_replicas") == 2 and row.get("health") == "HEALTHY":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert kills >= 1, f"chaos monkey landed no kills (seed={chaos_seed()})"
+        assert counts["other"] == 0, (
+            f"untyped failures under decode chaos (seed={chaos_seed()}): "
+            f"{counts} {other_errors}"
+        )
+        assert not post_token_untyped, (
+            f"streams failed non-typed AFTER first token "
+            f"(seed={chaos_seed()}): {post_token_untyped}"
+        )
+        assert counts["ok"] > 3, f"not enough successful decodes: {counts}"
+
+        # the healed fleet still decodes the reference greedily
+        healed = list(h.generate.remote(PROMPT, max_new_tokens=N_TOKENS))
+        assert healed == reference
+        print(
+            f"llm chaos (seed={chaos_seed()}): kills={monkey.kills} "
+            f"counts={counts}"
+        )
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_llm_drain_finishes_inflight_decodes():
+    """Graceful redeploy while decode streams are open: the drain keeps
+    old replicas alive until their in-flight decodes finish — every
+    stream completes, token-for-token, zero failures."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        h = _deploy_llm(
+            "llmdrain", num_replicas=2, graceful_shutdown_timeout_s=30.0
+        )
+        reference = list(h.generate.remote(PROMPT, max_new_tokens=48))
+        results = []
+        errors = []
+
+        def consumer(i):
+            hc = serve.get_app_handle("llmdrain").options(stream=True)
+            try:
+                results.append(
+                    list(hc.generate.remote(PROMPT, max_new_tokens=48))
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=consumer, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # let the decodes start
+        # full replica restart mid-decode: drain must let them finish
+        serve.run(
+            llm_deployment(
+                TINY_MODEL,
+                ENGINE,
+                deployment_name="llm",
+                num_replicas=2,
+                health_check_period_s=0.5,
+                max_ongoing_requests=12,
+                graceful_shutdown_timeout_s=30.0,
+            ),
+            name="llmdrain",
+        )
+        for t in threads:
+            t.join(timeout=90)
+        assert not errors, f"drain tore open decode streams: {errors[:3]}"
+        assert len(results) == 3
+        for out in results:
+            assert out == reference
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

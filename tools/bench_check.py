@@ -13,6 +13,16 @@ Rules (per newest row of each metric):
   * ``*_overhead_ratio``  — value must be <= the row's numeric ``budget``
     field when present, else <= the default 1.05.
   * ``*_stage_coverage``  — value must be >= 0.9.
+  * ``*_ttft_p99_ms``     — value must be <= the row's numeric ``budget``
+    field when present, else <= the default 5000 ms (the
+    ``deployment_ttft_p99`` SLO surface: TTFT quoted from the tracing
+    plane's stream spans must stay bounded).
+  * ``*_floor_ratio``     — value must be >= the row's numeric ``floor``
+    field when present, else >= 1.0 (e.g. continuous batching must not
+    lose to the static baseline on the same host).
+  * ``*_untyped_failures`` — value must be <= the row's numeric
+    ``budget`` field when present, else <= 0 (saturation must shed
+    typed, never collapse untyped).
 
 Rows whose ``value`` is null/non-numeric (placeholders for benches not yet
 run on this host) are reported but don't gate.
@@ -30,6 +40,9 @@ import sys
 
 DEFAULT_RATIO_BUDGET = 1.05
 COVERAGE_FLOOR = 0.9
+DEFAULT_TTFT_BUDGET_MS = 5000.0
+DEFAULT_FLOOR_RATIO = 1.0
+DEFAULT_UNTYPED_BUDGET = 0
 
 
 def load_newest_rows(root: str) -> dict[str, tuple[dict, str]]:
@@ -65,19 +78,31 @@ def check(root: str) -> int:
         row, src = newest[metric]
         gated_ratio = metric.endswith("_overhead_ratio")
         gated_cov = metric.endswith("_stage_coverage")
-        if not (gated_ratio or gated_cov):
+        gated_ttft = metric.endswith("_ttft_p99_ms")
+        gated_floor = metric.endswith("_floor_ratio")
+        gated_untyped = metric.endswith("_untyped_failures")
+        if not (gated_ratio or gated_cov or gated_ttft or gated_floor
+                or gated_untyped):
             continue
         value = row.get("value")
         if not isinstance(value, (int, float)):
             print(f"  SKIP  {metric} ({src}): no numeric value recorded")
             continue
         checked += 1
-        if gated_ratio:
+        if gated_ratio or gated_ttft or gated_untyped:
             budget = row.get("budget")
-            limit = budget if isinstance(budget, (int, float)) \
-                else DEFAULT_RATIO_BUDGET
+            default = (DEFAULT_RATIO_BUDGET if gated_ratio
+                       else DEFAULT_TTFT_BUDGET_MS if gated_ttft
+                       else DEFAULT_UNTYPED_BUDGET)
+            limit = budget if isinstance(budget, (int, float)) else default
             ok = value <= limit
             verdict = f"{value} <= {limit}"
+        elif gated_floor:
+            floor = row.get("floor")
+            limit = floor if isinstance(floor, (int, float)) \
+                else DEFAULT_FLOOR_RATIO
+            ok = value >= limit
+            verdict = f"{value} >= {limit}"
         else:
             ok = value >= COVERAGE_FLOOR
             verdict = f"{value} >= {COVERAGE_FLOOR}"
